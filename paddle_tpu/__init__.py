@@ -1,0 +1,85 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Capability bar: PaddlePaddle (reference mounted at /root/reference; see SURVEY.md).
+Architecture: idiomatic JAX/XLA — eager dispatch via cached per-op XLA executables,
+tape autograd mirroring the reference's GradNode graph, whole-graph trace+compile for
+`to_static`, and parallelism expressed as shardings over `jax.sharding.Mesh` with XLA
+collectives over ICI/DCN instead of NCCL.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core dtypes
+from .core.dtype import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128,
+)
+from .core.device import (  # noqa: F401
+    CPUPlace, TPUPlace, Place, set_device, get_device, device_count,
+    is_compiled_with_tpu,
+)
+# CUDAPlace parity alias: reference code using CUDAPlace runs on the accelerator
+CUDAPlace = TPUPlace
+
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.dispatch import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .core.autograd import grad  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+
+from .ops import *  # noqa: F401,F403  (tensor ops; also patches Tensor methods)
+from .ops import linalg  # noqa: F401
+
+from .framework import io as _io  # noqa: E402
+save = _io.save
+load = _io.load
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+
+
+def disable_static(place=None):  # parity no-op: eager is the default (and only) base mode
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for compiled graphs")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def get_default_dtype():
+    return "float32"
+
+
+_default_dtype = ["float32"]
+
+
+def set_default_dtype(d):
+    from .core.dtype import convert_dtype
+    _default_dtype[0] = str(convert_dtype(d))
+
+
+def is_grad_enabled_():
+    from .core.dispatch import is_grad_enabled as _ige
+    return _ige()
+
+
+def summary(net, input_size=None, dtypes=None):
+    total = 0
+    trainable = 0
+    for p in net.parameters():
+        total += p.size
+        if p.trainable:
+            trainable += p.size
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,48 @@
+"""paddle.amp.auto_cast / decorate (reference: python/paddle/amp/auto_cast.py).
+
+O1: per-op white/black-list casting at dispatch time (core/amp_state.py).
+O2: parameters cast to the low dtype; optimizer keeps fp32 master weights
+(multi_precision). bf16 is the TPU-native default.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ..core.amp_state import AmpAttrs, amp_state, set_amp_state
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = amp_state()
+    set_amp_state(AmpAttrs(enable, dtype, level, custom_white_list,
+                           custom_black_list))
+    try:
+        yield
+    finally:
+        set_amp_state(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to low precision, enable master weights."""
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    opt_list = ([optimizers] if single_opt else list(optimizers or []))
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                import numpy as np
+                if str(np.dtype(p.dtype)) == "float32":
+                    p._set_value_inplace(p.value().astype(
+                        "bfloat16" if dtype in ("bfloat16", "bf16") else "float16"))
+        for opt in opt_list:
+            opt._multi_precision = True if master_weight is None else bool(master_weight)
+    if optimizers is None:
+        return models if single_model else model_list
+    return ((model_list[0] if single_model else model_list),
+            (opt_list[0] if single_opt else opt_list))

@@ -1,0 +1,141 @@
+"""paddle.autograd namespace: backward, PyLayer, no_grad.
+
+Reference: python/paddle/autograd/ — PyLayer (py_layer.py) lets users define custom
+forward/backward; it is the substrate for recompute and the TP collective ops in Fleet.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..core.autograd import GradNode, run_backward
+from ..core.dispatch import is_grad_enabled, no_grad
+from ..core.tensor import Tensor
+
+
+def backward(tensors: List[Tensor], grad_tensors=None, retain_graph=False):
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class _PyLayerNode(GradNode):
+    __slots__ = ("ctx", "py_backward", "fwd_inputs")
+
+    def __init__(self, ctx, py_backward, fwd_inputs, diff_inputs, out_metas):
+        # bypass GradNode.__init__'s executable wiring; this node runs python backward
+        self.name = f"PyLayer({py_backward.__qualname__.split('.')[0]})"
+        self.bwd_fn = None
+        self.mode = "pylayer"
+        self.saved_primals = ()
+        self.saved_outs = None
+        self.diff_idx = tuple(range(len(diff_inputs)))
+        self.input_tensors = tuple(diff_inputs)
+        self.out_metas = out_metas
+        self.released = False
+        self._saved_versions = tuple(t._version for t in diff_inputs)
+        self.ctx = ctx
+        self.py_backward = py_backward
+        self.fwd_inputs = fwd_inputs
+
+    def run(self, cotangents):
+        if self.released:
+            raise RuntimeError(f"{self.name} backward ran twice without retain_graph")
+        self.check_versions()
+        cot_tensors = [Tensor(c, stop_gradient=True) for c in cotangents]
+        with no_grad():
+            grads = self.py_backward(self.ctx, *cot_tensors)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        # align returned grads with the tensor inputs of forward
+        tensor_inputs = [a for a in self.fwd_inputs if isinstance(a, Tensor)]
+        if len(grads) != len(tensor_inputs):
+            raise RuntimeError(
+                f"{self.name}.backward returned {len(grads)} grads for "
+                f"{len(tensor_inputs)} tensor inputs")
+        pairs = []
+        by_id = {id(t): i for i, t in enumerate(tensor_inputs)}
+        for t in self.input_tensors:
+            g = grads[by_id[id(t)]]
+            if g is None:
+                pairs.append((t, None))
+            else:
+                pairs.append((t, g.value() if isinstance(g, Tensor) else jnp.asarray(g)))
+        return pairs
+
+    def release(self):
+        self.ctx = None
+        self.released = True
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op.
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.exp(x)
+            ctx.save_for_backward(y)
+            return y
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        outs_t = tuple(o if isinstance(o, Tensor) else Tensor(o) for o in outs_t)
+        if record:
+            diff_inputs = [t for t in tensor_inputs
+                           if not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.inexact)]
+            node = _PyLayerNode(
+                ctx, cls.backward, args, diff_inputs,
+                tuple((tuple(o.shape), o.dtype) for o in outs_t))
+            wired = []
+            for i, o in enumerate(outs_t):
+                t = Tensor(o.value(), stop_gradient=False)
+                t._grad_node = node
+                t._out_index = i
+                wired.append(t)
+            outs_t = tuple(wired)
+        return outs_t[0] if single else outs_t
+
+
+LegacyPyLayer = PyLayer

@@ -1,0 +1,65 @@
+"""AMP dispatch state — consulted by core.dispatch on every eager op.
+
+Reference analog: the AMP auto-cast step inside generated `*_ad_func` forwards
+(eager_gen.py: AMP cast before PHI API call) with O1 white/black lists
+(python/paddle/amp/auto_cast.py). bf16-first on TPU.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+_tls = threading.local()
+
+# O1 lists (subset of reference white/black lists, matched to our op names)
+WHITE_LIST = {
+    "matmul", "linear", "bmm", "mv", "einsum", "conv", "conv_transpose", "sdpa",
+    "addmm", "inner", "outer",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax_ce_noreduce",
+    "cross_entropy", "cross_entropy_w", "mse_loss", "l1_loss", "bce", "bce_logits",
+    "sum", "mean", "norm_fro", "norm_p", "softmax", "log_softmax", "cumsum",
+    "layer_norm", "batch_norm_train", "batch_norm_infer", "rms_norm", "nll_loss",
+    "kl_div", "pow",
+}
+
+
+def amp_state():
+    return getattr(_tls, "amp", None)
+
+
+def set_amp_state(state):
+    _tls.amp = state
+
+
+class AmpAttrs:
+    __slots__ = ("enable", "dtype", "level", "custom_white_list", "custom_black_list")
+
+    def __init__(self, enable, dtype, level, custom_white_list=None,
+                 custom_black_list=None):
+        self.enable = enable
+        self.dtype = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") else jnp.float16
+        self.level = level
+        self.custom_white_list = set(custom_white_list or ())
+        self.custom_black_list = set(custom_black_list or ())
+
+
+def maybe_cast_inputs(op_name, arrays):
+    """O1 policy: white-listed ops run in low precision; black-listed forced fp32;
+    others run in the widest input dtype (no cast)."""
+    st = amp_state()
+    if st is None or not st.enable:
+        return arrays
+    if st.level == "O2":
+        # O2: everything except blacklist runs in low precision
+        if op_name in BLACK_LIST or op_name in st.custom_black_list:
+            return [a.astype(jnp.float32) if a.dtype == st.dtype else a for a in arrays]
+        return [a.astype(st.dtype) if a.dtype == jnp.float32 else a for a in arrays]
+    if (op_name in WHITE_LIST or op_name in st.custom_white_list) \
+            and op_name not in st.custom_black_list:
+        return [a.astype(st.dtype) if a.dtype == jnp.float32 else a for a in arrays]
+    if op_name in BLACK_LIST or op_name in st.custom_black_list:
+        return [a.astype(jnp.float32) if a.dtype == st.dtype else a for a in arrays]
+    return arrays

@@ -1,0 +1,222 @@
+"""Tape-free eager autograd engine.
+
+Reference analog: `fluid/eager/grad_node_info.h:168` (GradNodeBase with slots/edges),
+`eager/backward.cc:104` (RunBackward: in-degree map + topological queue walk) and
+`eager/accumulation/` (leaf grad accumulation). The structure here is the same — a reverse
+graph of GradNodes discovered at dispatch time — but each node's backward is a cached XLA
+executable produced by `jit(vjp(fwd))` rather than a generated CUDA grad kernel.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+class GradNode:
+    """One node of the reverse graph: knows how to turn output cotangents into input grads."""
+
+    __slots__ = ("name", "bwd_fn", "mode", "saved_primals", "saved_outs", "diff_idx",
+                 "input_tensors", "out_metas", "released", "_saved_versions")
+
+    def __init__(self, name, bwd_fn, mode, saved_primals, saved_outs, diff_idx,
+                 input_tensors, out_metas):
+        self.name = name
+        self.bwd_fn = bwd_fn
+        self.mode = mode  # "generic" (recompute-vjp over diff_idx) | "explicit"
+        self.saved_primals = saved_primals
+        self.saved_outs = saved_outs
+        self.diff_idx = diff_idx
+        self.input_tensors = input_tensors  # Tensors at diff_idx positions
+        self.out_metas = out_metas  # [(shape, dtype)] per output slot
+        self.released = False
+        # inplace-safety: snapshot input tensor versions (reference: eager/tensor_wrapper.h)
+        self._saved_versions = tuple(t._version for t in input_tensors)
+
+    def check_versions(self):
+        for t, v in zip(self.input_tensors, self._saved_versions):
+            if t._version != v:
+                raise RuntimeError(
+                    f"tensor used by {self.name} backward was modified in-place "
+                    f"(version {t._version} != saved {v}); this would produce wrong "
+                    f"gradients (reference analog: TensorWrapper inplace version check)")
+
+    def run(self, cotangents: Tuple) -> List:
+        """Returns list of (input_tensor, grad_array) pairs for diff inputs."""
+        if self.released:
+            raise RuntimeError(
+                f"trying to run backward of {self.name} a second time "
+                f"(specify retain_graph=True the first time)")
+        self.check_versions()
+        if self.mode == "explicit":
+            grads = self.bwd_fn(self.saved_primals, self.saved_outs, cotangents)
+            grads = [grads[i] for i in self.diff_idx]
+        else:
+            grads = self.bwd_fn(self.saved_primals, cotangents)
+        return list(zip(self.input_tensors, grads))
+
+    def release(self):
+        self.saved_primals = None
+        self.saved_outs = None
+        self.released = True
+
+    def __repr__(self):
+        return f"GradNode({self.name})"
+
+
+def _ones_like_meta(meta):
+    shape, dt = meta
+    return jnp.ones(shape, dt)
+
+
+def _zeros_like_meta(meta):
+    shape, dt = meta
+    return jnp.zeros(shape, dt)
+
+
+def _build_indegree(roots: Sequence[GradNode]) -> Dict[GradNode, int]:
+    """BFS the reverse graph; in-degree of P = #consumer nodes reachable that feed P.
+
+    Reference: getInDegreeMap, eager/backward.cc:22.
+    """
+    indeg: Dict[GradNode, int] = {}
+    seen = set()
+    queue = collections.deque(roots)
+    for r in roots:
+        indeg.setdefault(r, 0)
+        seen.add(r)
+    while queue:
+        node = queue.popleft()
+        for t in node.input_tensors:
+            p = t._grad_node
+            if p is None:
+                continue
+            indeg[p] = indeg.get(p, 0) + 1
+            if p not in seen:
+                seen.add(p)
+                queue.append(p)
+    return indeg
+
+
+def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
+                 retain_graph: bool = False):
+    """Reference analog: egr::RunBackward (eager/backward.cc:104)."""
+    from .tensor import Tensor
+
+    grad_tensors = grad_tensors or [None] * len(tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors length must match tensors")
+
+    # Per-node cotangent buffers, keyed by output slot (GradTensorHolder analog).
+    buffers: Dict[GradNode, List] = {}
+    roots: List[GradNode] = []
+
+    def _acc(buf, slot, g):
+        if buf[slot] is None:
+            buf[slot] = g
+        else:
+            buf[slot] = buf[slot] + g
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("cannot call backward() on a tensor with stop_gradient=True")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward roots "
+                    f"(shape {t.shape})")
+            g_arr = jnp.ones(t.shape, t.dtype)
+        else:
+            g_arr = g.value() if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            # backward on a leaf: grad goes straight to .grad
+            t._accumulate_grad(g_arr)
+            continue
+        buf = buffers.setdefault(node, [None] * len(node.out_metas))
+        _acc(buf, t._out_index, g_arr)
+        if node not in roots:
+            roots.append(node)
+
+    if not roots:
+        return
+
+    indeg = _build_indegree(roots)
+    # Roots that also appear as producers of other roots keep their counted in-degree;
+    # ready = in-degree 0 among accumulated-root nodes.
+    ready = collections.deque(n for n in roots if indeg.get(n, 0) == 0)
+    pending = {n: d for n, d in indeg.items()}
+    visited = set()
+
+    while ready:
+        node = ready.popleft()
+        if node in visited:
+            continue
+        visited.add(node)
+        buf = buffers.pop(node, [None] * len(node.out_metas))
+        cotangents = tuple(
+            b if b is not None else _zeros_like_meta(m)
+            for b, m in zip(buf, node.out_metas))
+        for t, g in node.run(cotangents):
+            if g is None:
+                continue
+            p = t._grad_node
+            if p is None:
+                if not t.stop_gradient:
+                    t._accumulate_grad(g)
+            else:
+                pbuf = buffers.setdefault(p, [None] * len(p.out_metas))
+                _acc(pbuf, t._out_index, g)
+                if t._retain_grad_flag and not t.stop_gradient:
+                    t._accumulate_grad(g)
+        if not retain_graph:
+            node.release()
+        for t in node.input_tensors:
+            p = t._grad_node
+            if p is None or p in visited:
+                continue
+            pending[p] -= 1
+            if pending[p] == 0:
+                ready.append(p)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """paddle.grad analog (reference: GeneralGrad in eager/backward.cc).
+
+    First-order only for now (create_graph raises); computes d(outputs)/d(inputs)
+    without touching .grad of other leaves.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True (double grad) not yet supported")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    # Snapshot and clear target grads, run backward, collect, restore.
+    saved = [(t, t._grad) for t in inputs]
+    targets = set(id(t) for t in inputs)
+    for t in inputs:
+        t._grad = None
+        t._retain_grad_flag = True
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t._grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the inputs has no gradient path from outputs "
+                        "(pass allow_unused=True to get None)")
+                results.append(None)
+            else:
+                results.append(Tensor(t._grad, stop_gradient=True))
+        return results
+    finally:
+        for t, g in saved:
+            t._grad = g
+            t._retain_grad_flag = False

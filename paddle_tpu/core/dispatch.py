@@ -1,0 +1,242 @@
+"""Eager op dispatch: the TPU-native replacement for the reference's PHI kernel machinery.
+
+Reference analog: `phi/core/kernel_factory.h` (KernelKey select) + generated dygraph
+`*_ad_func` forwards (`fluid/eager/auto_code_generator/generator/eager_gen.py:209`). There,
+every op resolves to a hand-written CUDA kernel; here, every op is a small jax-traceable
+function compiled once per (op, attrs, shapes, dtypes) into a cached XLA executable — the
+idiomatic way to get "eager" dispatch on an AOT-compiled device (SURVEY.md §7 hard part a).
+
+Backward rules come for free: the generic backward executable is `jit(vjp(fwd))`, where XLA
+dead-code-eliminates whatever part of the recomputed forward the cotangent doesn't need
+(e.g. matmul's vjp needs only the primal inputs, so the forward matmul is DCE'd away). Ops
+may still register an explicit bwd for cases where recompute-vjp is wrong or wasteful.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .flags import flag
+
+
+class OpDef:
+    __slots__ = ("name", "fwd", "bwd", "nondiff_inputs")
+
+    def __init__(self, name: str, fwd: Callable, bwd: Optional[Callable] = None,
+                 nondiff_inputs: Sequence[int] = ()):
+        self.name = name
+        self.fwd = fwd
+        self.bwd = bwd  # explicit backward: bwd(primals, outs, cotangents, **attrs) -> grads tuple
+        self.nondiff_inputs = frozenset(nondiff_inputs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fwd: Callable, bwd: Optional[Callable] = None,
+                nondiff_inputs: Sequence[int] = ()) -> OpDef:
+    op = OpDef(name, fwd, bwd, nondiff_inputs)
+    _REGISTRY[name] = op
+    return op
+
+
+def get_op(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+# ---------------------------------------------------------------- grad / trace mode
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(value: bool):
+    _tls.grad_enabled = bool(value)
+
+
+class no_grad:
+    """Context manager + decorator disabling autograd recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def in_trace() -> bool:
+    """True while tracing a to_static program (dispatch must not re-jit per op)."""
+    return getattr(_tls, "trace_depth", 0) > 0
+
+
+def push_trace():
+    _tls.trace_depth = getattr(_tls, "trace_depth", 0) + 1
+
+
+def pop_trace():
+    _tls.trace_depth = getattr(_tls, "trace_depth", 0) - 1
+
+
+# ---------------------------------------------------------------- executable caches
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.dtype):
+        return str(v)
+    return v
+
+
+def _attr_key(attrs: dict) -> Tuple:
+    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+
+
+@functools.lru_cache(maxsize=None)
+def _fwd_exec(name: str, attr_key: Tuple):
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+    fn = functools.partial(op.fwd, **attrs) if attrs else op.fwd
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_exec(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
+    """Generic backward executable: recompute-vjp of fwd w.r.t. diff_idx inputs."""
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+
+    def bwd(primals, cotangents):
+        def f(*diff_primals):
+            full = list(primals)
+            for slot, p in zip(diff_idx, diff_primals):
+                full[slot] = p
+            out = op.fwd(*full, **attrs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        _, vjp_fn = jax.vjp(f, *[primals[i] for i in diff_idx])
+        return vjp_fn(tuple(cotangents))
+
+    return jax.jit(bwd)
+
+
+@functools.lru_cache(maxsize=None)
+def _explicit_bwd_exec(name: str, attr_key: Tuple):
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+    fn = functools.partial(op.bwd, **attrs) if attrs else op.bwd
+    return jax.jit(fn)
+
+
+def clear_executable_cache():
+    _fwd_exec.cache_clear()
+    _bwd_exec.cache_clear()
+    _explicit_bwd_exec.cache_clear()
+
+
+# ---------------------------------------------------------------- dispatch entry
+
+
+def _check_nan_inf(name, outs):
+    for o in outs:
+        if isinstance(o, jax.Array) and jnp.issubdtype(o.dtype, jnp.inexact):
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"Operator {name} output contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is enabled)")
+
+
+def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
+    """Execute a registered op on Tensor/array inputs; record autograd if needed.
+
+    Returns raw output(s) wrapped into Tensors by the caller-side helper in
+    paddle_tpu.core.tensor (kept separate to avoid an import cycle).
+    """
+    from .tensor import Tensor, wrap_outputs  # local: cycle with tensor.py
+
+    attrs = attrs or {}
+    arrays = []
+    requires = []
+    in_tensors = []
+    for a in tensor_args:
+        if isinstance(a, Tensor):
+            arrays.append(a.value())
+            requires.append((not a.stop_gradient) and dtypes.is_differentiable(a.dtype))
+            in_tensors.append(a)
+        else:
+            arrays.append(a if isinstance(a, jax.Array) else jnp.asarray(a))
+            requires.append(False)
+            in_tensors.append(None)
+
+    from .amp_state import maybe_cast_inputs
+    arrays = maybe_cast_inputs(name, arrays)
+
+    op = _REGISTRY[name]
+    key = _attr_key(attrs)
+    record = is_grad_enabled() and any(requires)
+
+    if in_trace():
+        # Inside a to_static trace: call the raw function so everything inlines into the
+        # enclosing jit; no per-op executables, no autograd tape (grad via whole-graph vjp).
+        outs = op.fwd(*arrays, **attrs)
+    else:
+        outs = _fwd_exec(name, key)(*arrays)
+
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+
+    if flag("FLAGS_check_nan_inf") and not in_trace():
+        _check_nan_inf(name, outs_t)
+
+    node = None
+    if record and not in_trace():
+        from .autograd import GradNode
+        diff_idx = tuple(i for i, r in enumerate(requires)
+                         if r and i not in op.nondiff_inputs)
+        if diff_idx:
+            if op.bwd is not None:
+                bwd_fn = _explicit_bwd_exec(name, key)
+                mode = "explicit"
+            else:
+                bwd_fn = _bwd_exec(name, key, diff_idx, len(arrays))
+                mode = "generic"
+            node = GradNode(name=name, bwd_fn=bwd_fn, mode=mode,
+                            saved_primals=tuple(arrays),
+                            saved_outs=outs_t if mode == "explicit" else None,
+                            diff_idx=diff_idx,
+                            input_tensors=tuple(in_tensors[i] for i in diff_idx),
+                            out_metas=tuple((o.shape, o.dtype) for o in outs_t))
+
+    return wrap_outputs(outs_t, single, node)

@@ -1,0 +1,84 @@
+"""Dtype model for the framework.
+
+The reference keeps a C++ enum (`phi/common/data_type.h`) plus numpy interop; here the
+canonical representation is the JAX/numpy dtype object, with thin aliases exported at the
+package root (``paddle_tpu.float32`` etc.) mirroring ``paddle.float32``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (mirror reference phi/common/data_type.h enum members).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+# TPU-native deviation: int32 is the canonical integer dtype (XLA x64 disabled);
+# "int64" is accepted everywhere and maps to int32. True 64-bit ints are available
+# only by enabling jax_enable_x64, which is off for TPU performance.
+int64 = jnp.int32
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+
+
+def convert_dtype(dtype):
+    """Normalize str/np.dtype/jnp dtype to a canonical numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_ALIASES:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        return np.dtype(_STR_ALIASES[key])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = np.dtype(dtype)
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    d = np.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = np.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.integer) or d == np.dtype(np.bool_)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(convert_dtype(dtype)), jnp.complexfloating)
+
+
+def is_differentiable(dtype) -> bool:
+    d = np.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.inexact)

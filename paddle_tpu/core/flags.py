@@ -1,0 +1,54 @@
+"""Runtime flag registry.
+
+Reference analog: ``PADDLE_DEFINE_EXPORTED_*`` gflags (phi/core/flags.h:43-90) settable via
+``FLAGS_*`` env vars or ``paddle.set_flags``. Here flags are a plain registry seeded from
+the environment, queried by subsystems at call time.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {}
+_DEFS: Dict[str, tuple] = {}  # name -> (type, default, help)
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    typ = type(default)
+    _DEFS[name] = (typ, default, help_str)
+    env = os.environ.get(name)
+    if env is not None:
+        if typ is bool:
+            _FLAGS[name] = env.lower() in ("1", "true", "yes", "on")
+        else:
+            _FLAGS[name] = typ(env)
+    else:
+        _FLAGS[name] = default
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _DEFS:
+            raise KeyError(f"unknown flag {k!r}; defined flags: {sorted(_DEFS)}")
+        typ = _DEFS[k][0]
+        _FLAGS[k] = typ(v)
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {k: _FLAGS[k] for k in names}
+
+
+def flag(name: str):
+    return _FLAGS[name]
+
+
+# Core flags (subset of the reference's ~200; grown as subsystems land).
+define_flag("FLAGS_check_nan_inf", False, "check every op output for NaN/Inf (reference: framework/details/nan_inf_utils)")
+define_flag("FLAGS_eager_jit_ops", True, "execute eager ops through cached jitted executables")
+define_flag("FLAGS_use_bf16_matmul", False, "force bf16 accumulation inputs for matmul/conv in eager mode")
+define_flag("FLAGS_retain_grad_for_all", False, "retain .grad for non-leaf tensors")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "kept for API parity; XLA owns HBM on TPU")
+define_flag("FLAGS_cudnn_deterministic", False, "kept for API parity; XLA is deterministic by default")

@@ -1,0 +1,93 @@
+"""Global PRNG state.
+
+Reference analog: per-generator Philox state (`paddle.seed`, phi Generator) and Fleet's
+``RNGStatesTracker`` for tensor-parallel-deterministic dropout
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py).
+
+TPU-idiomatic design: a single functional jax.random key chain. Every consumer splits from
+the global chain; named tracker states support the TP local/global dropout pattern.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+
+_lock = threading.Lock()
+_state = {"key": jax.random.PRNGKey(0), "seed": 0}
+
+
+def seed(value: int):
+    with _lock:
+        _state["key"] = jax.random.PRNGKey(int(value))
+        _state["seed"] = int(value)
+    return value
+
+
+def get_seed() -> int:
+    return _state["seed"]
+
+
+def split_key():
+    """Return a fresh subkey, advancing the global chain."""
+    with _lock:
+        _state["key"], sub = jax.random.split(_state["key"])
+    return sub
+
+
+def get_rng_state():
+    return _state["key"]
+
+
+def set_rng_state(key):
+    with _lock:
+        _state["key"] = key
+
+
+class RNGStatesTracker:
+    """Named RNG state chains, for TP-deterministic dropout.
+
+    Mirrors fleet's RNGStatesTracker: 'global' dropout must agree across model-parallel
+    ranks, 'local' must differ. With a functional key chain this is just separate named
+    chains seeded from rank-dependent or rank-independent seeds.
+    """
+
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name: str, seed_val: int):
+        if name in self.states_:
+            raise ValueError(f"rng state {name!r} already exists")
+        self.states_[name] = jax.random.PRNGKey(int(seed_val))
+
+    def reset(self):
+        self.states_ = {}
+
+    def split(self, name: str):
+        if name not in self.states_:
+            raise KeyError(f"rng state {name!r} not registered")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        return sub
+
+    @contextmanager
+    def rng_state(self, name: str = "global"):
+        """Within the context, the global chain is swapped for the named chain."""
+        if name not in self.states_:
+            raise KeyError(f"rng state {name!r} not registered")
+        with _lock:
+            saved = _state["key"]
+            _state["key"] = self.states_[name]
+        try:
+            yield
+        finally:
+            with _lock:
+                self.states_[name] = _state["key"]
+                _state["key"] = saved
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

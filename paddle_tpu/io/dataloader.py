@@ -1,0 +1,134 @@
+"""DataLoader (reference: python/paddle/fluid/reader.py:311 + dataloader/ worker
+machinery). Worker processes there; worker threads + a bounded prefetch queue here —
+the heavy lifting (decode/augment) is numpy which releases the GIL, and the device
+transfer is async into HBM. A C++ feeder (reference data_feed.cc analog) can slot in
+under the same interface later.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b.numpy()) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, np.float32))
+    return batch
+
+
+class _PrefetchIterator:
+    _END = object()
+
+    def __init__(self, produce, num_workers: int, prefetch: int):
+        self._q = queue.Queue(maxsize=max(prefetch, 2))
+        self._produce = produce
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for item in self._produce():
+                if self._stop.is_set():
+                    return
+                self._q.put(item)
+        finally:
+            self._q.put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False, drop_last: bool = False,
+                 collate_fn: Optional[Callable] = None, num_workers: int = 0,
+                 use_buffer_reader: bool = True, prefetch_factor: int = 2,
+                 use_shared_memory: bool = True, timeout: int = 0,
+                 worker_init_fn=None, persistent_workers: bool = False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif batch_size is None:
+            self.batch_sampler = None
+            self.batch_size = None
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _produce_batches(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        elif self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.dataset[i]
+        else:
+            if self.num_workers > 1:
+                # thread-pool fetch: numpy augmentation releases the GIL
+                import concurrent.futures as cf
+                with cf.ThreadPoolExecutor(self.num_workers) as pool:
+                    for indices in self.batch_sampler:
+                        samples = list(pool.map(self.dataset.__getitem__, indices))
+                        yield self.collate_fn(samples)
+            else:
+                for indices in self.batch_sampler:
+                    yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return _PrefetchIterator(self._produce_batches, self.num_workers,
+                                     self.prefetch_factor * max(self.num_workers, 1))
+        return self._produce_batches()

@@ -1,0 +1,13 @@
+"""paddle_tpu.nn — layers and functionals (reference: python/paddle/nn)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import (  # noqa: F401
+    Layer, LayerList, Sequential, ParameterList, LayerDict,
+)
+from .layers_common import *  # noqa: F401,F403
+from .layers_conv_pool import *  # noqa: F401,F403
+from .layers_norm_act_loss import *  # noqa: F401,F403
+from .layers_transformer import *  # noqa: F401,F403
+from .layers_rnn import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401

@@ -1,0 +1,61 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByGlobalNorm/Norm/Value consumed by optimizers)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, jnp.ndarray]]):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    @no_grad()
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max)) for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    @no_grad()
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference semantics: scale ALL grads by clip_norm/global_norm when exceeded.
+    In hybrid-parallel runs the optimizer wrapper sums the squared norms across
+    parallel groups before the sqrt (hybrid_parallel_optimizer.py analog)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    @no_grad()
+    def __call__(self, params_grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for _, g in params_grads]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
+                for p, g in params_grads]

@@ -1,0 +1,223 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...ops._helpers import _op, make_unary
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid", "tanh",
+    "silu", "swish", "mish", "hardswish", "hardsigmoid", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "softsign", "softplus", "leaky_relu", "prelu",
+    "rrelu", "log_sigmoid", "maxout", "softmax", "log_softmax", "gumbel_softmax",
+    "glu", "thresholded_relu",
+]
+
+relu = make_unary("relu", jax.nn.relu)
+relu6 = make_unary("relu6", jax.nn.relu6)
+sigmoid = make_unary("sigmoid", jax.nn.sigmoid)
+tanh = make_unary("tanh", jnp.tanh)
+silu = make_unary("silu", jax.nn.silu)
+mish = make_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softsign = make_unary("softsign", jax.nn.soft_sign)
+log_sigmoid = make_unary("log_sigmoid", jax.nn.log_sigmoid)
+tanhshrink = make_unary("tanhshrink", lambda x: x - jnp.tanh(x))
+
+
+def relu_(x):
+    out = relu(x)
+    x._data = out.value()
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x._version += 1
+    return x
+
+
+def elu(x, alpha=1.0, name=None):
+    return _op("elu", x, alpha=float(alpha))
+
+
+register_op("elu", lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _op("selu", x, scale=float(scale), alpha=float(alpha))
+
+
+register_op("selu", lambda x, scale=1.0507, alpha=1.6733:
+            scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+
+
+def celu(x, alpha=1.0, name=None):
+    return _op("celu", x, alpha=float(alpha))
+
+
+register_op("celu", lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+
+
+def gelu(x, approximate=False, name=None):
+    return _op("gelu", x, approximate=bool(approximate))
+
+
+register_op("gelu", lambda x, approximate=False: jax.nn.gelu(x, approximate=approximate))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    return _op("hardswish", x)
+
+
+register_op("hardswish", lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _op("hardsigmoid", x, slope=float(slope), offset=float(offset))
+
+
+register_op("hardsigmoid", lambda x, slope=1 / 6, offset=0.5:
+            jnp.clip(x * slope + offset, 0.0, 1.0))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _op("hardtanh", x, min=float(min), max=float(max))
+
+
+register_op("hardtanh", lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _op("hardshrink", x, threshold=float(threshold))
+
+
+register_op("hardshrink", lambda x, threshold=0.5:
+            jnp.where(jnp.abs(x) > threshold, x, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _op("softshrink", x, threshold=float(threshold))
+
+
+register_op("softshrink", lambda x, threshold=0.5:
+            jnp.where(x > threshold, x - threshold,
+                      jnp.where(x < -threshold, x + threshold, 0.0)))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _op("softplus", x, beta=float(beta), threshold=float(threshold))
+
+
+register_op("softplus", lambda x, beta=1.0, threshold=20.0:
+            jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _op("leaky_relu", x, negative_slope=float(negative_slope))
+
+
+register_op("leaky_relu", lambda x, negative_slope=0.01:
+            jax.nn.leaky_relu(x, negative_slope))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _op("prelu", x, weight, data_format=str(data_format))
+
+
+def _prelu_fwd(x, w, data_format="NCHW"):
+    if w.size == 1:
+        alpha = w.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[ch_axis] = w.size
+        alpha = w.reshape(shape)
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+register_op("prelu", _prelu_fwd)
+
+
+def rrelu(x, lower=1 / 8, upper=1 / 3, training=True, name=None):
+    if training:
+        from ...core import random as rng
+        import jax as _jax
+        a = _jax.random.uniform(rng.split_key(), tuple(x.shape), jnp.float32,
+                                lower, upper)
+        from ...core.tensor import Tensor
+        return _op("rrelu_t", x, Tensor(a))
+    return leaky_relu(x, (lower + upper) / 2)
+
+
+register_op("rrelu_t", lambda x, a: jnp.where(x >= 0, x, a.astype(x.dtype) * x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _op("thresholded_relu", x, threshold=float(threshold))
+
+
+register_op("thresholded_relu", lambda x, threshold=1.0:
+            jnp.where(x > threshold, x, 0.0))
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _op("maxout", x, groups=int(groups), axis=int(axis))
+
+
+def _maxout_fwd(x, groups=1, axis=1):
+    ax = axis % x.ndim
+    c = x.shape[ax]
+    new_shape = x.shape[:ax] + (c // groups, groups) + x.shape[ax + 1:]
+    return jnp.max(x.reshape(new_shape), axis=ax + 1)
+
+
+register_op("maxout", _maxout_fwd)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return _op("softmax", x, axis=int(axis))
+
+
+register_op("softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _op("log_softmax", x, axis=int(axis))
+
+
+register_op("log_softmax", lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as rng
+    from ...core.tensor import Tensor
+    import jax as _jax
+    g = _jax.random.gumbel(rng.split_key(), tuple(x.shape), jnp.float32)
+    return _op("gumbel_softmax", x, Tensor(g), temperature=float(temperature),
+               hard=bool(hard), axis=int(axis))
+
+
+def _gumbel_softmax_fwd(x, g, temperature=1.0, hard=False, axis=-1):
+    y = jax.nn.softmax((x + g.astype(x.dtype)) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False) \
+            if hasattr(jnp, "put_along_axis") else \
+            jnp.take_along_axis(jnp.eye(y.shape[axis], dtype=y.dtype),
+                                idx.squeeze(axis), axis=0)
+        y = jax.lax.stop_gradient(onehot - y) + y
+    return y
+
+
+register_op("gumbel_softmax", _gumbel_softmax_fwd)
+
+
+def glu(x, axis=-1, name=None):
+    return _op("glu", x, axis=int(axis))
+
+
+register_op("glu", lambda x, axis=-1: jax.nn.glu(x, axis=axis))

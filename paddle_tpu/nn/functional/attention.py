@@ -1,0 +1,87 @@
+"""Attention functionals.
+
+Reference analogs: `phi/kernels/flash_attn_kernel.h` (dynload'd FlashAttention lib) and
+`incubate/nn/memory_efficient_attention.py`. On TPU the fused kernel is a Pallas flash
+attention (paddle_tpu.kernels.pallas.flash_attention); the default path is plain XLA,
+which already fuses the softmax chain well.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...ops._helpers import _op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention"]
+
+
+def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
+              dropout_p=0.0):
+    # q,k,v: [B, L, H, D] (paddle flash_attn layout)
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,L,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if has_mask:
+        mask = rest[0]
+        logits = logits + mask.astype(logits.dtype)
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,L,H,D]
+
+
+register_op("sdpa", _sdpa_fwd)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity: [B, L, H, D] layout."""
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return _op("sdpa", *args, causal=bool(is_causal), scale=None,
+               has_mask=attn_mask is not None, dropout_p=float(dropout_p))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None, use_pallas=None):
+    """paddle.nn.functional.flash_attention parity ([B,L,H,D]).
+
+    On real TPU devices ≥ the pallas kernel's tile minimum, dispatches to the Pallas
+    flash-attention kernel; otherwise falls back to the XLA softmax-chain (which XLA
+    fuses into a flash-like schedule anyway for moderate L).
+    """
+    if use_pallas is None:
+        use_pallas = _pallas_usable(query)
+    if use_pallas:
+        from ...kernels.pallas.flash_attention import flash_attention_blhd
+        out = flash_attention_blhd(query, key, value, causal=causal)
+        if return_softmax:
+            return out, None
+        return out
+    out = _op("sdpa", query, key, value, causal=bool(causal), scale=None,
+              has_mask=False, dropout_p=float(dropout))
+    if return_softmax:
+        return out, None
+    return out
+
+
+def _pallas_usable(q):
+    try:
+        dev = q.value().devices() if hasattr(q, "value") else set()
+        if not any(d.platform in ("tpu",) for d in dev):
+            return False
+    except Exception:
+        return False
+    shape = q.shape
+    return len(shape) == 4 and shape[1] >= 128 and shape[3] >= 64

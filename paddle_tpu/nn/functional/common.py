@@ -1,0 +1,309 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+(reference: python/paddle/nn/functional/common.py, input.py)"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as rng
+from ...core.dispatch import register_op
+from ...core.tensor import Tensor
+from ...ops._helpers import _op, static_int_list
+from ...ops.manipulation import pad  # re-export paddle.nn.functional.pad
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "pad", "interpolate", "upsample", "bilinear", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "label_smooth",
+    "class_center_sample", "unfold", "fold",
+]
+
+
+def _linear_fwd(x, w, *rest, has_bias=False):
+    out = jnp.matmul(x, w)
+    if has_bias:
+        out = out + rest[0]
+    return out
+
+
+register_op("linear", _linear_fwd)
+
+
+def linear(x, weight, bias=None, name=None):
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return _op("linear", *args, has_bias=bias is not None)
+
+
+def _dropout_fwd(x, mask, p=0.5, mode="upscale_in_train"):
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / (1.0 - p), 0.0)
+    return jnp.where(mask, x, 0.0)
+
+
+register_op("dropout", _dropout_fwd, nondiff_inputs=(1,))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = static_int_list(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(rng.split_key(), 1.0 - float(p), shape)
+    mask = Tensor(jnp.broadcast_to(keep, tuple(x.shape)))
+    return _op("dropout", x, mask, p=float(p), mode=str(mode))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(rng.split_key(), 1.0 - float(p), tuple(x.shape))
+    mask = Tensor(keep)
+    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * alpha_p * p
+    return _op("alpha_dropout", x, mask, alpha_p=float(alpha_p), a=float(a), b=float(b))
+
+
+register_op("alpha_dropout", lambda x, mask, alpha_p=0.0, a=1.0, b=0.0:
+            a * jnp.where(mask, x, alpha_p) + b, nondiff_inputs=(1,))
+
+
+def _embedding_fwd(w, ids, padding_idx=-1, has_pad=False):
+    out = jnp.take(w, ids, axis=0)
+    if has_pad:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+register_op("embedding", _embedding_fwd, nondiff_inputs=(1,))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _op("embedding", weight, x,
+               padding_idx=-1 if padding_idx is None else int(padding_idx),
+               has_pad=padding_idx is not None)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    n_spatial = x.ndim - 2
+    if channel_last:
+        sp_shape = x.shape[1:-1]
+    else:
+        sp_shape = x.shape[2:]
+    if size is not None:
+        out_sizes = static_int_list(size)
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scales = [float(scale_factor)] * n_spatial
+        else:
+            scales = [float(s) for s in scale_factor]
+        out_sizes = tuple(int(s * f) for s, f in zip(sp_shape, scales))
+    return _op("interpolate", x, out_sizes=tuple(out_sizes), mode=str(mode),
+               align_corners=bool(align_corners), channel_last=channel_last)
+
+
+def _interpolate_fwd(x, out_sizes=(), mode="nearest", align_corners=False,
+                     channel_last=False):
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if channel_last:
+        shape = (x.shape[0],) + tuple(out_sizes) + (x.shape[-1],)
+    else:
+        shape = x.shape[:2] + tuple(out_sizes)
+    # jax.image.resize has no align_corners; it matches align_corners=False semantics
+    return jax.image.resize(x, shape, method=method)
+
+
+register_op("interpolate", _interpolate_fwd)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return _op("bilinear", *args, has_bias=bias is not None)
+
+
+def _bilinear_fwd(x1, x2, w, *rest, has_bias=False):
+    # w: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if has_bias:
+        out = out + rest[0]
+    return out
+
+
+register_op("bilinear", _bilinear_fwd)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return _op("cosine_similarity", x1, x2, axis=int(axis), eps=float(eps))
+
+
+def _cos_sim_fwd(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+register_op("cosine_similarity", _cos_sim_fwd)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _op("pixel_shuffle", x, r=int(upscale_factor),
+               channel_last=data_format == "NHWC")
+
+
+def _pixel_shuffle_fwd(x, r=1, channel_last=False):
+    if channel_last:
+        n, h, w, c = x.shape
+        out = x.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+register_op("pixel_shuffle", _pixel_shuffle_fwd)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _op("pixel_unshuffle", x, r=int(downscale_factor),
+               channel_last=data_format == "NHWC")
+
+
+def _pixel_unshuffle_fwd(x, r=1, channel_last=False):
+    if channel_last:
+        n, h, w, c = x.shape
+        out = x.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4)
+    return out.reshape(n, c * r * r, h // r, w // r)
+
+
+register_op("pixel_unshuffle", _pixel_unshuffle_fwd)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _op("channel_shuffle", x, groups=int(groups),
+               channel_last=data_format == "NHWC")
+
+
+def _channel_shuffle_fwd(x, groups=1, channel_last=False):
+    ax = x.ndim - 1 if channel_last else 1
+    c = x.shape[ax]
+    moved = jnp.moveaxis(x, ax, 1)
+    n = moved.shape[0]
+    rest = moved.shape[2:]
+    out = moved.reshape((n, groups, c // groups) + rest)
+    out = jnp.swapaxes(out, 1, 2).reshape((n, c) + rest)
+    return jnp.moveaxis(out, 1, ax)
+
+
+register_op("channel_shuffle", _channel_shuffle_fwd)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return _op("label_smooth_prior", label, prior_dist, epsilon=float(epsilon))
+    return _op("label_smooth", label, epsilon=float(epsilon))
+
+
+register_op("label_smooth", lambda label, epsilon=0.1:
+            (1 - epsilon) * label + epsilon / label.shape[-1])
+register_op("label_smooth_prior", lambda label, prior, epsilon=0.1:
+            (1 - epsilon) * label + epsilon * prior)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample lands with the PS/recsys stack")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = static_int_list(kernel_sizes)
+    k = k * 2 if len(k) == 1 else k
+    s = static_int_list(strides)
+    s = s * 2 if len(s) == 1 else s
+    p = static_int_list(paddings)
+    p = p * 2 if len(p) == 1 else p
+    d = static_int_list(dilations)
+    d = d * 2 if len(d) == 1 else d
+    return _op("unfold", x, k=tuple(k), s=tuple(s), p=tuple(p), d=tuple(d))
+
+
+def _unfold_fwd(x, k=(3, 3), s=(1, 1), p=(0, 0), d=(1, 1)):
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=((p[0], p[0]), (p[1], p[1])), rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, oh, ow] → [N, C*kh*kw, L]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+register_op("unfold", _unfold_fwd)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    out_hw = static_int_list(output_sizes)
+    k = static_int_list(kernel_sizes)
+    k = k * 2 if len(k) == 1 else k
+    s = static_int_list(strides)
+    s = s * 2 if len(s) == 1 else s
+    p = static_int_list(paddings)
+    p = p * 2 if len(p) == 1 else p
+    d = static_int_list(dilations)
+    d = d * 2 if len(d) == 1 else d
+    return _op("fold", x, out_hw=tuple(out_hw), k=tuple(k), s=tuple(s), p=tuple(p),
+               d=tuple(d))
+
+
+def _fold_fwd(x, out_hw=(1, 1), k=(3, 3), s=(1, 1), p=(0, 0), d=(1, 1)):
+    n, ckk, L = x.shape
+    c = ckk // (k[0] * k[1])
+    oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    cols = x.reshape(n, c, k[0], k[1], oh, ow)
+    out = jnp.zeros((n, c, out_hw[0] + 2 * p[0], out_hw[1] + 2 * p[1]), x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            hi = i * d[0]
+            wj = j * d[1]
+            out = out.at[:, :, hi:hi + oh * s[0]:s[0], wj:wj + ow * s[1]:s[1]].add(
+                cols[:, :, i, j])
+    return out[:, :, p[0]:out.shape[2] - p[0], p[1]:out.shape[3] - p[1]]
+
+
+register_op("fold", _fold_fwd)

@@ -1,0 +1,168 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py; kernels
+phi/kernels/gpu/conv_*). Weight layout is the reference's OIHW for state-dict parity;
+lax.conv_general_dilated handles the dimension numbers and XLA lays out for the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...ops._helpers import _op, static_int_list
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    t = static_int_list(v)
+    if len(t) == 1:
+        t = t * n
+    return tuple(t)
+
+
+def _norm_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    t = static_int_list(padding) if not isinstance(padding, int) else (padding,)
+    if len(t) == 1:
+        t = t * n
+    if len(t) == n:
+        return tuple((p, p) for p in t)
+    if len(t) == 2 * n:
+        return tuple((t[2 * i], t[2 * i + 1]) for i in range(n))
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_fwd(x, w, *rest, strides=(), padding="VALID", dilations=(), groups=1,
+              n_spatial=2, channel_last=False, has_bias=False):
+    spatial = "".join("DHW"[3 - n_spatial:][i] for i in range(n_spatial))
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+    )
+    if has_bias:
+        b = rest[0]
+        shape = [1] * out.ndim
+        shape[1 if not channel_last else -1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+register_op("conv", _conv_fwd)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n_spatial, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    strides = _norm_tuple(stride, n_spatial)
+    dilations = _norm_tuple(dilation, n_spatial)
+    pad = _norm_padding(padding, n_spatial)
+    args = [x, weight]
+    if bias is not None:
+        args.append(bias)
+    return _op("conv", *args, strides=strides, padding=pad, dilations=dilations,
+               groups=int(groups), n_spatial=n_spatial, channel_last=channel_last,
+               has_bias=bias is not None)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose_fwd(x, w, *rest, strides=(), padding="VALID", output_padding=(),
+                        dilations=(), groups=1, n_spatial=2, channel_last=False,
+                        has_bias=False):
+    spatial = "".join("DHW"[3 - n_spatial:][i] for i in range(n_spatial))
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    rhs_spec = "IO" + spatial  # paddle conv_transpose weight: [in, out/groups, *k]
+    if not isinstance(padding, str):
+        # paddle semantics: out = (in-1)*s - 2p + k  ⇒  lax padding = eff_k - 1 - p
+        ksp = w.shape[2:]
+        padding = tuple(
+            ((k - 1) * d + 1 - 1 - lo, (k - 1) * d + 1 - 1 - hi)
+            for k, d, (lo, hi) in zip(ksp, dilations, padding))
+    if groups != 1:
+        # grouped transpose conv: split and concat along channels
+        xs = jnp.split(x, groups, axis=1 if not channel_last else -1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [jax.lax.conv_transpose(
+            xi, wi, strides=strides, padding=padding, rhs_dilation=dilations,
+            dimension_numbers=(lhs_spec, rhs_spec, lhs_spec), transpose_kernel=True)
+            for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1 if not channel_last else -1)
+    else:
+        out = jax.lax.conv_transpose(
+            x, w, strides=strides, padding=padding, rhs_dilation=dilations,
+            dimension_numbers=(lhs_spec, rhs_spec, lhs_spec), transpose_kernel=True)
+    if any(p for p in output_padding):
+        pads = [(0, 0)] * out.ndim
+        for i, p in enumerate(output_padding):
+            d = (i + 2) if not channel_last else (i + 1)
+            pads[d] = (0, p)
+        out = jnp.pad(out, pads)
+    if has_bias:
+        b = rest[0]
+        shape = [1] * out.ndim
+        shape[1 if not channel_last else -1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+register_op("conv_transpose", _conv_transpose_fwd)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation,
+                       groups, n_spatial, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    strides = _norm_tuple(stride, n_spatial)
+    dilations = _norm_tuple(dilation, n_spatial)
+    pad = _norm_padding(padding, n_spatial)
+    out_pad = _norm_tuple(output_padding, n_spatial) if output_padding else (0,) * n_spatial
+    args = [x, weight]
+    if bias is not None:
+        args.append(bias)
+    return _op("conv_transpose", *args, strides=strides, padding=pad,
+               output_padding=out_pad, dilations=dilations, groups=int(groups),
+               n_spatial=n_spatial, channel_last=channel_last, has_bias=bias is not None)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format, output_size)

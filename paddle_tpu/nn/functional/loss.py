@@ -1,0 +1,370 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...ops._helpers import _op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "smooth_l1_loss",
+    "nll_loss", "kl_div", "margin_ranking_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "square_error_cost", "sigmoid_focal_loss",
+    "log_loss", "soft_margin_loss", "triplet_margin_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _ce_fwd(logits, label, soft_label=False, axis=-1, use_softmax=True,
+            ignore_index=-100, reduction="mean", has_weight=False, weight=None,
+            label_smoothing=0.0):
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+        valid = jnp.ones(loss.shape, jnp.float32)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis)
+        n_classes = logits.shape[axis]
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(lbl, n_classes, dtype=logp.dtype, axis=axis)
+            smooth = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(smooth * logp, axis=axis)
+        else:
+            lbl_safe = jnp.where(lbl == ignore_index, 0, lbl)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(lbl_safe, axis).astype(jnp.int32), axis=axis)
+            loss = jnp.squeeze(loss, axis)
+        valid = (lbl != ignore_index).astype(loss.dtype)
+        loss = loss * valid
+        if has_weight:
+            wgt = jnp.take(weight, jnp.where(lbl == ignore_index, 0, lbl).astype(jnp.int32))
+            loss = loss * wgt
+            valid = valid * wgt
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-9)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    if weight is not None:
+        return _op("cross_entropy_w", input, label, weight, soft_label=bool(soft_label),
+                   axis=int(axis), use_softmax=bool(use_softmax),
+                   ignore_index=int(ignore_index), reduction=str(reduction),
+                   label_smoothing=float(label_smoothing))
+    return _op("cross_entropy", input, label, soft_label=bool(soft_label),
+               axis=int(axis), use_softmax=bool(use_softmax),
+               ignore_index=int(ignore_index), reduction=str(reduction),
+               label_smoothing=float(label_smoothing))
+
+
+register_op("cross_entropy",
+            lambda logits, label, **kw: _ce_fwd(logits, label, has_weight=False, **kw),
+            nondiff_inputs=(1,))
+register_op("cross_entropy_w",
+            lambda logits, label, weight, **kw: _ce_fwd(logits, label, has_weight=True,
+                                                        weight=weight, **kw),
+            nondiff_inputs=(1,))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = _op("softmax_ce_noreduce", logits, label, soft_label=bool(soft_label),
+               axis=int(axis), ignore_index=int(ignore_index))
+    if return_softmax:
+        from .activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def _softmax_ce_noreduce(logits, label, soft_label=False, axis=-1, ignore_index=-100):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lbl = label
+    squeeze_back = False
+    if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis)
+        squeeze_back = True
+    lbl_safe = jnp.where(lbl == ignore_index, 0, lbl)
+    loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl_safe, axis).astype(jnp.int32),
+                                axis=axis)
+    mask = jnp.expand_dims(lbl != ignore_index, axis)
+    loss = jnp.where(mask, loss, 0.0)
+    return loss
+
+
+register_op("softmax_ce_noreduce", _softmax_ce_noreduce, nondiff_inputs=(1,))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _op("bce", *args, reduction=str(reduction), has_weight=weight is not None)
+
+
+def _bce_fwd(x, label, *rest, reduction="mean", has_weight=False):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(x, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - x, eps)))
+    if has_weight:
+        loss = loss * rest[0]
+    return _reduce(loss, reduction)
+
+
+register_op("bce", _bce_fwd, nondiff_inputs=(1,))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return _op("bce_logits", *args, reduction=str(reduction),
+               has_weight=weight is not None, has_pos_weight=pos_weight is not None)
+
+
+def _bce_logits_fwd(x, label, *rest, reduction="mean", has_weight=False,
+                    has_pos_weight=False):
+    i = 0
+    w = None
+    pw = None
+    if has_weight:
+        w = rest[i]; i += 1
+    if has_pos_weight:
+        pw = rest[i]
+    max_val = jnp.maximum(-x, 0.0)
+    if pw is not None:
+        log_w = (pw - 1) * label + 1
+        loss = (1 - label) * x + log_w * (jnp.log(
+            jnp.exp(-max_val) + jnp.exp(-x - max_val)) + max_val)
+    else:
+        loss = (1 - label) * x + max_val + jnp.log(
+            jnp.exp(-max_val) + jnp.exp(-x - max_val))
+    if w is not None:
+        loss = loss * w
+    return _reduce(loss, reduction)
+
+
+register_op("bce_logits", _bce_logits_fwd, nondiff_inputs=(1,))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _op("mse_loss", input, label, reduction=str(reduction))
+
+
+register_op("mse_loss", lambda x, y, reduction="mean":
+            _reduce(jnp.square(x - y), reduction))
+
+
+def square_error_cost(input, label):
+    return _op("mse_loss", input, label, reduction="none")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _op("l1_loss", input, label, reduction=str(reduction))
+
+
+register_op("l1_loss", lambda x, y, reduction="mean":
+            _reduce(jnp.abs(x - y), reduction))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _op("smooth_l1", input, label, reduction=str(reduction), delta=float(delta))
+
+
+def _smooth_l1_fwd(x, y, reduction="mean", delta=1.0):
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < delta, 0.5 * jnp.square(diff) / delta, diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+register_op("smooth_l1", _smooth_l1_fwd)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _op("nll_loss", *args, ignore_index=int(ignore_index),
+               reduction=str(reduction), has_weight=weight is not None)
+
+
+def _nll_fwd(logp, label, *rest, ignore_index=-100, reduction="mean", has_weight=False):
+    lbl_safe = jnp.where(label == ignore_index, 0, label).astype(jnp.int32)
+    loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl_safe, 1), axis=1).squeeze(1)
+    valid = (label != ignore_index).astype(loss.dtype)
+    loss = loss * valid
+    if has_weight:
+        wv = jnp.take(rest[0], lbl_safe)
+        loss = loss * wv
+        valid = valid * wv
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-9)
+    return _reduce(loss, reduction)
+
+
+register_op("nll_loss", _nll_fwd, nondiff_inputs=(1,))
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return _op("kl_div", input, label, reduction=str(reduction))
+
+
+def _kl_div_fwd(logp, target, reduction="mean"):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - logp)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / logp.shape[0]
+    return _reduce(loss, reduction)
+
+
+register_op("kl_div", _kl_div_fwd)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return _op("margin_ranking", input, other, label, margin=float(margin),
+               reduction=str(reduction))
+
+
+register_op("margin_ranking", lambda x, y, label, margin=0.0, reduction="mean":
+            _reduce(jnp.maximum(-label * (x - y) + margin, 0.0), reduction))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    return _op("cosine_embedding", input1, input2, label, margin=float(margin),
+               reduction=str(reduction))
+
+
+def _cos_emb_fwd(x1, x2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+register_op("cosine_embedding", _cos_emb_fwd, nondiff_inputs=(2,))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _op("hinge_embedding", input, label, margin=float(margin),
+               reduction=str(reduction))
+
+
+register_op("hinge_embedding", lambda x, label, margin=1.0, reduction="mean":
+            _reduce(jnp.where(label == 1, x, jnp.maximum(margin - x, 0.0)), reduction),
+            nondiff_inputs=(1,))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return _op("focal", *args, alpha=float(alpha), gamma=float(gamma),
+               reduction=str(reduction), has_norm=normalizer is not None)
+
+
+def _focal_fwd(x, label, *rest, alpha=0.25, gamma=2.0, reduction="sum",
+               has_norm=False):
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if has_norm:
+        loss = loss / rest[0]
+    return _reduce(loss, reduction)
+
+
+register_op("focal", _focal_fwd, nondiff_inputs=(1,))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _op("log_loss", input, label, epsilon=float(epsilon))
+
+
+register_op("log_loss", lambda p, y, epsilon=1e-4:
+            -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _op("soft_margin", input, label, reduction=str(reduction))
+
+
+register_op("soft_margin", lambda x, y, reduction="mean":
+            _reduce(jnp.log1p(jnp.exp(-y * x)), reduction))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    return _op("triplet", input, positive, negative, margin=float(margin), p=float(p),
+               epsilon=float(epsilon), swap=bool(swap), reduction=str(reduction))
+
+
+def _triplet_fwd(a, pos, neg, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean"):
+    def dist(u, v):
+        return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+    d_pos = dist(a, pos)
+    d_neg = dist(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    return _reduce(jnp.maximum(d_pos - d_neg + margin, 0.0), reduction)
+
+
+register_op("triplet", _triplet_fwd)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _op("ml_soft_margin", *args, reduction=str(reduction),
+               has_weight=weight is not None)
+
+
+def _ml_soft_margin_fwd(x, y, *rest, reduction="mean", has_weight=False):
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    if has_weight:
+        loss = loss * rest[0]
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+register_op("ml_soft_margin", _ml_soft_margin_fwd, nondiff_inputs=(1,))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return _op("poisson_nll", input, label, log_input=bool(log_input),
+               full=bool(full), epsilon=float(epsilon), reduction=str(reduction))
+
+
+def _poisson_nll_fwd(x, y, log_input=True, full=False, epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(x) - y * x
+    else:
+        loss = x - y * jnp.log(x + epsilon)
+    if full:
+        stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+        loss = loss + jnp.where(y > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+register_op("poisson_nll", _poisson_nll_fwd)

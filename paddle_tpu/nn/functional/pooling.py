@@ -1,0 +1,177 @@
+"""Pooling (reference: python/paddle/nn/functional/pooling.py; phi pool kernels).
+All pooling lowers to lax.reduce_window, which XLA fuses well on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import register_op
+from ...ops._helpers import _op, static_int_list
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _norm(v, n):
+    t = static_int_list(v)
+    return tuple(t * n if len(t) == 1 else t)
+
+
+def _pool_fwd(x, kernel=(), strides=(), padding=(), mode="max", channel_last=False,
+              ceil_mode=False, exclusive=True):
+    n_spatial = len(kernel)
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        ws = (1,) + strides + (1,)
+        pads = ((0, 0),) + padding + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        ws = (1, 1) + strides
+        pads = ((0, 0), (0, 0)) + padding
+    if mode == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, ws, pads)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, ws, pads)
+    if exclusive and any(p != (0, 0) for p in pads):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, ws, pads)
+        return summed / counts
+    denom = 1
+    for k in kernel:
+        denom *= k
+    return summed / denom
+
+
+register_op("pool", _pool_fwd)
+
+
+def _pool(x, kernel_size, stride, padding, n_spatial, mode, data_format,
+          ceil_mode=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    kernel = _norm(kernel_size, n_spatial)
+    strides = _norm(stride, n_spatial) if stride is not None else kernel
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for pools")
+    pad = _norm(padding, n_spatial)
+    pads = tuple((p, p) for p in pad)
+    if ceil_mode:
+        # extend high padding so ceil-division windows fit (matches reference ceil_mode)
+        shape = x.shape
+        sp_dims = range(1, 1 + n_spatial) if channel_last else range(2, 2 + n_spatial)
+        new_pads = []
+        for i, d in enumerate(sp_dims):
+            size = shape[d] + 2 * pad[i]
+            rem = (size - kernel[i]) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem else 0
+            new_pads.append((pad[i], pad[i] + extra))
+        pads = tuple(new_pads)
+    return _op("pool", x, kernel=kernel, strides=strides, padding=pads, mode=mode,
+               channel_last=channel_last, exclusive=bool(exclusive))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", data_format, ceil_mode,
+                 exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode,
+                 exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode,
+                 exclusive)
+
+
+def _adaptive_pool_fwd(x, out_sizes=(), mode="avg", channel_last=False):
+    n_spatial = len(out_sizes)
+    sp_dims = list(range(1, 1 + n_spatial)) if channel_last else \
+        list(range(x.ndim - n_spatial, x.ndim))
+    out = x
+    for dim, osize in zip(sp_dims, out_sizes):
+        in_size = out.shape[dim]
+        if in_size % osize == 0:
+            k = in_size // osize
+            moved = jnp.moveaxis(out, dim, -1)
+            new_shape = moved.shape[:-1] + (osize, k)
+            r = moved.reshape(new_shape)
+            red = jnp.mean(r, axis=-1) if mode == "avg" else jnp.max(r, axis=-1)
+            out = jnp.moveaxis(red, -1, dim)
+        else:
+            # general adaptive: per-output-window gather (start/end like reference)
+            starts = np.floor(np.arange(osize) * in_size / osize).astype(int)
+            ends = np.ceil((np.arange(osize) + 1) * in_size / osize).astype(int)
+            moved = jnp.moveaxis(out, dim, 0)
+            pieces = []
+            for s, e in zip(starts, ends):
+                seg = moved[s:e]
+                pieces.append(jnp.mean(seg, axis=0) if mode == "avg"
+                              else jnp.max(seg, axis=0))
+            out = jnp.moveaxis(jnp.stack(pieces, axis=0), 0, dim)
+    return out
+
+
+register_op("adaptive_pool", _adaptive_pool_fwd)
+
+
+def _adaptive(x, output_size, n_spatial, mode, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    out_sizes = static_int_list(output_size)
+    if len(out_sizes) == 1:
+        out_sizes = out_sizes * n_spatial
+    # resolve None entries to input size
+    sp_dims = list(range(1, 1 + n_spatial)) if channel_last else \
+        list(range(x.ndim - n_spatial, x.ndim))
+    resolved = []
+    for d, s in zip(sp_dims, out_sizes):
+        resolved.append(x.shape[d] if s is None or s < 0 else s)
+    return _op("adaptive_pool", x, out_sizes=tuple(resolved), mode=mode,
+               channel_last=channel_last)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,5 @@
+from .api import (  # noqa: F401
+    Initializer, Constant, Uniform, Normal, TruncatedNormal, XavierNormal,
+    XavierUniform, KaimingNormal, KaimingUniform, Assign, Orthogonal,
+    calculate_gain, set_global_initializer,
+)

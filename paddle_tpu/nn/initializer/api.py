@@ -1,0 +1,176 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as rng
+from ...core.dtype import convert_dtype
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(rng.split_key(), shape, dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return jax.random.normal(rng.split_key(), shape, dtype) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        z = jax.random.truncated_normal(rng.split_key(), -2.0, 2.0, shape, dtype)
+        return z * self.std + self.mean
+
+
+def calculate_fan(shape):
+    """fan_in, fan_out. Conventions match the reference for checkpoint parity:
+    linear weights are [in, out]; conv kernels are OIHW (out, in/groups, *spatial)."""
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) <= 1:
+        return (shape[0], shape[0]) if shape else (1, 1)
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = calculate_fan(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(rng.split_key(), shape, dtype, -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = calculate_fan(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(rng.split_key(), shape, dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = calculate_fan(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(rng.split_key(), shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = calculate_fan(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return jax.random.normal(rng.split_key(), shape, dtype) * std
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = np.asarray(self.value)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return jnp.asarray(arr.astype(np.dtype(dtype)))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        return jax.nn.initializers.orthogonal(scale=self.gain)(
+            rng.split_key(), shape, dtype)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _resolve_initializer(attr, is_bias, default_initializer):
+    """ParamAttr/bool/str → initializer callable."""
+    init = None
+    if attr is not None and not isinstance(attr, (bool, str)):
+        init = getattr(attr, "initializer", None) or getattr(attr, "_initializer", None)
+        if callable(attr) and not isinstance(attr, Initializer) and init is None:
+            init = attr if isinstance(attr, Initializer) else None
+    if isinstance(attr, Initializer):
+        init = attr
+    if init is None:
+        init = default_initializer
+    if init is None:
+        if is_bias:
+            init = _global_bias_init or Constant(0.0)
+        else:
+            init = _global_weight_init or XavierUniform()
+    return init

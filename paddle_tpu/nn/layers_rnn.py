@@ -1,0 +1,310 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-idiomatic: the whole sequence loop is one registered op whose forward is a
+`lax.scan`, so XLA compiles a single fused while-loop and the generic vjp gives BPTT.
+Gate order matches the reference (i, f, g, o for LSTM; r, z, n for GRU mirroring
+paddle/torch layout) so state dicts transfer.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..ops._helpers import _op
+from .initializer import Uniform
+from .layer import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    gates = x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        xr, xz, xn = jnp.split(x_t @ w_ih.T + b_ih, 3, axis=-1)
+        hr, hz, hn = jnp.split(h @ w_hh.T + b_hh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, c
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, c
+
+
+def _rnn_fwd(x, init_h, init_c, *weights, mode="LSTM", num_layers=1,
+             bidirectional=False, time_major=False, activation="tanh",
+             dropout=0.0):
+    """x: [B,T,D] (or [T,B,D] if time_major). weights: per (layer, direction):
+    w_ih, w_hh, b_ih, b_hh. init_h/init_c: [num_layers*D, B, H]."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T,B,D]
+    n_dir = 2 if bidirectional else 1
+    out = x
+    final_h = []
+    final_c = []
+    widx = 0
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(n_dir):
+            w_ih, w_hh, b_ih, b_hh = weights[widx:widx + 4]
+            widx += 4
+            state_idx = layer * n_dir + d
+            h0 = init_h[state_idx]
+            c0 = init_c[state_idx]
+            seq = out if d == 0 else jnp.flip(out, axis=0)
+
+            def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                h, c = carry
+                h2, c2 = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh,
+                                    activation)
+                return (h2, c2), h2
+
+            (hT, cT), ys = jax.lax.scan(step, (h0, c0), seq)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            dir_outs.append(ys)
+            final_h.append(hT)
+            final_c.append(cT)
+        out = dir_outs[0] if n_dir == 1 else jnp.concatenate(dir_outs, axis=-1)
+    fh = jnp.stack(final_h, axis=0)
+    fc = jnp.stack(final_c, axis=0)
+    if not time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    return out, fh, fc
+
+
+register_op("rnn", _rnn_fwd)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        from ..ops import full
+        b = batch_ref.shape[batch_dim_idx]
+        hidden = self.hidden_size
+        return full([b, hidden], init_value, dtype or "float32")
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+
+class _CellCommon(RNNCellBase):
+    def __init__(self, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [n_gates * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [n_gates * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [n_gates * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [n_gates * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+
+def _cell_op_fwd(x, h, c, w_ih, w_hh, b_ih, b_hh, mode="LSTM", activation="tanh"):
+    h2, c2 = _cell_step(mode, x, h, c, w_ih, w_hh, b_ih, b_hh, activation)
+    return h2, c2
+
+
+register_op("rnn_cell", _cell_op_fwd)
+
+
+class SimpleRNNCell(_CellCommon):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, _ = _op("rnn_cell", inputs, states, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, mode="RNN", activation=self.activation)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(_CellCommon):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h0 = self.get_initial_states(inputs)
+            c0 = self.get_initial_states(inputs)
+        else:
+            h0, c0 = states
+        h, c = _op("rnn_cell", inputs, h0, c0, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, mode="LSTM")
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_CellCommon):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, _ = _op("rnn_cell", inputs, states, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, mode="GRU")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence loop (python loop in eager; prefer the fused
+    SimpleRNN/LSTM/GRU layers which compile to one lax.scan)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import stack
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        states = initial_states
+        outs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            x_t = inputs[:, t] if not self.time_major else inputs[t]
+            y, states = self.cell(x_t, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = stack(outs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import concat
+        s_fw, s_bw = (None, None) if initial_states is None else initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        n_dir = 2 if self.bidirectional else 1
+        self.n_dir = n_dir
+        n_gates = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._flat_weights = []
+        for layer in range(num_layers):
+            for d in range(n_dir):
+                in_sz = input_size if layer == 0 else hidden_size * n_dir
+                suffix = f"_reverse" if d == 1 else ""
+                w_ih = self.create_parameter([n_gates * hidden_size, in_sz],
+                                             weight_ih_attr, default_initializer=init)
+                w_hh = self.create_parameter([n_gates * hidden_size, hidden_size],
+                                             weight_hh_attr, default_initializer=init)
+                b_ih = self.create_parameter([n_gates * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+                b_hh = self.create_parameter([n_gates * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", b_hh)
+                self._flat_weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import zeros
+        batch_axis = 1 if self.time_major else 0
+        b = inputs.shape[batch_axis]
+        n_states = self.num_layers * self.n_dir
+        if initial_states is None:
+            h0 = zeros([n_states, b, self.hidden_size], inputs.dtype)
+            c0 = zeros([n_states, b, self.hidden_size], inputs.dtype)
+        elif self.mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0 = initial_states
+            c0 = zeros([n_states, b, self.hidden_size], inputs.dtype)
+        out, fh, fc = _op("rnn", inputs, h0, c0, *self._flat_weights,
+                          mode=self.mode, num_layers=self.num_layers,
+                          bidirectional=self.bidirectional,
+                          time_major=self.time_major, activation=self.activation,
+                          dropout=float(self.dropout))
+        if self.mode == "LSTM":
+            return out, (fh, fc)
+        return out, fh
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)

@@ -1,0 +1,259 @@
+"""Op corpus + Tensor method monkey-patching.
+
+Reference analog: `python/paddle/tensor/__init__.py`'s monkey_patch of math methods onto
+the Tensor type (the reference generates these from YAML; here they're direct bindings to
+the dispatchable ops).
+"""
+from __future__ import annotations
+
+from builtins import any as _any, all as _all, slice as _builtin_slice
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, register_op
+from ..core.tensor import Tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from . import linalg  # namespace: paddle_tpu.linalg
+from . import creation as _creation
+from . import math as _math
+from . import manipulation as _manip
+from . import logic as _logic
+
+from .math import (add, subtract, multiply, divide, matmul, pow as _pow,
+                   remainder, floor_divide, neg, abs as _abs)
+from .logic import (equal, not_equal, less_than, less_equal, greater_than,
+                    greater_equal)
+from .manipulation import cast as _cast
+
+
+def _op(name, *tensors, **attrs):
+    return apply_op(name, tensors, attrs)
+
+
+# ---------------------------------------------------------------- indexing
+
+
+def _split_index(index):
+    """Split a python index expression into a static spec + dynamic tensor operands."""
+    if not isinstance(index, tuple):
+        index = (index,)
+    spec = []
+    tensor_args = []
+    for item in index:
+        if isinstance(item, Tensor):
+            if item.dtype == jnp.bool_:
+                # boolean mask → dynamic shape: handled by caller eagerly
+                spec.append(("mask", len(tensor_args)))
+            else:
+                spec.append(("tensor", len(tensor_args)))
+            tensor_args.append(item)
+        elif isinstance(item, np.ndarray):
+            t = Tensor(item)
+            spec.append(("tensor", len(tensor_args)))
+            tensor_args.append(t)
+        elif isinstance(item, _builtin_slice):
+            spec.append(("slice", (item.start, item.stop, item.step)))
+        elif item is None:
+            spec.append(("newaxis", None))
+        elif item is Ellipsis:
+            spec.append(("ellipsis", None))
+        elif isinstance(item, (list,)):
+            arr = np.asarray(item)
+            if arr.dtype == np.bool_:
+                t = Tensor(arr)
+                spec.append(("mask", len(tensor_args)))
+                tensor_args.append(t)
+            else:
+                t = Tensor(arr.astype(np.int32))
+                spec.append(("tensor", len(tensor_args)))
+                tensor_args.append(t)
+        else:
+            spec.append(("int", int(item)))
+    return tuple(spec), tensor_args
+
+
+def _materialize_index(spec, arrays):
+    idx = []
+    for kind, payload in spec:
+        if kind == "tensor" or kind == "mask":
+            idx.append(arrays[payload])
+        elif kind == "slice":
+            idx.append(_builtin_slice(*payload))
+        elif kind == "newaxis":
+            idx.append(None)
+        elif kind == "ellipsis":
+            idx.append(Ellipsis)
+        else:
+            idx.append(payload)
+    return tuple(idx)
+
+
+def _getitem_fwd(x, *index_arrays, spec=()):
+    return x[_materialize_index(spec, index_arrays)]
+
+
+register_op("getitem", _getitem_fwd)
+
+
+def _tensor_getitem(self, index):
+    spec, tensor_args = _split_index(index)
+    if _any(k == "mask" for k, _ in spec):
+        # dynamic-shape boolean indexing: eager numpy materialization
+        np_idx = _materialize_index(spec, [np.asarray(t.numpy()) for t in tensor_args])
+        return Tensor(self.numpy()[np_idx])
+    return _op("getitem", self, *tensor_args, spec=spec)
+
+
+def _setitem_fwd(x, *args, spec=(), n_idx=0):
+    index_arrays = args[:n_idx]
+    value = args[n_idx]
+    idx = _materialize_index(spec, index_arrays)
+    return x.at[idx].set(value.astype(x.dtype) if hasattr(value, "astype") else value)
+
+
+register_op("setitem", _setitem_fwd)
+
+
+def _tensor_setitem(self, index, value):
+    spec, tensor_args = _split_index(index)
+    if not isinstance(value, Tensor):
+        value = Tensor(np.asarray(value), dtype=self.dtype)
+    if _any(k == "mask" for k, _ in spec):
+        np_idx = _materialize_index(spec, [np.asarray(t.numpy()) for t in tensor_args])
+        arr = np.asarray(self.numpy())
+        arr[np_idx] = np.asarray(value.numpy())
+        new = Tensor(arr, dtype=self.dtype)
+        self._data = new.value()
+        self._version += 1
+        return
+    out = _op("setitem", self, *tensor_args, value, spec=spec, n_idx=len(tensor_args))
+    # in-place semantics with autograd rewiring (reference: inplace ops bump version)
+    self._data = out.value()
+    self._grad_node = out._grad_node
+    self._out_index = out._out_index
+    self._version += 1
+
+
+# ---------------------------------------------------------------- dunders & methods
+
+
+def _install_tensor_methods():
+    T = Tensor
+    T.__getitem__ = _tensor_getitem
+    T.__setitem__ = _tensor_setitem
+    T.__add__ = lambda s, o: add(s, o)
+    T.__radd__ = lambda s, o: add(s, o)
+    T.__sub__ = lambda s, o: subtract(s, o)
+    T.__rsub__ = lambda s, o: subtract(_ensure(o, s), s)
+    T.__mul__ = lambda s, o: multiply(s, o)
+    T.__rmul__ = lambda s, o: multiply(s, o)
+    T.__truediv__ = lambda s, o: divide(s, o)
+    T.__rtruediv__ = lambda s, o: divide(_ensure(o, s), s)
+    T.__floordiv__ = lambda s, o: floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: floor_divide(_ensure(o, s), s)
+    T.__mod__ = lambda s, o: remainder(s, o)
+    T.__rmod__ = lambda s, o: remainder(_ensure(o, s), s)
+    T.__pow__ = lambda s, o: _pow(s, o)
+    T.__rpow__ = lambda s, o: _pow(_ensure(o, s), s)
+    T.__matmul__ = lambda s, o: matmul(s, o)
+    T.__rmatmul__ = lambda s, o: matmul(_ensure(o, s), s)
+    T.__neg__ = lambda s: neg(s)
+    T.__abs__ = lambda s: _abs(s)
+    T.__invert__ = lambda s: _logic.logical_not(s)
+    T.__eq__ = lambda s, o: equal(s, o)
+    T.__ne__ = lambda s, o: not_equal(s, o)
+    T.__lt__ = lambda s, o: less_than(s, o)
+    T.__le__ = lambda s, o: less_equal(s, o)
+    T.__gt__ = lambda s, o: greater_than(s, o)
+    T.__ge__ = lambda s, o: greater_equal(s, o)
+    T.__hash__ = lambda s: id(s)
+    T.__and__ = lambda s, o: _logic.logical_and(s, o) if s.dtype == jnp.bool_ else _math.bitwise_and(s, o)
+    T.__or__ = lambda s, o: _logic.logical_or(s, o) if s.dtype == jnp.bool_ else _math.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: _logic.logical_xor(s, o) if s.dtype == jnp.bool_ else _math.bitwise_xor(s, o)
+
+    # named methods (subset of the ~300 the reference patches; grown over time)
+    method_table = {}
+    for mod in (_math, _manip, _logic, _creation, linalg):
+        for nm in getattr(mod, "__all__", []):
+            fn = getattr(mod, nm, None)
+            if callable(fn):
+                method_table.setdefault(nm, fn)
+    skip = {"to_tensor", "is_tensor", "meshgrid", "zeros", "ones", "full", "empty",
+            "arange", "linspace", "logspace", "eye", "rand", "randn", "randint",
+            "uniform", "normal", "randperm", "one_hot", "einsum", "multi_dot",
+            "broadcast_tensors"}
+    for nm, fn in method_table.items():
+        if nm in skip or hasattr(T, nm):
+            continue
+        setattr(T, nm, fn)
+
+    T.astype = lambda s, dtype: _cast(s, dtype)
+    T.cast = lambda s, dtype: _cast(s, dtype)
+    T.mm = lambda s, o: matmul(s, o)
+    T.dot = _math.dot
+    T.add_ = _make_inplace(add)
+    T.subtract_ = _make_inplace(subtract)
+    T.multiply_ = _make_inplace(multiply)
+    T.divide_ = _make_inplace(divide)
+    T.scale_ = _make_inplace(_math.scale)
+    T.clip_ = _make_inplace(_math.clip)
+    T.exp_ = _make_inplace(_math.exp)
+    T.sqrt_ = _make_inplace(_math.sqrt)
+    T.rsqrt_ = _make_inplace(_math.rsqrt)
+    T.floor_ = _make_inplace(_math.floor)
+    T.ceil_ = _make_inplace(_math.ceil)
+    T.round_ = _make_inplace(_math.round)
+    T.reciprocal_ = _make_inplace(_math.reciprocal)
+    T.fill_ = _fill_
+    T.zero_ = lambda s: _fill_(s, 0)
+    T.uniform_ = _uniform_
+    T.normal_ = _normal_
+
+
+def _ensure(o, like):
+    if isinstance(o, Tensor):
+        return o
+    return Tensor(jnp.asarray(o))
+
+
+def _make_inplace(fn):
+    def inplace(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._data = out.value()
+        self._grad_node = out._grad_node
+        self._out_index = out._out_index
+        self._version += 1
+        return self
+    return inplace
+
+
+def _fill_(self, value):
+    self._data = jnp.full(tuple(self.shape), value, self.dtype)
+    self._version += 1
+    return self
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):
+    from ..core import random as rng
+    import jax
+    self._data = jax.random.uniform(rng.split_key(), tuple(self.shape), self.dtype,
+                                    minval=float(min), maxval=float(max))
+    self._version += 1
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0):
+    from ..core import random as rng
+    import jax
+    self._data = (jax.random.normal(rng.split_key(), tuple(self.shape), self.dtype)
+                  * std + mean)
+    self._version += 1
+    return self
+
+
+_install_tensor_methods()

@@ -1,0 +1,61 @@
+"""Shared plumbing for op definitions.
+
+Reference analog: the YAML op schema + generated API layer (phi/api/yaml/ops.yaml,
+phi/api/yaml/generator/api_base.py:1187). Instead of YAML→C++ codegen, each op here is a
+jax-traceable forward registered with core.dispatch; factories below stamp out the
+elementwise families the way the reference stamps kernels from macros.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, register_op
+from ..core.tensor import Tensor
+
+
+def _op(name, *tensors, **attrs):
+    return apply_op(name, tensors, attrs)
+
+
+def make_unary(name, fn):
+    register_op(name, fn)
+
+    def wrapper(x, name=None):
+        return _op(name_, x)
+
+    name_ = name
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = f"Elementwise {name} (TPU-native; lowers to XLA)."
+    return wrapper
+
+
+def make_binary(name, fn):
+    register_op(name, fn)
+
+    def wrapper(x, y, name=None):
+        return _op(name_, x, y)
+
+    name_ = name
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = f"Elementwise {name} with numpy broadcasting."
+    return wrapper
+
+
+def as_tuple_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(v) for v in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def static_int_list(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in v.numpy().reshape(-1))
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x.item()) if isinstance(x, Tensor) else int(x) for x in v)
+    return (int(v),)

@@ -1,0 +1,229 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py + random.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as rng
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, to_tensor  # re-export to_tensor
+from ._helpers import static_int_list
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "rand",
+    "randn", "randint", "randint_like", "uniform", "normal", "standard_normal",
+    "randperm", "bernoulli", "multinomial", "tril", "triu", "diag", "diagflat",
+    "meshgrid", "assign", "clone", "numel", "poisson",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
+    if isinstance(shape, (list, tuple)):
+        return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return (int(shape),)
+
+
+def _dt(dtype, default=jnp.float32):
+    d = convert_dtype(dtype)
+    return d if d is not None else default
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        arr = jnp.full(_shape(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(jnp.float32)
+        return Tensor(arr)
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(x.shape), _dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones(tuple(x.shape), _dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full(tuple(x.shape), fill_value, _dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = jnp.int32 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) else jnp.float32
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+# ------------------------------------------------------------------ random
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rng.split_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rng.split_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.value() if isinstance(mean, Tensor) else mean
+        s = std.value() if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(rng.split_key(), out_shape) * s + m)
+    return Tensor(jax.random.normal(rng.split_key(), _shape(shape)) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rng.split_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rng.split_key(), _shape(shape), int(low), int(high),
+                                     _dt(dtype, jnp.int32)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rng.split_key(), tuple(x.shape), int(low), int(high),
+                                     _dt(dtype, x.dtype)))
+
+
+def randperm(n, dtype=None, name=None):
+    return Tensor(jax.random.permutation(rng.split_key(), int(n)).astype(
+        _dt(dtype, jnp.int32)))
+
+
+def bernoulli(x, name=None):
+    p = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(rng.split_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(rng.split_key(), logits, axis=-1,
+                                     shape=(*p.shape[:-1], int(num_samples)))
+    else:
+        key = rng.split_key()
+        z = jax.random.gumbel(key, p.shape)
+        _, out = jax.lax.top_k(logits + z, int(num_samples))
+    return Tensor(out.astype(jnp.int32))
+
+
+def poisson(x, name=None):
+    lam = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(rng.split_key(), lam).astype(lam.dtype))
+
+
+# ------------------------------------------------------------------ structured
+
+def tril(x, diagonal=0, name=None):
+    from ._helpers import _op
+    return _op("tril", x, diagonal=int(diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    from ._helpers import _op
+    return _op("triu", x, diagonal=int(diagonal))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    from ._helpers import _op
+    return _op("diag", x, offset=int(offset), padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    from ._helpers import _op
+    return _op("diagflat", x, offset=int(offset))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [a.value() if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    data = x.value() if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if data.dtype == jnp.float64:
+        data = data.astype(jnp.float32)
+    if output is not None:
+        output.set_value(data)
+        return output
+    return Tensor(data)
+
+
+def clone(x, name=None):
+    from ._helpers import _op
+    return _op("clone", x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int32))
+
+
+# register the dispatchable structured ops
+from ..core.dispatch import register_op as _reg
+
+_reg("tril", lambda x, diagonal=0: jnp.tril(x, diagonal))
+_reg("triu", lambda x, diagonal=0: jnp.triu(x, diagonal))
+_reg("diag", lambda x, offset=0, padding_value=0:
+     jnp.diag(x, offset) if x.ndim == 1 else jnp.diagonal(x, offset, -2, -1))
+_reg("diagflat", lambda x, offset=0: jnp.diagflat(x, offset))
+_reg("clone", lambda x: x + jnp.zeros((), x.dtype))

@@ -1,0 +1,306 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py, paddle.linalg namespace)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ._helpers import _op, as_tuple_axis
+
+__all__ = [
+    "norm", "vector_norm", "matrix_norm", "dist", "cond", "matrix_rank",
+    "cholesky", "qr", "svd", "svdvals", "eig", "eigh", "eigvals", "eigvalsh",
+    "inv", "pinv", "solve", "triangular_solve", "cholesky_solve", "lstsq", "lu",
+    "det", "slogdet", "matrix_power", "mv", "bmm", "bincount", "histogram",
+    "cross", "cov", "corrcoef", "einsum", "multi_dot", "householder_product",
+    "matrix_exp", "pca_lowrank",
+]
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) and len(axis) == 2 else 2.0
+    if isinstance(p, str):
+        return _op("norm_fro", x, axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+    return _op("norm_p", x, p=float(p), axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+
+
+def _norm_fro(x, axis=None, keepdim=False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def _norm_p(x, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+register_op("norm_fro", _norm_fro)
+register_op("norm_p", _norm_p)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return _op("norm_p", x, p=float(p), axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    if p == "fro":
+        return _op("norm_fro", x, axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+    return _op("matrix_norm_ord", x, p=p if isinstance(p, str) else float(p),
+               axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+
+
+register_op("matrix_norm_ord", lambda x, p=2, axis=(-2, -1), keepdim=False:
+            jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim))
+
+
+def dist(x, y, p=2, name=None):
+    return _op("dist", x, y, p=float(p))
+
+
+register_op("dist", lambda x, y, p=2.0: _norm_p(x - y, p=p))
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(x.value(), p=p))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x.value(), tol=tol))
+
+
+def cholesky(x, upper=False, name=None):
+    return _op("cholesky", x, upper=bool(upper))
+
+
+register_op("cholesky", lambda x, upper=False:
+            jnp.linalg.cholesky(x) if not upper
+            else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2).conj())
+
+
+def qr(x, mode="reduced", name=None):
+    outs = _op("qr", x, mode=str(mode))
+    return outs if isinstance(outs, tuple) else outs
+
+
+def _qr_fwd(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode if mode != "r" else "reduced")
+    if mode == "r":
+        return r
+    return q, r
+
+
+register_op("qr", _qr_fwd)
+
+
+def svd(x, full_matrices=False, name=None):
+    return _op("svd", x, full_matrices=bool(full_matrices))
+
+
+register_op("svd", lambda x, full_matrices=False:
+            tuple(jnp.linalg.svd(x, full_matrices=full_matrices)))
+
+
+def svdvals(x, name=None):
+    return _op("svdvals", x)
+
+
+register_op("svdvals", lambda x: jnp.linalg.svd(x, compute_uv=False))
+
+
+def eig(x, name=None):
+    # CPU-only in jax; run on host like reference's CPU fallback for LAPACK ops
+    import numpy as np
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(np.linalg.eigvals(x.numpy()))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = _op("eigh", x, UPLO=str(UPLO))
+    return outs
+
+
+register_op("eigh", lambda x, UPLO="L": tuple(jnp.linalg.eigh(x, UPLO=UPLO)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _op("eigvalsh", x, UPLO=str(UPLO))
+
+
+register_op("eigvalsh", lambda x, UPLO="L": jnp.linalg.eigvalsh(x, UPLO=UPLO))
+
+
+def inv(x, name=None):
+    return _op("inv", x)
+
+
+register_op("inv", jnp.linalg.inv)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _op("pinv", x, rcond=float(rcond), hermitian=bool(hermitian))
+
+
+register_op("pinv", lambda x, rcond=1e-15, hermitian=False:
+            jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian))
+
+
+def solve(x, y, name=None):
+    return _op("solve", x, y)
+
+
+register_op("solve", jnp.linalg.solve)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return _op("triangular_solve", x, y, upper=bool(upper), transpose=bool(transpose),
+               unitriangular=bool(unitriangular))
+
+
+register_op("triangular_solve", lambda x, y, upper=True, transpose=False, unitriangular=False:
+            jax.scipy.linalg.solve_triangular(x, y, lower=not upper, trans=1 if transpose else 0,
+                                              unit_diagonal=unitriangular))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _op("cholesky_solve", x, y, upper=bool(upper))
+
+
+register_op("cholesky_solve", lambda x, y, upper=False:
+            jax.scipy.linalg.cho_solve((y, not upper), x))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x.value(), y.value(), rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x.value())
+    outs = [Tensor(lu_mat), Tensor((piv + 1).astype(jnp.int32))]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), jnp.int32)))
+    return tuple(outs)
+
+
+def det(x, name=None):
+    return _op("det", x)
+
+
+register_op("det", jnp.linalg.det)
+
+
+def slogdet(x, name=None):
+    return _op("slogdet", x)
+
+
+register_op("slogdet", lambda x: tuple(jnp.linalg.slogdet(x)))
+
+
+def matrix_power(x, n, name=None):
+    return _op("matrix_power", x, n=int(n))
+
+
+register_op("matrix_power", lambda x, n=1: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_exp(x, name=None):
+    return _op("matrix_exp", x)
+
+
+register_op("matrix_exp", jax.scipy.linalg.expm)
+
+
+def mv(x, vec, name=None):
+    return _op("mv", x, vec)
+
+
+register_op("mv", jnp.matmul)
+
+
+def bmm(x, y, name=None):
+    return _op("bmm", x, y)
+
+
+register_op("bmm", jnp.matmul)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+    w = weights.numpy() if weights is not None else None
+    return Tensor(np.bincount(x.numpy(), weights=w, minlength=int(minlength)))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    import numpy as np
+    rng_arg = None if (min == 0 and max == 0) else (float(min), float(max))
+    hist, _ = np.histogram(input.numpy(), bins=int(bins), range=rng_arg)
+    return Tensor(hist.astype(np.int32))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis
+    if ax == 9:
+        shape = x.shape
+        ax = next((i for i, s in enumerate(shape) if s == 3), -1)
+    return _op("cross", x, y, axis=int(ax))
+
+
+register_op("cross", lambda x, y, axis=-1: jnp.cross(x, y, axis=axis))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    args = [x]
+    if fweights is not None:
+        args.append(fweights)
+    if aweights is not None:
+        args.append(aweights)
+    return Tensor(jnp.cov(x.value(), rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=None if fweights is None else fweights.value(),
+                          aweights=None if aweights is None else aweights.value()))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(x.value(), rowvar=rowvar))
+
+
+def einsum(equation, *operands, name=None):
+    ops_ = list(operands)
+    if len(ops_) == 1 and isinstance(ops_[0], (list, tuple)):
+        ops_ = list(ops_[0])
+    return _op("einsum", *ops_, equation=str(equation))
+
+
+register_op("einsum", lambda *xs, equation="": jnp.einsum(equation, *xs))
+
+
+def multi_dot(x, name=None):
+    return _op("multi_dot", *list(x))
+
+
+register_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)))
+
+
+def householder_product(x, tau, name=None):
+    # A = H_1 H_2 ... H_k, H_i = I - tau_i v_i v_i^T (jax: geqrf companion)
+    return Tensor(jax.lax.linalg.householder_product(x.value(), tau.value()))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = x.value()
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s[..., :q]), Tensor(jnp.swapaxes(vt, -1, -2)[..., :q])

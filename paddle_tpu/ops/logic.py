@@ -1,0 +1,60 @@
+"""Comparison/logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import _op, make_binary, make_unary
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "allclose", "isclose", "equal_all", "is_empty", "is_tensor",
+]
+
+equal = make_binary("equal", jnp.equal)
+not_equal = make_binary("not_equal", jnp.not_equal)
+less_than = make_binary("less_than", jnp.less)
+less_equal = make_binary("less_equal", jnp.less_equal)
+greater_than = make_binary("greater_than", jnp.greater)
+greater_equal = make_binary("greater_equal", jnp.greater_equal)
+logical_and = make_binary("logical_and", jnp.logical_and)
+logical_or = make_binary("logical_or", jnp.logical_or)
+logical_xor = make_binary("logical_xor", jnp.logical_xor)
+logical_not = make_unary("logical_not", jnp.logical_not)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _op("isclose", x, y, rtol=float(rtol), atol=float(atol),
+               equal_nan=bool(equal_nan))
+
+
+from ..core.dispatch import register_op as _reg
+
+_reg("isclose", lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+     jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _op("allclose", x, y, rtol=float(rtol), atol=float(atol),
+               equal_nan=bool(equal_nan))
+
+
+_reg("allclose", lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+     jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    return _op("equal_all", x, y)
+
+
+_reg("equal_all", lambda x, y: jnp.array_equal(x, y))
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

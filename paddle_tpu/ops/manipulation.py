@@ -1,0 +1,750 @@
+"""Shape/layout/indexing ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from builtins import range as _range, slice as _pyslice, sum as _sum
+
+from ._helpers import _op, static_int_list
+
+__all__ = [
+    "reshape", "transpose", "squeeze", "unsqueeze", "flatten", "cast",
+    "concat", "stack", "split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select", "masked_fill",
+    "where", "take_along_axis", "put_along_axis", "slice", "strided_slice",
+    "unbind", "unstack", "repeat_interleave", "t", "moveaxis", "as_strided",
+    "topk", "sort", "argsort", "argmax", "argmin", "unique", "unique_consecutive",
+    "nonzero", "one_hot", "pad", "crop", "shard_index", "tensordot",
+    "searchsorted", "bucketize", "mode", "kthvalue", "tolist", "atleast_1d",
+    "atleast_2d", "atleast_3d", "view", "view_as", "as_complex", "as_real",
+]
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+    return _op("cast", x, dtype=str(np.dtype(dt)))
+
+
+register_op("cast", lambda x, dtype="float32": x.astype(dtype))
+
+
+def reshape(x, shape, name=None):
+    return _op("reshape", x, shape=static_int_list(shape))
+
+
+register_op("reshape", lambda x, shape=(): jnp.reshape(x, shape))
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    return _op("transpose", x, perm=static_int_list(perm))
+
+
+register_op("transpose", lambda x, perm=(): jnp.transpose(x, perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return _op("clone", x)
+    return _op("t2", x)
+
+
+register_op("t2", lambda x: jnp.swapaxes(x, -1, -2))
+
+
+def moveaxis(x, source, destination, name=None):
+    return _op("moveaxis", x, source=static_int_list(source),
+               destination=static_int_list(destination))
+
+
+register_op("moveaxis", lambda x, source=(), destination=():
+            jnp.moveaxis(x, source, destination))
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return _op("squeeze_all", x)
+    ax = static_int_list(axis)
+    ax = tuple(a for a in ax if x.shape[a] == 1)
+    return _op("squeeze", x, axis=ax)
+
+
+register_op("squeeze_all", lambda x: jnp.squeeze(x))
+register_op("squeeze", lambda x, axis=(): jnp.squeeze(x, axis) if axis else x)
+
+
+def unsqueeze(x, axis, name=None):
+    return _op("unsqueeze", x, axis=static_int_list(axis))
+
+
+register_op("unsqueeze", lambda x, axis=(): jnp.expand_dims(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _op("flatten", x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+def _flatten_fwd(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    s = start_axis % nd
+    e = stop_axis % nd
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return x.reshape(new_shape)
+
+
+register_op("flatten", _flatten_fwd)
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _op("concat", *tensors, axis=int(axis))
+
+
+register_op("concat", lambda *xs, axis=0: jnp.concatenate(xs, axis=axis))
+
+
+def stack(x, axis=0, name=None):
+    return _op("stack", *list(x), axis=int(axis))
+
+
+register_op("stack", lambda *xs, axis=0: jnp.stack(xs, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        n_neg = [i for i, s in enumerate(sizes) if s < 0]
+        if n_neg:
+            rest = dim - _sum(s for s in sizes if s >= 0)
+            sizes[n_neg[0]] = rest
+    outs = _op("split", x, sizes=tuple(sizes), axis=axis)
+    return list(outs)
+
+
+def _split_fwd(x, sizes=(), axis=0):
+    indices = np.cumsum(sizes[:-1]).tolist()
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+register_op("split", _split_fwd)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    outs = _op("unbind", x, axis=int(axis), n=int(n))
+    return list(outs)
+
+
+def _unbind_fwd(x, axis=0, n=1):
+    return tuple(jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis))
+
+
+register_op("unbind", _unbind_fwd)
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    return _op("tile", x, reps=static_int_list(repeat_times))
+
+
+register_op("tile", lambda x, reps=(): jnp.tile(x, reps))
+
+
+def expand(x, shape, name=None):
+    tgt = static_int_list(shape)
+    tgt = tuple(x.shape[i - (len(tgt) - x.ndim)] if s == -1 else s
+                for i, s in enumerate(tgt))
+    return _op("broadcast_to", x, shape=tgt)
+
+
+def expand_as(x, y, name=None):
+    return _op("broadcast_to", x, shape=tuple(y.shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return _op("broadcast_to", x, shape=static_int_list(shape))
+
+
+register_op("broadcast_to", lambda x, shape=(): jnp.broadcast_to(x, shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, out_shape) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    return _op("flip", x, axis=static_int_list(axis))
+
+
+register_op("flip", lambda x, axis=(): jnp.flip(x, axis))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _op("rot90", x, k=int(k), axes=tuple(int(a) for a in axes))
+
+
+register_op("rot90", lambda x, k=1, axes=(0, 1): jnp.rot90(x, k, axes))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _op("roll", x, shifts=static_int_list(shifts),
+               axis=None if axis is None else static_int_list(axis))
+
+
+register_op("roll", lambda x, shifts=(), axis=None: jnp.roll(x, shifts, axis))
+
+# ------------------------------------------------------------------ gather/scatter
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _op("gather", x, index, axis=int(axis))
+
+
+register_op("gather", lambda x, index, axis=0:
+            jnp.take(x, index.reshape(-1) if index.ndim > 1 else index, axis=axis),
+            nondiff_inputs=(1,))
+
+
+def gather_nd(x, index, name=None):
+    return _op("gather_nd", x, index)
+
+
+def _gather_nd_fwd(x, index):
+    idx_depth = index.shape[-1]
+    batch_shape = index.shape[:-1]
+    flat_idx = index.reshape(-1, idx_depth)
+    parts = tuple(flat_idx[:, i] for i in range(idx_depth))
+    out = x[parts]
+    return out.reshape(batch_shape + x.shape[idx_depth:])
+
+
+register_op("gather_nd", _gather_nd_fwd, nondiff_inputs=(1,))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _op("scatter", x, index, updates, overwrite=bool(overwrite))
+
+
+def _scatter_fwd(x, index, updates, overwrite=True):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+register_op("scatter", _scatter_fwd, nondiff_inputs=(1,))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _op("scatter_nd_add", x, index, updates)
+
+
+def _scatter_nd_add_fwd(x, index, updates):
+    idx_depth = index.shape[-1]
+    flat_idx = index.reshape(-1, idx_depth)
+    flat_updates = updates.reshape((flat_idx.shape[0],) + x.shape[idx_depth:])
+    parts = tuple(flat_idx[:, i] for i in range(idx_depth))
+    return x.at[parts].add(flat_updates)
+
+
+register_op("scatter_nd_add", _scatter_nd_add_fwd, nondiff_inputs=(1,))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros_t = Tensor(jnp.zeros(static_int_list(shape),
+                     updates.dtype if not isinstance(updates, Tensor) else updates.dtype))
+    return scatter_nd_add(zeros_t, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _op("index_select", x, index, axis=int(axis))
+
+
+register_op("index_select", lambda x, index, axis=0:
+            jnp.take(x, index.reshape(-1), axis=axis), nondiff_inputs=(1,))
+
+
+def index_sample(x, index, name=None):
+    return _op("index_sample", x, index)
+
+
+register_op("index_sample", lambda x, index:
+            jnp.take_along_axis(x, index.astype(jnp.int32), axis=1), nondiff_inputs=(1,))
+
+
+def index_add(x, index, axis, value, name=None):
+    return _op("index_add", x, index, value, axis=int(axis))
+
+
+def _index_add_fwd(x, index, value, axis=0):
+    moved = jnp.moveaxis(x, axis, 0)
+    v = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index.reshape(-1)].add(v)
+    return jnp.moveaxis(out, 0, axis)
+
+
+register_op("index_add", _index_add_fwd, nondiff_inputs=(1,))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_tensors = list(indices)
+    return _op("index_put", x, *idx_tensors, value, accumulate=bool(accumulate),
+               n_idx=len(idx_tensors))
+
+
+def _index_put_fwd(x, *args, accumulate=False, n_idx=1):
+    idx = tuple(args[:n_idx])
+    value = args[n_idx]
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+register_op("index_put", _index_put_fwd)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (cannot appear inside traced programs)
+    arr = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    m = mask.value() if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return Tensor(arr[np.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return _op("masked_fill_t", x, mask, value)
+    return _op("masked_fill", x, mask, value=float(value))
+
+
+register_op("masked_fill", lambda x, mask, value=0.0:
+            jnp.where(mask, jnp.asarray(value, x.dtype), x))
+register_op("masked_fill_t", lambda x, mask, value:
+            jnp.where(mask, value.astype(x.dtype), x))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _op("where", condition, x, y)
+
+
+register_op("where", lambda c, x, y: jnp.where(c, x, y))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return _op("take_along_axis", arr, indices, axis=int(axis))
+
+
+register_op("take_along_axis", lambda x, idx, axis=0:
+            jnp.take_along_axis(x, idx, axis=axis), nondiff_inputs=(1,))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.full(tuple(indices.shape), values, arr.dtype))
+    return _op("put_along_axis", arr, indices, values, axis=int(axis), reduce=str(reduce))
+
+
+def _put_along_axis_fwd(x, idx, values, axis=0, reduce="assign"):
+    v = jnp.broadcast_to(values, idx.shape).astype(x.dtype)
+    if reduce == "add":
+        return _scatter_along_axis(x, idx, v, axis, "add")
+    if reduce == "multiply" or reduce == "mul":
+        return _scatter_along_axis(x, idx, v, axis, "mul")
+    return _scatter_along_axis(x, idx, v, axis, "set")
+
+
+def _scatter_along_axis(x, idx, v, axis, mode):
+    # build open-mesh index tuple selecting along `axis` by idx
+    mesh = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    index_tuple = tuple(idx if d == axis else mesh[d] for d in range(x.ndim))
+    at = x.at[index_tuple]
+    return {"add": at.add, "mul": at.multiply, "set": at.set}[mode](v)
+
+
+register_op("put_along_axis", _put_along_axis_fwd, nondiff_inputs=(1,))
+
+# ------------------------------------------------------------------ slicing
+
+
+def slice(input, axes, starts, ends, name=None):
+    return _op("slice", input, axes=static_int_list(axes),
+               starts=static_int_list(starts), ends=static_int_list(ends))
+
+
+def _slice_fwd(x, axes=(), starts=(), ends=()):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = _pyslice(s, e)
+    return x[tuple(idx)]
+
+
+register_op("slice", _slice_fwd)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _op("strided_slice", x, axes=static_int_list(axes),
+               starts=static_int_list(starts), ends=static_int_list(ends),
+               strides=static_int_list(strides))
+
+
+def _strided_slice_fwd(x, axes=(), starts=(), ends=(), strides=()):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = _pyslice(s, e, st)
+    return x[tuple(idx)]
+
+
+register_op("strided_slice", _strided_slice_fwd)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = static_int_list(shape)
+    offsets = static_int_list(offsets) if offsets is not None else (0,) * len(shape)
+    axes = tuple(_range(len(shape)))
+    starts = offsets
+    ends = tuple(o + (s if s != -1 else x.shape[i] - o)
+                 for i, (o, s) in enumerate(zip(offsets, shape)))
+    return slice(x, axes, starts, ends)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return _op("repeat_interleave_t", x, repeats,
+                   axis=None if axis is None else int(axis),
+                   total=int(repeats.numpy().sum()))
+    return _op("repeat_interleave", x, repeats=int(repeats),
+               axis=None if axis is None else int(axis))
+
+
+register_op("repeat_interleave", lambda x, repeats=1, axis=None:
+            jnp.repeat(x, repeats, axis=axis))
+register_op("repeat_interleave_t", lambda x, repeats, axis=None, total=0:
+            jnp.repeat(x, repeats, axis=axis, total_repeat_length=total),
+            nondiff_inputs=(1,))
+
+# ------------------------------------------------------------------ sort/search
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    vals = _op("topk_vals", x, k=int(k), axis=int(axis), largest=bool(largest))
+    idx = _op("topk_idx", x, k=int(k), axis=int(axis), largest=bool(largest))
+    return vals, idx
+
+
+def _topk(x, k=1, axis=-1, largest=True):
+    ax = axis % x.ndim
+    moved = jnp.moveaxis(x, ax, -1)
+    src = moved if largest else -moved
+    v, i = jax.lax.top_k(src, k)
+    if not largest:
+        v = -v
+    return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+
+
+register_op("topk_vals", lambda x, k=1, axis=-1, largest=True: _topk(x, k, axis, largest)[0])
+register_op("topk_idx", lambda x, k=1, axis=-1, largest=True:
+            _topk(x, k, axis, largest)[1].astype(jnp.int32))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _op("sort", x, axis=int(axis), descending=bool(descending))
+
+
+def _sort_fwd(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+register_op("sort", _sort_fwd)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _op("argsort", x, axis=int(axis), descending=bool(descending))
+
+
+def _argsort_fwd(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis)
+    out = jnp.flip(out, axis=axis) if descending else out
+    return out.astype(jnp.int32)
+
+
+register_op("argsort", _argsort_fwd)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _op("argmax", x, axis=None if axis is None else int(axis),
+               keepdim=bool(keepdim))
+
+
+register_op("argmax", lambda x, axis=None, keepdim=False:
+            jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+            .astype(jnp.int32))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _op("argmin", x, axis=None if axis is None else int(axis),
+               keepdim=bool(keepdim))
+
+
+register_op("argmin", lambda x, axis=None, keepdim=False:
+            jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+            .astype(jnp.int32))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int32)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(vals), Tensor(idxs)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+    vals = _op("kthvalue_vals", x, k=int(k), axis=ax, keepdim=bool(keepdim))
+    idx = _op("kthvalue_idx", x, k=int(k), axis=ax, keepdim=bool(keepdim))
+    return vals, idx
+
+
+def _kthvalue(x, k=1, axis=-1, keepdim=False):
+    sorted_v = jnp.sort(x, axis=axis)
+    argsorted = jnp.argsort(x, axis=axis)
+    v = jnp.take(sorted_v, k - 1, axis=axis)
+    i = jnp.take(argsorted, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+register_op("kthvalue_vals", lambda x, k=1, axis=-1, keepdim=False: _kthvalue(x, k, axis, keepdim)[0])
+register_op("kthvalue_idx", lambda x, k=1, axis=-1, keepdim=False:
+            _kthvalue(x, k, axis, keepdim)[1].astype(jnp.int32))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return _op("searchsorted", sorted_sequence, values,
+               side="right" if right else "left", out_int32=bool(out_int32))
+
+
+register_op("searchsorted", lambda s, v, side="left", out_int32=False:
+            jnp.searchsorted(s, v, side=side).astype(jnp.int32 if out_int32 else jnp.int32))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape → eager numpy path (reference runs this on CPU too)
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    outs = [Tensor(r if i == 0 else r.astype(np.int32)) for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if arr.size == 0:
+        outs = [Tensor(arr)]
+    else:
+        take_first = np.ones(arr.shape[ax], bool)
+        sl = [np.s_[:]] * arr.ndim
+        sl_prev = list(sl)
+        sl[ax] = np.s_[1:]
+        sl_prev[ax] = np.s_[:-1]
+        neq = np.any(arr[tuple(sl)] != arr[tuple(sl_prev)],
+                     axis=tuple(i for i in range(arr.ndim) if i != ax)) \
+            if arr.ndim > 1 else arr[1:] != arr[:-1]
+        take_first[1:] = neq
+        uniq = np.compress(take_first, arr, axis=ax)
+        outs = [Tensor(uniq)]
+        if return_inverse:
+            outs.append(Tensor(np.cumsum(take_first) - 1))
+        if return_counts:
+            idx = np.flatnonzero(take_first)
+            counts = np.diff(np.append(idx, arr.shape[ax]))
+            outs.append(Tensor(counts.astype(np.int32)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int32)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int32))
+
+
+def one_hot(x, num_classes, name=None):
+    return _op("one_hot", x, num_classes=int(num_classes))
+
+
+register_op("one_hot", lambda x, num_classes=1:
+            jax.nn.one_hot(x, num_classes, dtype=jnp.float32))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad_list = static_int_list(pad)
+    return _op("pad", x, pad=pad_list, mode=str(mode), value=float(value),
+               data_format=str(data_format))
+
+
+def _pad_fwd(x, pad=(), mode="constant", value=0.0, data_format="NCHW"):
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad covers trailing spatial dims (reversed pairs like torch)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            spatial_dims = list(range(nd - n_spatial, nd))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        for i, d in enumerate(spatial_dims):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+register_op("pad", _pad_fwd)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _op("shard_index", input, index_num=int(index_num), nshards=int(nshards),
+               shard_id=int(shard_id), ignore_value=int(ignore_value))
+
+
+def _shard_index_fwd(x, index_num=1, nshards=1, shard_id=0, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+register_op("shard_index", _shard_index_fwd)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else (a,) for a in axes)
+    return _op("tensordot", x, y, axes=axes if isinstance(axes, int) else tuple(axes))
+
+
+register_op("tensordot", lambda x, y, axes=2:
+            jnp.tensordot(x, y, axes=axes if isinstance(axes, int) else tuple(map(tuple, axes))))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(x, (1,)) if x.ndim == 0 else x for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        if x.ndim == 0:
+            outs.append(reshape(x, (1, 1)))
+        elif x.ndim == 1:
+            outs.append(unsqueeze(x, 0))
+        else:
+            outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        y = atleast_2d(x)
+        outs.append(unsqueeze(y, -1) if y.ndim == 2 else y)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def as_complex(x, name=None):
+    return _op("as_complex", x)
+
+
+register_op("as_complex", lambda x: jax.lax.complex(x[..., 0], x[..., 1]))
+
+
+def as_real(x, name=None):
+    return _op("as_real", x)
+
+
+register_op("as_real", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x.numpy()).reshape(-1)[offset:],
+        shape=tuple(shape),
+        strides=tuple(s * x.numpy().dtype.itemsize for s in stride))
+    return Tensor(arr.copy())
+
+
+def tolist(x):
+    return x.numpy().tolist()

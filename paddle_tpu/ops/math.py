@@ -1,0 +1,438 @@
+"""Math ops (reference: python/paddle/tensor/math.py, ops.yaml math entries).
+
+Every op is one jax-traceable forward; gradients come from the dispatch layer's
+jit(vjp(fwd)) generic backward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ._helpers import _op, as_tuple_axis, make_binary, make_unary
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "heaviside",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs",
+    "neg", "sign", "floor", "ceil", "round", "trunc", "frac", "reciprocal",
+    "square", "sin", "cos", "tan", "tanh", "asin", "acos", "atan", "sinh", "cosh",
+    "asinh", "acosh", "atanh", "erf", "erfinv", "digamma", "lgamma",
+    "clip", "lerp", "scale", "stanh", "rad2deg", "deg2rad", "angle", "conj", "real", "imag",
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "std", "var", "median",
+    "nansum", "nanmean", "logsumexp", "all", "any", "count_nonzero",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    "matmul", "dot", "inner", "outer", "addmm", "kron", "trace", "diff",
+    "isnan", "isinf", "isfinite", "nan_to_num", "logit", "multiplex",
+    "increment", "gcd", "lcm", "logaddexp", "hypot", "ldexp", "copysign",
+    "sgn", "take", "renorm", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+]
+
+# ------------------------------------------------------------- elementwise binary
+
+add = make_binary("add", jnp.add)
+subtract = make_binary("subtract", jnp.subtract)
+multiply = make_binary("multiply", jnp.multiply)
+divide = make_binary("divide", jnp.true_divide)
+floor_divide = make_binary("floor_divide", jnp.floor_divide)
+remainder = make_binary("remainder", jnp.remainder)
+mod = remainder
+maximum = make_binary("maximum", jnp.maximum)
+minimum = make_binary("minimum", jnp.minimum)
+fmax = make_binary("fmax", jnp.fmax)
+fmin = make_binary("fmin", jnp.fmin)
+atan2 = make_binary("atan2", jnp.arctan2)
+logaddexp = make_binary("logaddexp", jnp.logaddexp)
+hypot = make_binary("hypot", jnp.hypot)
+copysign = make_binary("copysign", jnp.copysign)
+gcd = make_binary("gcd", jnp.gcd)
+lcm = make_binary("lcm", jnp.lcm)
+bitwise_and = make_binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = make_binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = make_binary("bitwise_xor", jnp.bitwise_xor)
+heaviside = make_binary("heaviside", jnp.heaviside)
+
+
+def pow(x, y, name=None):
+    return _op("pow", x, y)
+
+
+register_op("pow", jnp.power)
+
+
+def ldexp(x, y, name=None):
+    return _op("ldexp", x, y)
+
+
+register_op("ldexp", lambda x, y: x * (2.0 ** y.astype(jnp.float32)))
+
+# ------------------------------------------------------------- elementwise unary
+
+exp = make_unary("exp", jnp.exp)
+expm1 = make_unary("expm1", jnp.expm1)
+log = make_unary("log", jnp.log)
+log2 = make_unary("log2", jnp.log2)
+log10 = make_unary("log10", jnp.log10)
+log1p = make_unary("log1p", jnp.log1p)
+sqrt = make_unary("sqrt", jnp.sqrt)
+rsqrt = make_unary("rsqrt", jax.lax.rsqrt)
+abs = make_unary("abs", jnp.abs)
+neg = make_unary("neg", jnp.negative)
+sign = make_unary("sign", jnp.sign)
+sgn = sign
+floor = make_unary("floor", jnp.floor)
+ceil = make_unary("ceil", jnp.ceil)
+round = make_unary("round", jnp.round)
+trunc = make_unary("trunc", jnp.trunc)
+frac = make_unary("frac", lambda x: x - jnp.trunc(x))
+reciprocal = make_unary("reciprocal", jnp.reciprocal)
+square = make_unary("square", jnp.square)
+sin = make_unary("sin", jnp.sin)
+cos = make_unary("cos", jnp.cos)
+tan = make_unary("tan", jnp.tan)
+tanh = make_unary("tanh", jnp.tanh)
+asin = make_unary("asin", jnp.arcsin)
+acos = make_unary("acos", jnp.arccos)
+atan = make_unary("atan", jnp.arctan)
+sinh = make_unary("sinh", jnp.sinh)
+cosh = make_unary("cosh", jnp.cosh)
+asinh = make_unary("asinh", jnp.arcsinh)
+acosh = make_unary("acosh", jnp.arccosh)
+atanh = make_unary("atanh", jnp.arctanh)
+erf = make_unary("erf", jax.scipy.special.erf)
+erfinv = make_unary("erfinv", jax.scipy.special.erfinv)
+digamma = make_unary("digamma", jax.scipy.special.digamma)
+lgamma = make_unary("lgamma", jax.scipy.special.gammaln)
+rad2deg = make_unary("rad2deg", jnp.rad2deg)
+deg2rad = make_unary("deg2rad", jnp.deg2rad)
+angle = make_unary("angle", jnp.angle)
+conj = make_unary("conj", jnp.conj)
+real = make_unary("real", jnp.real)
+imag = make_unary("imag", jnp.imag)
+isnan = make_unary("isnan", jnp.isnan)
+isinf = make_unary("isinf", jnp.isinf)
+isfinite = make_unary("isfinite", jnp.isfinite)
+bitwise_not = make_unary("bitwise_not", jnp.bitwise_not)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return _op("clip", x, min=None if lo is None else float(lo),
+               max=None if hi is None else float(hi))
+
+
+register_op("clip", lambda x, min=None, max=None: jnp.clip(x, min, max))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        weight = float(weight)
+        return _op("lerp_scalar", x, y, weight=weight)
+    return _op("lerp", x, y, weight)
+
+
+register_op("lerp", lambda x, y, w: x + w * (y - x))
+register_op("lerp_scalar", lambda x, y, weight=0.5: x + weight * (y - x))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return _op("scale", x, scale=float(scale), bias=float(bias),
+               bias_after_scale=bool(bias_after_scale))
+
+
+def _scale_fwd(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+register_op("scale", _scale_fwd)
+
+stanh = make_unary("stanh", lambda x: 1.7159 * jnp.tanh(0.66667 * x))
+
+
+def logit(x, eps=None, name=None):
+    return _op("logit", x, eps=eps)
+
+
+def _logit_fwd(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+register_op("logit", _logit_fwd)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _op("nan_to_num", x, nan=float(nan),
+               posinf=None if posinf is None else float(posinf),
+               neginf=None if neginf is None else float(neginf))
+
+
+register_op("nan_to_num", lambda x, nan=0.0, posinf=None, neginf=None:
+            jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+
+def increment(x, value=1.0, name=None):
+    out = _op("scale", x, scale=1.0, bias=float(value), bias_after_scale=True)
+    x._set_value_inplace(out.value())
+    return x
+
+# ------------------------------------------------------------- reductions
+
+
+def _reduction(name, jfn):
+    def fwd(x, axis=None, keepdim=False):
+        return jfn(x, axis=axis, keepdims=keepdim)
+
+    register_op(name, fwd)
+
+    def wrapper(x, axis=None, keepdim=False, name=None):
+        return _op(name_, x, axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+
+    name_ = name
+    wrapper.__name__ = name
+    return wrapper
+
+
+sum = _reduction("sum", jnp.sum)
+mean = _reduction("mean", jnp.mean)
+prod = _reduction("prod", jnp.prod)
+max = _reduction("max", jnp.max)
+min = _reduction("min", jnp.min)
+amax = _reduction("amax", jnp.max)
+amin = _reduction("amin", jnp.min)
+nansum = _reduction("nansum", jnp.nansum)
+nanmean = _reduction("nanmean", jnp.nanmean)
+all = _reduction("all", jnp.all)
+any = _reduction("any", jnp.any)
+median = _reduction("median", jnp.median)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _op("std", x, axis=as_tuple_axis(axis), unbiased=bool(unbiased),
+               keepdim=bool(keepdim))
+
+
+register_op("std", lambda x, axis=None, unbiased=True, keepdim=False:
+            jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _op("var", x, axis=as_tuple_axis(axis), unbiased=bool(unbiased),
+               keepdim=bool(keepdim))
+
+
+register_op("var", lambda x, axis=None, unbiased=True, keepdim=False:
+            jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _op("logsumexp", x, axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+
+
+register_op("logsumexp", lambda x, axis=None, keepdim=False:
+            jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _op("count_nonzero", x, axis=as_tuple_axis(axis), keepdim=bool(keepdim))
+
+
+register_op("count_nonzero", lambda x, axis=None, keepdim=False:
+            jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int32))
+
+# ------------------------------------------------------------- scans
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return _op("cumsum", x, axis=None if axis is None else int(axis))
+
+
+register_op("cumsum", lambda x, axis=None:
+            jnp.cumsum(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return _op("cumprod", x, axis=None if dim is None else int(dim))
+
+
+register_op("cumprod", lambda x, axis=None:
+            jnp.cumprod(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    return _op("logcumsumexp", x, axis=None if axis is None else int(axis))
+
+
+register_op("logcumsumexp", lambda x, axis=None:
+            jax.lax.cumlogsumexp(x.reshape(-1) if axis is None else x,
+                                 axis=0 if axis is None else axis))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    arr = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    a = arr.reshape(-1) if axis is None else arr
+    ax = 0 if axis is None else int(axis)
+    out = jax.lax.cummax(a, axis=ax)
+    vals = _op("cummax_vals", x, axis=None if axis is None else int(axis))
+    return vals, Tensor(_cum_arg_indices(a, out, ax).astype(jnp.int32))
+
+
+register_op("cummax_vals", lambda x, axis=None:
+            jax.lax.cummax(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
+register_op("cummin_vals", lambda x, axis=None:
+            jax.lax.cummin(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
+
+
+def _cum_arg_indices(a, out, ax):
+    n = a.shape[ax]
+    ar = jnp.arange(n)
+    shape = [1] * a.ndim
+    shape[ax] = n
+    pos = ar.reshape(shape)
+    match = (a == out)
+    return jax.lax.cummax(jnp.where(match, pos, -1), axis=ax)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    arr = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    a = arr.reshape(-1) if axis is None else arr
+    ax = 0 if axis is None else int(axis)
+    out = jax.lax.cummin(a, axis=ax)
+    vals = _op("cummin_vals", x, axis=None if axis is None else int(axis))
+    return vals, Tensor(_cum_arg_indices(a, out, ax).astype(jnp.int32))
+
+# ------------------------------------------------------------- linalg-ish
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _op("matmul", x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y))
+
+
+def _matmul_fwd(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+register_op("matmul", _matmul_fwd)
+
+
+def dot(x, y, name=None):
+    return _op("dot", x, y)
+
+
+register_op("dot", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def inner(x, y, name=None):
+    return _op("inner", x, y)
+
+
+register_op("inner", jnp.inner)
+
+
+def outer(x, y, name=None):
+    return _op("outer", x, y)
+
+
+register_op("outer", lambda x, y: jnp.outer(x.reshape(-1), y.reshape(-1)))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _op("addmm", input, x, y, beta=float(beta), alpha=float(alpha))
+
+
+register_op("addmm", lambda inp, x, y, beta=1.0, alpha=1.0:
+            beta * inp + alpha * jnp.matmul(x, y))
+
+
+def kron(x, y, name=None):
+    return _op("kron", x, y)
+
+
+register_op("kron", jnp.kron)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _op("trace", x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+register_op("trace", lambda x, offset=0, axis1=0, axis2=1:
+            jnp.trace(x, offset, axis1, axis2))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    spec = []
+    if prepend is not None:
+        args.append(prepend)
+        spec.append("prepend")
+    if append is not None:
+        args.append(append)
+        spec.append("append")
+    return _op("diff", *args, n=int(n), axis=int(axis), spec=tuple(spec))
+
+
+def _diff_fwd(x, *extra, n=1, axis=-1, spec=()):
+    kw = {}
+    for name, arr in zip(spec, extra):
+        kw[name] = arr
+    return jnp.diff(x, n=n, axis=axis, **kw)
+
+
+register_op("diff", _diff_fwd)
+
+
+def multiplex(inputs, index, name=None):
+    stacked_args = list(inputs) + [index]
+    return _op("multiplex", *stacked_args)
+
+
+def _multiplex_fwd(*args):
+    *ins, idx = args
+    stacked = jnp.stack(ins, axis=0)  # [K, N, ...]
+    sel = idx.reshape(-1).astype(jnp.int32)  # [N]
+    rows = jnp.arange(sel.shape[0])
+    return stacked[sel, rows]
+
+
+register_op("multiplex", _multiplex_fwd)
+
+
+def take(x, index, mode="raise", name=None):
+    return _op("take", x, index, mode=str(mode))
+
+
+def _take_fwd(x, index, mode="raise"):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    idx = index
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, -n, n - 1)
+    idx = jnp.where(idx < 0, idx + n, idx)
+    return jnp.take(flat, idx.astype(jnp.int32))
+
+
+register_op("take", _take_fwd, nondiff_inputs=(1,))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _op("renorm", x, p=float(p), axis=int(axis), max_norm=float(max_norm))
+
+
+def _renorm_fwd(x, p=2.0, axis=0, max_norm=1.0):
+    dims = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+register_op("renorm", _renorm_fwd)

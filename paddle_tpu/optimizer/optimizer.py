@@ -1,0 +1,472 @@
+"""Optimizers (reference: python/paddle/optimizer/optimizer.py + per-algo files).
+
+TPU-idiomatic: step() performs ONE fused pytree update — all params/grads/states are
+updated inside a single cached XLA executable (the reference's multi_tensor path is the
+analog, optimizer.py _append_optimize_multi_tensor_op). Learning rate is passed as a
+device scalar so LR schedules never trigger recompilation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..core.dtype import convert_dtype
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb"]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_update(cls, static_key):
+    """One compiled update over the whole parameter pytree per optimizer config."""
+    static = dict(static_key)
+
+    def update(params, grads, states, scalars):
+        new_params, new_states = cls._update_rule(params, grads, states, scalars,
+                                                  **static)
+        return new_params, new_states
+
+    return jax.jit(update)
+
+
+class Optimizer:
+    _state_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode, like reference "
+                             "dygraph optimizers)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (float, int)) or weight_decay is None:
+            self._weight_decay = float(weight_decay or 0.0)
+        else:  # L2Decay-style object with a coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._master_weights: Dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------ lr plumbing
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._learning_rate = scheduler
+
+    # ------------------------------------------------------------ state
+
+    def _ensure_state(self, p: Parameter):
+        pid = id(p)
+        if pid not in self._accumulators:
+            dtype = jnp.float32 if self._multi_precision else p.value().dtype
+            self._accumulators[pid] = {
+                name: jnp.zeros(tuple(p.shape), dtype) for name in self._state_names}
+            if self._multi_precision and p.value().dtype != jnp.float32:
+                self._master_weights[pid] = p.value().astype(jnp.float32)
+        return self._accumulators[pid]
+
+    def _static_config(self):
+        return (("weight_decay", self._weight_decay),)
+
+    def _scalars(self, lr):
+        self._step_count += 1
+        return {"lr": jnp.asarray(lr, jnp.float32),
+                "step": jnp.asarray(self._step_count, jnp.float32)}
+
+    # ------------------------------------------------------------ step
+
+    @no_grad()
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if p.trainable and p._grad is not None]
+        if not params:
+            return
+        grads = [p._grad for p in params]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip(list(zip(params, grads)))
+            grads = [g for _, g in clipped]
+        for p in params:
+            self._ensure_state(p)
+
+        use_master = [id(p) in self._master_weights for p in params]
+        param_vals = [self._master_weights[id(p)] if m else p.value()
+                      for p, m in zip(params, use_master)]
+        # per-param lr scale (ParamAttr learning_rate)
+        lr_scales = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
+                          for p in params)
+        states = [self._accumulators[id(p)] for p in params]
+        scalars = self._scalars(self.get_lr())
+
+        static_key = self._static_config() + (("lr_scales", lr_scales),)
+        new_params, new_states = _jitted_update(type(self), static_key)(
+            param_vals, [g.astype(v.dtype) for g, v in zip(grads, param_vals)],
+            states, scalars)
+
+        for p, newv, news, m in zip(params, new_params, new_states, use_master):
+            if m:
+                self._master_weights[id(p)] = newv
+                p._set_value_inplace(newv.astype(p.value().dtype))
+            else:
+                p._set_value_inplace(newv)
+            self._accumulators[id(p)] = news
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ------------------------------------------------------------ checkpoint
+
+    def state_dict(self):
+        out = {"master_weights": {}, "LR_Scheduler": {}}
+        for i, p in enumerate(self._parameter_list):
+            pid = id(p)
+            key = p.name or f"param_{i}"
+            if pid in self._accumulators:
+                for name, arr in self._accumulators[pid].items():
+                    out[f"{key}_{name}"] = Tensor(arr)
+            if pid in self._master_weights:
+                out["master_weights"][key] = Tensor(self._master_weights[pid])
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        out["_step_count"] = self._step_count
+        return out
+
+    def set_state_dict(self, state):
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            acc = {}
+            for name in self._state_names:
+                k = f"{key}_{name}"
+                if k in state:
+                    v = state[k]
+                    acc[name] = v.value() if isinstance(v, Tensor) else jnp.asarray(v)
+            if acc:
+                self._accumulators[id(p)] = acc
+            mw = state.get("master_weights", {})
+            if key in mw:
+                v = mw[key]
+                self._master_weights[id(p)] = (v.value() if isinstance(v, Tensor)
+                                               else jnp.asarray(v))
+        if isinstance(self._learning_rate, LRScheduler) and state.get("LR_Scheduler"):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        self._step_count = state.get("_step_count", 0)
+
+    # subclasses implement:
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, **static):
+        raise NotImplementedError
+
+
+def _apply_wd(p, g, wd):
+    """L2 regularization added to the gradient (reference L2Decay semantics)."""
+    return g + wd * p if wd else g
+
+
+class SGD(Optimizer):
+    _state_names: List[str] = []
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, lr_scales=()):
+        lr = scalars["lr"]
+        new_params = [p - (lr * s) * _apply_wd(p, g, weight_decay)
+                      for p, g, s in zip(params, grads, lr_scales)]
+        return new_params, states
+
+
+class Momentum(Optimizer):
+    _state_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _static_config(self):
+        return super()._static_config() + (("momentum", self._momentum),
+                                           ("use_nesterov", self._use_nesterov))
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, momentum=0.9,
+                     use_nesterov=False, lr_scales=()):
+        lr = scalars["lr"]
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            g = _apply_wd(p, g, weight_decay)
+            v = momentum * st["velocity"] + g
+            if use_nesterov:
+                p2 = p - (lr * s) * (g + momentum * v)
+            else:
+                p2 = p - (lr * s) * v
+            new_params.append(p2)
+            new_states.append({"velocity": v})
+        return new_params, new_states
+
+
+class Adam(Optimizer):
+    _state_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 lazy_mode=False, multi_precision=False, use_multi_tensor=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1 = float(beta1 if not isinstance(beta1, Tensor) else beta1.item())
+        self._beta2 = float(beta2 if not isinstance(beta2, Tensor) else beta2.item())
+        self._epsilon = float(epsilon)
+
+    def _static_config(self):
+        return super()._static_config() + (("beta1", self._beta1),
+                                           ("beta2", self._beta2),
+                                           ("epsilon", self._epsilon))
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, beta1=0.9,
+                     beta2=0.999, epsilon=1e-8, lr_scales=(), decouple_wd=False):
+        lr = scalars["lr"]
+        t = scalars["step"]
+        bc1 = 1.0 - beta1 ** t
+        bc2 = 1.0 - beta2 ** t
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            if not decouple_wd:
+                g = _apply_wd(p, g, weight_decay)
+            m1 = beta1 * st["moment1"] + (1 - beta1) * g
+            m2 = beta2 * st["moment2"] + (1 - beta2) * jnp.square(g)
+            m1h = m1 / bc1
+            m2h = m2 / bc2
+            step_v = (lr * s) * m1h / (jnp.sqrt(m2h) + epsilon)
+            if decouple_wd and weight_decay:
+                step_v = step_v + (lr * s) * weight_decay * p
+            new_params.append(p - step_v)
+            new_states.append({"moment1": m1, "moment2": m2})
+        return new_params, new_states
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py,
+    default coeff 0.01)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, name=None,
+                 lazy_mode=False, multi_precision=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name, lazy_mode, multi_precision)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _static_config(self):
+        return super()._static_config() + (("decouple_wd", True),)
+
+    @no_grad()
+    def step(self):
+        if self._apply_decay_param_fun is not None:
+            # zero out decay for excluded params by splitting the step
+            wd = self._weight_decay
+            included = [p for p in self._parameter_list
+                        if self._apply_decay_param_fun(p.name)]
+            excluded = [p for p in self._parameter_list
+                        if not self._apply_decay_param_fun(p.name)]
+            all_params = self._parameter_list
+            try:
+                self._parameter_list = included
+                self._weight_decay = wd
+                super().step()
+                self._parameter_list = excluded
+                self._weight_decay = 0.0
+                self._step_count -= 1  # same logical step for both halves
+                super().step()
+            finally:
+                self._parameter_list = all_params
+                self._weight_decay = wd
+            return
+        super().step()
+
+
+class Adamax(Optimizer):
+    _state_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _static_config(self):
+        return super()._static_config() + (("beta1", self._beta1),
+                                           ("beta2", self._beta2),
+                                           ("epsilon", self._epsilon))
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, beta1=0.9,
+                     beta2=0.999, epsilon=1e-8, lr_scales=()):
+        lr = scalars["lr"]
+        t = scalars["step"]
+        bc1 = 1.0 - beta1 ** t
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            g = _apply_wd(p, g, weight_decay)
+            m = beta1 * st["moment"] + (1 - beta1) * g
+            u = jnp.maximum(beta2 * st["inf_norm"], jnp.abs(g))
+            new_params.append(p - (lr * s) / bc1 * m / (u + epsilon))
+            new_states.append({"moment": m, "inf_norm": u})
+        return new_params, new_states
+
+
+class Adagrad(Optimizer):
+    _state_names = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = float(epsilon)
+        self._init_acc = float(initial_accumulator_value)
+
+    def _ensure_state(self, p):
+        pid = id(p)
+        if pid not in self._accumulators:
+            self._accumulators[pid] = {
+                "moment": jnp.full(tuple(p.shape), self._init_acc, p.value().dtype)}
+        return self._accumulators[pid]
+
+    def _static_config(self):
+        return super()._static_config() + (("epsilon", self._epsilon),)
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, epsilon=1e-6,
+                     lr_scales=()):
+        lr = scalars["lr"]
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            g = _apply_wd(p, g, weight_decay)
+            m = st["moment"] + jnp.square(g)
+            new_params.append(p - (lr * s) * g / (jnp.sqrt(m) + epsilon))
+            new_states.append({"moment": m})
+        return new_params, new_states
+
+
+class Adadelta(Optimizer):
+    _state_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = float(epsilon), float(rho)
+
+    def _static_config(self):
+        return super()._static_config() + (("epsilon", self._epsilon),
+                                           ("rho", self._rho))
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, epsilon=1e-6,
+                     rho=0.95, lr_scales=()):
+        lr = scalars["lr"]
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            g = _apply_wd(p, g, weight_decay)
+            asg = rho * st["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+            upd = g * jnp.sqrt(st["avg_squared_update"] + epsilon) / jnp.sqrt(asg + epsilon)
+            asu = rho * st["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+            new_params.append(p - (lr * s) * upd)
+            new_states.append({"avg_squared_grad": asg, "avg_squared_update": asu})
+        return new_params, new_states
+
+
+class RMSProp(Optimizer):
+    _state_names = ["mean_square", "mean_grad", "momentum_acc"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), bool(centered)
+
+    def _static_config(self):
+        return super()._static_config() + (("rho", self._rho),
+                                           ("epsilon", self._epsilon),
+                                           ("momentum", self._momentum),
+                                           ("centered", self._centered))
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, rho=0.95,
+                     epsilon=1e-6, momentum=0.0, centered=False, lr_scales=()):
+        lr = scalars["lr"]
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            g = _apply_wd(p, g, weight_decay)
+            ms = rho * st["mean_square"] + (1 - rho) * jnp.square(g)
+            if centered:
+                mg = rho * st["mean_grad"] + (1 - rho) * g
+                denom = jnp.sqrt(ms - jnp.square(mg) + epsilon)
+            else:
+                mg = st["mean_grad"]
+                denom = jnp.sqrt(ms + epsilon)
+            mom = momentum * st["momentum_acc"] + (lr * s) * g / denom
+            new_params.append(p - mom)
+            new_states.append({"mean_square": ms, "mean_grad": mg,
+                               "momentum_acc": mom})
+        return new_params, new_states
+
+
+class Lamb(Optimizer):
+    _state_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _static_config(self):
+        return super()._static_config() + (("beta1", self._beta1),
+                                           ("beta2", self._beta2),
+                                           ("epsilon", self._epsilon))
+
+    @staticmethod
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, beta1=0.9,
+                     beta2=0.999, epsilon=1e-6, lr_scales=()):
+        lr = scalars["lr"]
+        t = scalars["step"]
+        bc1 = 1.0 - beta1 ** t
+        bc2 = 1.0 - beta2 ** t
+        new_params, new_states = [], []
+        for p, g, st, s in zip(params, grads, states, lr_scales):
+            m1 = beta1 * st["moment1"] + (1 - beta1) * g
+            m2 = beta2 * st["moment2"] + (1 - beta2) * jnp.square(g)
+            r = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + epsilon) + weight_decay * p
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            new_params.append(p - (lr * s) * trust * r)
+            new_states.append({"moment1": m1, "moment2": m2})
+        return new_params, new_states

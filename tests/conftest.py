@@ -1,0 +1,18 @@
+"""Test harness: force an 8-device virtual CPU platform before jax initializes.
+
+Mirrors the reference's fake-device strategy (SURVEY.md §4: custom_cpu plugin — a CPU
+masquerading as an accelerator) so multi-chip sharding semantics are testable without a
+TPU pod. NOTE: the axon TPU plugin ignores the JAX_PLATFORMS env var, so the config
+update must happen here, before any jax computation.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

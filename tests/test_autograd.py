@@ -1,0 +1,190 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Finite-difference gradient (reference: OpTest.get_numeric_gradient,
+    eager_op_test.py:131)."""
+    x = np.asarray(x, dtype=np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_matmul_grad_vs_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.rand(3, 4).astype("float32")
+    b_np = rng.rand(4, 2).astype("float32")
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    ga = numeric_grad(lambda x: (x @ b_np.astype(np.float64)).sum(), a_np)
+    gb = numeric_grad(lambda y: (a_np.astype(np.float64) @ y).sum(), b_np)
+    assert np.allclose(a.grad.numpy(), ga, atol=1e-2)
+    assert np.allclose(b.grad.numpy(), gb, atol=1e-2)
+
+
+@pytest.mark.parametrize("op,f", [
+    ("exp", np.exp),
+    ("tanh", np.tanh),
+    ("log", np.log),
+    ("sqrt", np.sqrt),
+    ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+])
+def test_unary_grads_vs_numeric(op, f):
+    rng = np.random.RandomState(1)
+    x_np = (rng.rand(5) + 0.5).astype("float32")
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    if op == "sigmoid":
+        import paddle_tpu.nn.functional as F
+        y = F.sigmoid(x).sum()
+    else:
+        y = getattr(paddle, op)(x).sum()
+    y.backward()
+    g = numeric_grad(lambda v: f(v).sum(), x_np)
+    assert np.allclose(x.grad.numpy(), g, atol=1e-2), op
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    (y1 + y2).backward()
+    assert np.allclose(x.grad.numpy(), [5.0])
+    # second backward accumulates into .grad
+    z = x * 4
+    z.backward()
+    assert np.allclose(x.grad.numpy(), [9.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    out = (x * y).sum()
+    out.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * 5
+    assert z.stop_gradient
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * x        # 4, da/dx = 2x = 4
+    b = a * 3        # da path
+    c = a * 2
+    out = (b + c).sum()   # d/da = 5, d/dx = 5*2x = 20
+    out.backward()
+    assert np.allclose(x.grad.numpy(), [20.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [4.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    assert np.allclose(gx.numpy(), [12.0])
+    # .grad untouched by paddle.grad
+    assert x.grad is None
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    assert np.allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[5.0, 1.0, 3.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    assert np.allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3),
+                         stop_gradient=False)
+    y = x[0, 1:].sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [[0, 1, 1], [0, 0, 0]])
+
+
+def test_concat_split_grad():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = paddle.to_tensor([3.0], stop_gradient=False)
+    c = paddle.concat([a, b])
+    (c * paddle.to_tensor([1.0, 2.0, 3.0])).sum().backward()
+    assert np.allclose(a.grad.numpy(), [1.0, 2.0])
+    assert np.allclose(b.grad.numpy(), [3.0])
+
+
+def test_inplace_version_check():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    x.add_(paddle.to_tensor([1.0]))
+    with pytest.raises(RuntimeError):
+        y.sum().backward()
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * a
+
+        @staticmethod
+        def backward(ctx, dy):
+            (a,) = ctx.saved_tensor
+            return dy * a * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x)
+    y.sum().backward()
+    assert np.allclose(x.grad.numpy(), [6.0])

@@ -1,0 +1,138 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(np.dtype(t.dtype)) == "float32"
+    assert t.numpy().tolist() == [[1.0, 2.0], [3.0, 4.0]]
+
+
+def test_default_dtypes():
+    assert np.dtype(paddle.to_tensor(1).dtype) == np.int32  # TPU-native: int32 canon
+    assert np.dtype(paddle.to_tensor(1.5).dtype) == np.float32
+    assert np.dtype(paddle.to_tensor(True).dtype) == np.bool_
+
+
+def test_arith_dunders():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    assert np.allclose((x + y).numpy(), [5, 7, 9])
+    assert np.allclose((x - y).numpy(), [-3, -3, -3])
+    assert np.allclose((x * y).numpy(), [4, 10, 18])
+    assert np.allclose((y / x).numpy(), [4, 2.5, 2])
+    assert np.allclose((x ** 2).numpy(), [1, 4, 9])
+    assert np.allclose((2.0 - x).numpy(), [1, 0, -1])
+    assert np.allclose((1.0 / x).numpy(), [1, 0.5, 1 / 3])
+    assert np.allclose((-x).numpy(), [-1, -2, -3])
+    assert np.allclose(abs(paddle.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_comparison_elementwise():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([3.0, 2.0, 1.0])
+    assert (x == y).numpy().tolist() == [False, True, False]
+    assert (x < y).numpy().tolist() == [True, False, False]
+    assert (x >= y).numpy().tolist() == [False, True, True]
+
+
+def test_matmul_scalars_broadcast():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    c = a @ b
+    assert c.shape == [2, 4]
+    assert np.allclose(c.numpy(), 3.0)
+
+
+def test_indexing_get():
+    x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    assert x[0].shape == [3, 4]
+    assert x[:, 1].shape == [2, 4]
+    assert x[0, 1, 2].item() == 6.0
+    assert x[..., -1].shape == [2, 3]
+    assert x[:, None].shape == [2, 1, 3, 4]
+    idx = paddle.to_tensor(np.array([0, 2]))
+    assert x[0, idx].shape == [2, 4]
+
+
+def test_indexing_set():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    assert x.numpy()[1].tolist() == [5, 5, 5]
+    x[0, 0] = 7.0
+    assert x.numpy()[0, 0] == 7
+
+
+def test_bool_mask():
+    x = paddle.to_tensor([1.0, -2.0, 3.0, -4.0])
+    m = x > 0
+    sel = x[m]
+    assert sel.numpy().tolist() == [1.0, 3.0]
+
+
+def test_inplace_methods():
+    x = paddle.ones([2, 2])
+    x.add_(paddle.ones([2, 2]))
+    assert np.allclose(x.numpy(), 2.0)
+    x.scale_(scale=0.5)
+    assert np.allclose(x.numpy(), 1.0)
+    x.zero_()
+    assert np.allclose(x.numpy(), 0.0)
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.7, 2.3])
+    y = x.astype("int32")
+    assert y.numpy().tolist() == [1, 2]
+    z = paddle.cast(x, paddle.float16)
+    assert np.dtype(z.dtype) == np.float16
+
+
+def test_reshape_transpose_methods():
+    x = paddle.to_tensor(np.arange(6).astype("float32"))
+    y = x.reshape([2, 3])
+    assert y.shape == [2, 3]
+    z = y.transpose([1, 0])
+    assert z.shape == [3, 2]
+    assert z.t().shape == [2, 3]
+
+
+def test_reduction_methods():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.sum().item() == 10.0
+    assert x.mean().item() == 2.5
+    assert x.max().item() == 4.0
+    assert x.sum(axis=0).numpy().tolist() == [4.0, 6.0]
+    assert x.sum(axis=1, keepdim=True).shape == [2, 1]
+
+
+def test_item_and_float():
+    x = paddle.to_tensor([3.5])
+    assert float(x) == 3.5
+    assert x.item() == 3.5
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = paddle.clone(x)
+    assert not c.stop_gradient
+
+
+def test_save_load(tmp_path):
+    state = {"w": paddle.ones([2, 2]), "step": 3, "nested": [paddle.zeros([1])]}
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    assert np.allclose(loaded["w"].numpy(), 1.0)
+    assert loaded["step"] == 3
+    assert loaded["nested"][0].shape == [1]
+
+
+def test_repr_does_not_crash():
+    x = paddle.rand([2, 2])
+    assert "Tensor" in repr(x)
