@@ -45,15 +45,15 @@ class GradScaler:
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
-        found = False
+        flags = []
         for p in optimizer._parameter_list:
             if p._grad is None:
                 continue
             g = p._grad * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
+            flags.append(jnp.all(jnp.isfinite(g)))
             p._grad = g
-        self._found_inf = found
+        # one host sync for the whole step, not one per parameter
+        self._found_inf = bool(flags) and not bool(jnp.all(jnp.stack(flags)))
         self._unscaled = True
 
     def unscale_(self, optimizer):

@@ -198,8 +198,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         retain_graph = False
 
     # Snapshot and clear target grads, run backward, collect, restore.
-    saved = [(t, t._grad) for t in inputs]
-    targets = set(id(t) for t in inputs)
+    saved = [(t, t._grad, t._retain_grad_flag) for t in inputs]
     for t in inputs:
         t._grad = None
         t._retain_grad_flag = True
@@ -217,6 +216,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
                 results.append(Tensor(t._grad, stop_gradient=True))
         return results
     finally:
-        for t, g in saved:
+        for t, g, flag in saved:
             t._grad = g
-            t._retain_grad_flag = False
+            t._retain_grad_flag = flag

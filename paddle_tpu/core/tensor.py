@@ -221,7 +221,8 @@ class Tensor:
         device = kwargs.get("device")
         dtype = kwargs.get("dtype")
         for a in args:
-            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or isinstance(a, Place):
+            if isinstance(a, Place) or (isinstance(a, str) and a.split(":")[0] in
+                                        ("cpu", "tpu", "gpu", "xpu")):
                 device = a
             else:
                 dtype = a

@@ -42,6 +42,7 @@ class _PrefetchIterator:
     def __init__(self, produce, num_workers: int, prefetch: int):
         self._q = queue.Queue(maxsize=max(prefetch, 2))
         self._produce = produce
+        self._err = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -52,7 +53,9 @@ class _PrefetchIterator:
                 if self._stop.is_set():
                     return
                 self._q.put(item)
-        finally:
+            self._q.put(self._END)
+        except BaseException as e:  # propagate worker errors to the consumer
+            self._err = e
             self._q.put(self._END)
 
     def __iter__(self):
@@ -61,6 +64,9 @@ class _PrefetchIterator:
     def __next__(self):
         item = self._q.get()
         if item is self._END:
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
             raise StopIteration
         return item
 

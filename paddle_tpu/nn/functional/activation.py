@@ -27,12 +27,9 @@ tanhshrink = make_unary("tanhshrink", lambda x: x - jnp.tanh(x))
 
 
 def relu_(x):
-    out = relu(x)
-    x._data = out.value()
-    x._grad_node = out._grad_node
-    x._out_index = out._out_index
-    x._version += 1
-    return x
+    from ...ops import _rewire_inplace, _snapshot
+    out = relu(_snapshot(x))
+    return _rewire_inplace(x, out)
 
 
 def elu(x, alpha=1.0, name=None):

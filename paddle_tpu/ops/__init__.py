@@ -131,12 +131,10 @@ def _tensor_setitem(self, index, value):
         self._data = new.value()
         self._version += 1
         return
-    out = _op("setitem", self, *tensor_args, value, spec=spec, n_idx=len(tensor_args))
+    out = _op("setitem", _snapshot(self), *tensor_args, value, spec=spec,
+              n_idx=len(tensor_args))
     # in-place semantics with autograd rewiring (reference: inplace ops bump version)
-    self._data = out.value()
-    self._grad_node = out._grad_node
-    self._out_index = out._out_index
-    self._version += 1
+    _rewire_inplace(self, out)
 
 
 # ---------------------------------------------------------------- dunders & methods
@@ -221,14 +219,27 @@ def _ensure(o, like):
     return Tensor(jnp.asarray(o))
 
 
+def _snapshot(t):
+    """Shallow autograd snapshot so an in-place op consumes the OLD node, not a
+    self-loop (the new node must not list its own output tensor as an input)."""
+    snap = Tensor(t.value(), stop_gradient=t.stop_gradient)
+    snap._grad_node = t._grad_node
+    snap._out_index = t._out_index
+    return snap
+
+
+def _rewire_inplace(self, out):
+    self._data = out.value()
+    self._grad_node = out._grad_node
+    self._out_index = out._out_index
+    self._version += 1
+    return self
+
+
 def _make_inplace(fn):
     def inplace(self, *args, **kwargs):
-        out = fn(self, *args, **kwargs)
-        self._data = out.value()
-        self._grad_node = out._grad_node
-        self._out_index = out._out_index
-        self._version += 1
-        return self
+        out = fn(_snapshot(self), *args, **kwargs)
+        return _rewire_inplace(self, out)
     return inplace
 
 

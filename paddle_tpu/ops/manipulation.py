@@ -133,6 +133,10 @@ def split(x, num_or_sections, axis=0, name=None):
     dim = x.shape[axis]
     if isinstance(num_or_sections, int):
         n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"split: axis {axis} size {dim} is not divisible by num {n} "
+                f"(pass explicit section sizes for uneven splits)")
         sizes = [dim // n] * n
     else:
         sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
@@ -654,13 +658,14 @@ def _pad_fwd(x, pad=(), mode="constant", value=0.0, data_format="NCHW"):
     if len(pad) == 2 * nd:
         cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle convention: pad covers trailing spatial dims (reversed pairs like torch)
+        # paddle convention: pairs pad the LAST spatial dim first
+        # (pad_left,pad_right = W, then pad_top,pad_bottom = H, ...)
         n_spatial = len(pad) // 2
         cfg = [(0, 0)] * nd
         if data_format in ("NCHW", "NCL", "NCDHW"):
-            spatial_dims = list(range(nd - n_spatial, nd))
+            spatial_dims = list(range(nd - 1, nd - 1 - n_spatial, -1))
         else:
-            spatial_dims = list(range(1, 1 + n_spatial))
+            spatial_dims = list(range(nd - 2, nd - 2 - n_spatial, -1))
         for i, d in enumerate(spatial_dims):
             cfg[d] = (pad[2 * i], pad[2 * i + 1])
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",

@@ -296,13 +296,14 @@ class AdamW(Adam):
             excluded = [p for p in self._parameter_list
                         if not self._apply_decay_param_fun(p.name)]
             all_params = self._parameter_list
+            saved_step = self._step_count
             try:
                 self._parameter_list = included
                 self._weight_decay = wd
                 super().step()
                 self._parameter_list = excluded
                 self._weight_decay = 0.0
-                self._step_count -= 1  # same logical step for both halves
+                self._step_count = saved_step  # same logical step for both halves
                 super().step()
             finally:
                 self._parameter_list = all_params
