@@ -40,6 +40,9 @@ from . import optimizer  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from .param_attr import ParamAttr  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 
 

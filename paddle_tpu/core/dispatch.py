@@ -99,12 +99,38 @@ def in_trace() -> bool:
     return getattr(_tls, "trace_depth", 0) > 0
 
 
-def push_trace():
+def push_trace(ctx=None):
     _tls.trace_depth = getattr(_tls, "trace_depth", 0) + 1
+    _tls.trace_ctx = ctx
 
 
 def pop_trace():
     _tls.trace_depth = getattr(_tls, "trace_depth", 0) - 1
+    if _tls.trace_depth == 0:
+        _tls.trace_ctx = None
+
+
+def trace_ctx():
+    return getattr(_tls, "trace_ctx", None)
+
+
+class TraceContext:
+    """Collects functional side effects during a to_static trace.
+
+    Reference analog: dy2static captures buffer writes (e.g. BN running stats) as
+    program state vars; here they become extra outputs of the traced pure function,
+    assigned back to the live buffers after each execution.
+    """
+
+    def __init__(self):
+        self.buffer_updates = []  # list of (Tensor, traced_array)
+
+    def record_buffer_update(self, tensor, array):
+        for i, (t, _) in enumerate(self.buffer_updates):
+            if t is tensor:
+                self.buffer_updates[i] = (t, array)
+                return
+        self.buffer_updates.append((tensor, array))
 
 
 # ---------------------------------------------------------------- executable caches

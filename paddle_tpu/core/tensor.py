@@ -202,6 +202,14 @@ class Tensor:
         """In-place value replacement; bumps version so stale autograd saves error out."""
         if tuple(arr.shape) != tuple(self._data.shape):
             raise ValueError(f"in-place shape mismatch {arr.shape} vs {self._data.shape}")
+        from .dispatch import in_trace, trace_ctx
+        if in_trace():
+            ctx = trace_ctx()
+            if ctx is not None:
+                # inside a to_static trace: capture as a functional update instead of
+                # leaking a tracer into live eager state
+                ctx.record_buffer_update(self, arr)
+                return
         self._data = arr
         self._version += 1
 

@@ -1,0 +1,2 @@
+from .api import to_static, not_to_static, save, load, TranslatedLayer, ignore_module  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401

@@ -1,0 +1,137 @@
+"""paddle_tpu.static — compiler-friendly control flow + static-graph parity surface.
+
+The reference's static graph (ProgramDesc + Executor, SURVEY.md §2.2) is replaced by
+trace-and-compile (`paddle_tpu.jit.to_static`): there is no separate program IR to
+build by hand — XLA HLO is the program. What remains here is:
+
+- InputSpec (shared with jit)
+- cond / while_loop / case / switch_case: structured control flow that works BOTH
+  eagerly and inside a to_static trace (lowering to lax.cond/while_loop) — the
+  replacement for the reference's AST transforms of python if/while
+  (jit/dy2static/convert_operators.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import in_trace
+from ..core.tensor import Tensor
+from ..jit.input_spec import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "cond", "while_loop", "case", "switch_case", "Executor",
+           "default_main_program", "name_scope"]
+
+
+def _unwrap(x):
+    return x.value() if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if isinstance(a, jax.Array) else a, tree)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t.value() if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, operands=None):
+    """paddle.static.nn.cond parity; lowers to lax.cond under trace."""
+    operands = operands or []
+    if in_trace():
+        ops_arrays = _unwrap_tree(list(operands))
+
+        def tf(ops):
+            return _unwrap_tree(true_fn(*_wrap_tree(ops)))
+
+        def ff(ops):
+            return _unwrap_tree(false_fn(*_wrap_tree(ops)))
+
+        out = jax.lax.cond(_unwrap(pred).reshape(()), tf, ff, ops_arrays)
+        return _wrap_tree(out)
+    if bool(pred):
+        return true_fn(*operands)
+    return false_fn(*operands)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
+    """paddle.static.nn.while_loop parity; lowers to lax.while_loop under trace."""
+    if in_trace():
+        init = _unwrap_tree(list(loop_vars))
+
+        def c(vs):
+            return _unwrap(cond_fn(*_wrap_tree(vs))).reshape(())
+
+        def b(vs):
+            out = body_fn(*_wrap_tree(vs))
+            return _unwrap_tree(list(out))
+
+        out = jax.lax.while_loop(c, b, init)
+        return _wrap_tree(out)
+    vs = list(loop_vars)
+    while bool(cond_fn(*vs)):
+        vs = list(body_fn(*vs))
+    return vs
+
+
+def case(pred_fn_pairs, default=None):
+    for pred, fn in pred_fn_pairs:
+        if in_trace():
+            raise NotImplementedError("use switch_case with an index under to_static")
+        if bool(pred):
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no case matched and no default provided")
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        fns = [f for _, f in branch_fns] if isinstance(branch_fns[0], tuple) else list(branch_fns)
+    if in_trace():
+        out = jax.lax.switch(_unwrap(branch_index).reshape(()).astype(jnp.int32),
+                             [lambda f=f: _unwrap_tree(f()) for f in fns])
+        return _wrap_tree(out)
+    i = int(branch_index)
+    if 0 <= i < len(fns):
+        return fns[i]()
+    if default is not None:
+        return default()
+    raise IndexError(f"branch index {i} out of range")
+
+
+# ----------------------------------------------------------- compatibility shims
+
+class Executor:
+    """Reference API shim: static Program execution is trace-and-compile here."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, *args, **kwargs):
+        raise NotImplementedError(
+            "paddle_tpu has no ProgramDesc executor; decorate your function with "
+            "@paddle_tpu.jit.to_static and call it — the trace IS the program")
+
+
+def default_main_program():
+    raise NotImplementedError("no ProgramDesc in paddle_tpu; use jit.to_static")
+
+
+class name_scope:
+    def __init__(self, name=""):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

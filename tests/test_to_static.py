@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import to_static
+from paddle_tpu.optimizer import SGD
+
+
+def test_to_static_function():
+    @to_static
+    def f(x, y):
+        return paddle.matmul(x, y) + 1.0
+
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    out = f(a, b)
+    assert out.shape == [2, 4]
+    assert np.allclose(out.numpy(), 4.0)
+    # cache hit on same shapes
+    out2 = f(a, b)
+    assert len(f.concrete_programs) == 1
+    # new shape → new program
+    f(paddle.ones([5, 3]), b)
+    assert len(f.concrete_programs) == 2
+
+
+def test_to_static_layer_training():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = to_static(Net())
+    x = paddle.randn([4, 8])
+    label = paddle.to_tensor(np.array([0, 1, 0, 1]))
+    opt = SGD(learning_rate=0.1, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    losses = []
+    for _ in range(30):
+        out = net(x)
+        loss = loss_fn(out, label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_to_static_matches_eager():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return F.gelu(self.fc(x)) * 2
+
+    net = Net()
+    x = paddle.randn([3, 4])
+    eager_out = net(x)
+    snet = to_static(net)
+    static_out = snet(x)
+    assert np.allclose(eager_out.numpy(), static_out.numpy(), atol=1e-5)
+
+
+def test_to_static_bn_buffer_updates():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            return self.bn(x)
+
+    net = to_static(Net())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype("float32") + 3)
+    before = net.bn._mean.numpy().copy()
+    net(x)
+    after = net.bn._mean.numpy()
+    assert not np.allclose(before, after), "BN running mean must update through trace"
+
+
+def test_static_cond_in_trace():
+    from paddle_tpu.static import cond
+
+    @to_static
+    def f(x):
+        return cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+    out = f(paddle.ones([3]))
+    assert np.allclose(out.numpy(), 2.0)
+    out2 = f(paddle.full([3], -1.0))
+    assert np.allclose(out2.numpy(), -2.0)
+
+
+def test_static_while_loop_in_trace():
+    from paddle_tpu.static import while_loop
+
+    @to_static
+    def f(n):
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(0)
+        i, s, n = while_loop(lambda i, s, n: i < n,
+                             lambda i, s, n: (i + 1, s + i, n), [i, s, n])
+        return s
+
+    out = f(paddle.to_tensor(5))
+    assert int(out) == 10
+
+
+def test_jit_save_load(tmp_path):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return F.softmax(self.fc(x))
+
+    net = Net()
+    net.eval()
+    x = paddle.randn([2, 4])
+    expect = net(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[paddle.static.InputSpec([2, 4])])
+    loaded = paddle.jit.load(path)
+    got = loaded(x).numpy()
+    assert np.allclose(expect, got, atol=1e-6)
