@@ -44,6 +44,8 @@ from . import jit  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import models  # noqa: F401,E402
 
 
 def disable_static(place=None):  # parity no-op: eager is the default (and only) base mode

@@ -14,6 +14,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 
 
+_MULTI_DEVICE = None  # lazily cached: device-set checks are per-op bwd overhead
+
+
 class GradNode:
     """One node of the reverse graph: knows how to turn output cotangents into input grads."""
 
@@ -42,6 +45,46 @@ class GradNode:
                     f"(version {t._version} != saved {v}); this would produce wrong "
                     f"gradients (reference analog: TensorWrapper inplace version check)")
 
+    def _align_cotangent_devices(self, cotangents: Tuple) -> Tuple:
+        """Pipeline backward p2p: when this node's saved primals live on a different
+        device set than an incoming cotangent (stage boundary), re-place the
+        cotangent onto the primals' devices — the reverse of the forward's
+        activation transfer (reference: p2p_communication send_backward)."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        global _MULTI_DEVICE
+        if _MULTI_DEVICE is None:
+            _MULTI_DEVICE = _jax.device_count() > 1
+        if not _MULTI_DEVICE:
+            return cotangents  # stage boundaries cannot exist on one device
+
+        ref = None
+        all_devs = set()
+        try:
+            for p in (self.saved_primals or ()):
+                if isinstance(p, _jax.Array):
+                    devs = p.sharding.device_set
+                    all_devs |= devs
+                    if ref is None or len(devs) > len(ref.sharding.device_set):
+                        ref = p
+        except Exception:
+            return cotangents
+        if ref is None:
+            return cotangents
+        out = []
+        for c in cotangents:
+            # only a DISJOINT device set marks a stage boundary; overlapping sets
+            # (e.g. single-device input + mesh-wide weight) are jit-compatible
+            if (isinstance(c, _jax.Array)
+                    and not (c.sharding.device_set & all_devs)):
+                sh = ref.sharding
+                target = (NamedSharding(sh.mesh, _P())
+                          if isinstance(sh, NamedSharding) else sh)
+                c = _jax.device_put(c, target)
+            out.append(c)
+        return tuple(out)
+
     def run(self, cotangents: Tuple) -> List:
         """Returns list of (input_tensor, grad_array) pairs for diff inputs."""
         if self.released:
@@ -49,6 +92,7 @@ class GradNode:
                 f"trying to run backward of {self.name} a second time "
                 f"(specify retain_graph=True the first time)")
         self.check_versions()
+        cotangents = self._align_cotangent_devices(cotangents)
         if self.mode == "explicit":
             grads = self.bwd_fn(self.saved_primals, self.saved_outs, cotangents)
             grads = [grads[i] for i in self.diff_idx]

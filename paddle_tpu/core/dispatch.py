@@ -124,13 +124,21 @@ class TraceContext:
 
     def __init__(self):
         self.buffer_updates = []  # list of (Tensor, traced_array)
+        self.saved_data = {}      # id(Tensor) -> (tensor, pre-trace concrete array)
 
     def record_buffer_update(self, tensor, array):
+        if id(tensor) not in self.saved_data:
+            self.saved_data[id(tensor)] = (tensor, tensor._data)
         for i, (t, _) in enumerate(self.buffer_updates):
             if t is tensor:
                 self.buffer_updates[i] = (t, array)
                 return
         self.buffer_updates.append((tensor, array))
+
+    def restore(self):
+        """Undo in-trace mutations so no tracer leaks into live eager state."""
+        for t, original in self.saved_data.values():
+            t._data = original
 
 
 # ---------------------------------------------------------------- executable caches

@@ -15,13 +15,32 @@ from contextlib import contextmanager
 import jax
 
 _lock = threading.Lock()
-_state = {"key": jax.random.PRNGKey(0), "seed": 0}
+_state = {"seed": 0}
+_rng_tensor = None  # the single source of truth for the key, once materialized
+
+
+def rng_state_tensor():
+    """The global key as a Tensor, so to_static can thread it as program state.
+
+    Traced programs take it as an input and return its advanced value as an update
+    (like BN running stats) — this keeps dropout patterns fresh per step in compiled
+    programs instead of baking the trace-time mask in as a constant.
+    """
+    global _rng_tensor
+    if _rng_tensor is None:
+        from .tensor import Tensor
+        _rng_tensor = Tensor(jax.random.PRNGKey(_state["seed"]))
+        _rng_tensor.name = "__global_rng_state__"
+        _rng_tensor.persistable = True
+    return _rng_tensor
 
 
 def seed(value: int):
+    import numpy as _np
     with _lock:
-        _state["key"] = jax.random.PRNGKey(int(value))
         _state["seed"] = int(value)
+        rng_state_tensor()._data = jax.random.PRNGKey(int(value))
+        _host["gen"] = _np.random.default_rng(int(value))
     return value
 
 
@@ -30,19 +49,48 @@ def get_seed() -> int:
 
 
 def split_key():
-    """Return a fresh subkey, advancing the global chain."""
+    """Return a fresh subkey, advancing the global chain (traced or eager)."""
+    from .dispatch import in_trace, trace_ctx
+    t = rng_state_tensor()
+    if in_trace():
+        new_key, sub = jax.random.split(t._data)
+        ctx = trace_ctx()
+        if ctx is not None:
+            # record BEFORE mutating so TraceContext.saved_data snapshots the
+            # pre-trace key (ctx.restore() must never put a tracer back)
+            ctx.record_buffer_update(t, new_key)
+        t._data = new_key  # chain within the trace
+        return sub
     with _lock:
-        _state["key"], sub = jax.random.split(_state["key"])
+        new_key, sub = jax.random.split(t._data)
+        t._data = new_key
     return sub
 
 
+_host = {"gen": None}
+
+
+def host_generator():
+    """Host-side numpy Generator seeded with the global seed.
+
+    Weight INITIALIZATION samples here (reference inits are host-side too): a device
+    round-trip + XLA compile per parameter shape is pure overhead at build time.
+    The device key chain (split_key) stays the source for runtime randomness
+    (dropout), where values must be drawable inside compiled programs.
+    """
+    import numpy as _np
+    if _host["gen"] is None:
+        _host["gen"] = _np.random.default_rng(_state["seed"])
+    return _host["gen"]
+
+
 def get_rng_state():
-    return _state["key"]
+    return rng_state_tensor()._data
 
 
 def set_rng_state(key):
     with _lock:
-        _state["key"] = key
+        rng_state_tensor()._data = key
 
 
 class RNGStatesTracker:
@@ -75,15 +123,16 @@ class RNGStatesTracker:
         """Within the context, the global chain is swapped for the named chain."""
         if name not in self.states_:
             raise KeyError(f"rng state {name!r} not registered")
+        t = rng_state_tensor()
         with _lock:
-            saved = _state["key"]
-            _state["key"] = self.states_[name]
+            saved = t._data
+            t._data = self.states_[name]
         try:
             yield
         finally:
             with _lock:
-                self.states_[name] = _state["key"]
-                _state["key"] = saved
+                self.states_[name] = t._data
+                t._data = saved
 
 
 _TRACKER = RNGStatesTracker()

@@ -206,15 +206,22 @@ class Tensor:
         if in_trace():
             ctx = trace_ctx()
             if ctx is not None:
-                # inside a to_static trace: capture as a functional update instead of
-                # leaking a tracer into live eager state
+                # inside a to_static trace: capture as a functional update; also set
+                # _data so later in-trace reads chain off the new value (TraceContext
+                # .restore() un-leaks the tracer when the trace ends)
                 ctx.record_buffer_update(self, arr)
+                self._data = arr
                 return
         self._data = arr
         self._version += 1
 
     def set_value(self, value):
-        arr = value.value() if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+        if isinstance(value, Tensor):
+            arr = value.value()
+        elif isinstance(value, jax.Array):
+            arr = value  # keep on device — np.asarray here would round-trip HBM→host
+        else:
+            arr = jnp.asarray(np.asarray(value))
         if arr.dtype != self._data.dtype:
             arr = arr.astype(self._data.dtype)
         self._set_value_inplace(arr)

@@ -83,12 +83,14 @@ class ConcreteProgram:
     Reference: ConcreteProgram (program_translator.py:974).
     """
 
-    def __init__(self, op_name, params, buffers, out_spec, n_updates):
+    def __init__(self, op_name, params, buffers, out_spec, n_updates,
+                 in_buffers=None):
         self.op_name = op_name
         self.params = params          # captured Parameter objects, in order
         self.buffers = buffers        # captured buffer Tensors whose updates are outputs
         self.out_spec = out_spec
         self.n_updates = n_updates
+        self.in_buffers = in_buffers or []  # state tensors fed as inputs each run
 
 
 class StaticFunction:
@@ -110,6 +112,8 @@ class StaticFunction:
     # ------------------------------------------------------------------ trace
 
     def _trace(self, args, kwargs, arg_tensors, struct_spec):
+        from ..core import random as _random
+
         layer = self._instance
         params: List[Parameter] = []
         if isinstance(layer, Layer):
@@ -117,15 +121,21 @@ class StaticFunction:
             buffer_list = [b for _, b in layer.named_buffers()]
         else:
             buffer_list = []
+        # thread mutable state as traced INPUTS (reads must not bake trace-time
+        # constants in — BN running stats, and the global RNG chain so dropout draws
+        # fresh masks per execution)
+        buffer_list = buffer_list + [_random.rng_state_tensor()]
         op_name = f"run_program_{next(_counter)}"
         n_params = len(params)
+        n_buffers = len(buffer_list)
         n_inputs = len(arg_tensors)
         out_spec_holder = {}
         ctx_holder = {}
 
         def pure_fn(*arrays):
             param_arrays = arrays[:n_params]
-            input_arrays = arrays[n_params:]
+            buffer_arrays = arrays[n_params:n_params + n_buffers]
+            input_arrays = arrays[n_params + n_buffers:]
             ctx = dispatch.TraceContext()
             saved_param_data = [p._data for p in params]
             saved_buf_data = [b._data for b in buffer_list]
@@ -133,6 +143,8 @@ class StaticFunction:
             try:
                 for p, a in zip(params, param_arrays):
                     p._data = a
+                for b, a in zip(buffer_list, buffer_arrays):
+                    b._data = a
                 input_tensors = []
                 for i, a in enumerate(input_arrays):
                     t = Tensor.__new__(Tensor)
@@ -160,6 +172,7 @@ class StaticFunction:
                 return tuple(t.value() for t in out_tensors) + tuple(update_arrays)
             finally:
                 dispatch.pop_trace()
+                ctx.restore()  # tensors mutated mid-trace (incl. non-buffer state)
                 for p, d in zip(params, saved_param_data):
                     p._data = d
                 for b, d in zip(buffer_list, saved_buf_data):
@@ -167,13 +180,15 @@ class StaticFunction:
 
         # run an abstract trace once to fix output structure & updates
         abstract_in = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype) for p in params] \
+            + [jax.ShapeDtypeStruct(tuple(b.shape), b.dtype) for b in buffer_list] \
             + [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in arg_tensors]
         jax.eval_shape(pure_fn, *abstract_in)
 
         register_op(op_name, pure_fn)
         return ConcreteProgram(op_name, params, ctx_holder.get("buffers", []),
                                out_spec_holder["spec"],
-                               len(ctx_holder.get("buffers", [])))
+                               len(ctx_holder.get("buffers", [])),
+                               in_buffers=buffer_list)
 
     # ------------------------------------------------------------------ call
 
@@ -188,7 +203,7 @@ class StaticFunction:
         if program is None:
             program = self._trace(args, kwargs, arg_tensors, struct_spec)
             self._cache[key] = program
-        all_inputs = list(program.params) + arg_tensors
+        all_inputs = list(program.params) + list(program.in_buffers) + arg_tensors
         outs = apply_op(program.op_name, all_inputs, {})
         outs = outs if isinstance(outs, tuple) else (outs,)
         n_real = len(outs) - program.n_updates
@@ -293,6 +308,7 @@ def save(layer, path, input_spec=None, **configs):
                 return tuple(t.value() for t in outs)
             finally:
                 dispatch.pop_trace()
+                ctx.restore()  # un-leak tensors mutated mid-trace (e.g. RNG state)
                 for p, d in zip(params, saved):
                     p._data = d
                 for b, d in zip(buffers, saved_b):

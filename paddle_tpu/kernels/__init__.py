@@ -1,0 +1,8 @@
+"""Custom TPU kernels (Pallas).
+
+Reference analog: the hand-written CUDA corpus under /root/reference/paddle/phi/kernels/
+gpu and /root/reference/paddle/fluid/operators/fused/. On TPU almost all of that corpus
+is XLA's job; Pallas is reserved for the ops where a hand schedule beats the compiler —
+flash attention (reference: phi/kernels/flash_attn_kernel.h dynload'd library) being the
+canonical one.
+"""

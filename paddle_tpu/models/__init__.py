@@ -1,0 +1,9 @@
+"""Flagship model families for the benchmark configs (BASELINE.md).
+
+The reference ships transformers in python/paddle/nn/layer/transformer.py and fused
+variants in incubate; full LM architectures (GPT/BERT/ERNIE) live in PaddleNLP built on
+those layers. Here they are first-class since they are the benchmark configs: GPT
+(decoder LM, the north-star config) and BERT (encoder, the to_static config).
+"""
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_tiny  # noqa: F401
+from .bert import BertConfig, BertModel, BertForPreTraining, bert_base, bert_tiny  # noqa: F401

@@ -1,0 +1,153 @@
+"""BERT encoder LM — the to_static benchmark config (BASELINE.md config 2).
+
+Post-LN transformer encoder per the original BERT recipe, with MLM + NSP pretraining
+heads. Built on paddle_tpu.nn (reference surface: nn.TransformerEncoder,
+/root/reference/python/paddle/nn/layer/transformer.py:137 — full architectures live in
+PaddleNLP; here they are first-class benchmark models).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from .. import ops
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30528            # 30522 padded to a multiple of 64
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def bert_base(**overrides) -> "BertConfig":
+    cfg = dict()
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+def bert_tiny(**overrides) -> "BertConfig":
+    cfg = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+               intermediate_size=128, max_position_embeddings=128)
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
+                                                config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertLayer(nn.Layer):
+    """Post-LN encoder block (attention → add&norm → FFN → add&norm)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.qkv_proj = nn.Linear(config.hidden_size, 3 * config.hidden_size)
+        self.out_proj = nn.Linear(config.hidden_size, config.hidden_size)
+        self.attn_norm = nn.LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_epsilon)
+        self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.ffn_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.attn_dropout_p = config.attention_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(2)
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_p if self.training else 0.0)
+        attn = self.out_proj(attn.reshape([b, s, h]))
+        x = self.attn_norm(x + self.dropout(attn))
+        ffn = self.fc_out(F.gelu(self.fc_in(x)))
+        return self.ffn_norm(x + self.dropout(ffn))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(config)
+                                     for _ in range(config.num_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+        self._init_weights(config)
+
+    def _init_weights(self, config):
+        normal = nn.initializer.Normal(mean=0.0, std=config.initializer_range)
+        for _, p in self.named_parameters():
+            if p.ndim >= 2:
+                p.set_value(normal(tuple(p.shape), p.dtype))
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPreTraining(nn.Layer):
+    """MLM (tied decoder) + NSP heads; forward returns (mlm_logits, nsp_logits) or the
+    summed pretraining loss when labels are given."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = nn.LayerNorm(config.hidden_size,
+                                           epsilon=config.layer_norm_epsilon)
+        self.decoder_bias = self.create_parameter(
+            shape=[config.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids, attn_mask)
+        x = self.transform_norm(F.gelu(self.transform(seq_out)))
+        mlm_logits = ops.matmul(x, self.bert.embeddings.word_embeddings.weight,
+                                transpose_y=True) + self.decoder_bias
+        nsp_logits = self.nsp_head(pooled)
+        if masked_lm_labels is None:
+            return mlm_logits, nsp_logits
+        mlm_loss = F.cross_entropy(
+            mlm_logits.reshape([-1, self.config.vocab_size]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100)
+        loss = mlm_loss
+        if next_sentence_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits,
+                                          next_sentence_labels.reshape([-1]))
+        return loss

@@ -1,0 +1,174 @@
+"""GPT decoder-only LM — the flagship / north-star model (BASELINE.md config 4).
+
+Architecture follows the GPT-3 recipe (pre-LN transformer decoder, learned position
+embeddings, GELU MLP with 4x width, tied LM head). Built on paddle_tpu.nn layers so the
+same module runs eager, under @to_static, and under mesh sharding (the distributed
+wrappers re-place parameter arrays with NamedShardings; see
+paddle_tpu/distributed/fleet/meta_parallel).
+
+Reference analogs: nn.TransformerDecoderLayer surface
+(/root/reference/python/paddle/nn/layer/transformer.py) and the fused incubate stack
+(/root/reference/python/paddle/incubate/nn/layer/fused_transformer.py:1021
+FusedMultiTransformer) — here fusion is XLA's job, and attention uses
+F.scaled_dot_product_attention (Pallas flash path on real TPUs).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from .. import ops
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304            # 50257 padded to a multiple of 128 for the MXU
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 2048
+    intermediate_size: int = 0         # 0 → 4*hidden
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+def gpt3_1p3b(**overrides) -> "GPTConfig":
+    """GPT-3 XL, 1.3B params: 24 layers, d=2048, 16 heads (BASELINE north star)."""
+    cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+               max_position_embeddings=2048)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+def gpt_tiny(**overrides) -> "GPTConfig":
+    """Tiny config for tests / dryruns."""
+    cfg = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+               max_position_embeddings=128)
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.qkv_proj = nn.Linear(config.hidden_size, 3 * config.hidden_size)
+        self.out_proj = nn.Linear(config.hidden_size, config.hidden_size)
+        self.dropout_p = config.attention_dropout_prob
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(2)          # each [b, s, heads, head_dim]
+        drop = self.dropout_p if self.training else 0.0
+        if self.use_flash and attn_mask is None and drop == 0.0:
+            # Pallas flash kernel on real TPUs (auto-detected); XLA sdpa otherwise
+            out = F.flash_attention(q, k, v, causal=True)
+        else:
+            # always causal; attn_mask (e.g. additive padding mask) combines with it
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=drop, training=self.training,
+                is_causal=True)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN decoder block."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.dropout(self.attn(self.ln_1(x), attn_mask))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self._init_weights(config)
+
+    def _init_weights(self, config):
+        std = config.initializer_range
+        normal = nn.initializer.Normal(mean=0.0, std=std)
+        resid_scale = nn.initializer.Normal(
+            mean=0.0, std=std / math.sqrt(2.0 * config.num_layers))
+        for name, p in self.named_parameters():
+            if p.ndim >= 2:
+                # GPT-2/3 init: residual-out projections scaled by 1/sqrt(2L)
+                init = (resid_scale if name.endswith(("out_proj.weight",
+                                                      "fc_out.weight")) else normal)
+                p.set_value(init(tuple(p.shape), p.dtype))
+
+    def forward(self, input_ids, attn_mask=None):
+        b, s = input_ids.shape
+        pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head on GPTModel; loss = shifted next-token cross-entropy."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None  # reuse wte
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.gpt(input_ids, attn_mask)
+        if self.lm_head is None:
+            logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        loss = F.cross_entropy(
+            shift_logits.reshape([-1, self.config.vocab_size]),
+            shift_labels.reshape([-1]))
+        return logits, loss

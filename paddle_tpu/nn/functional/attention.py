@@ -12,15 +12,17 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ...core import random as rng
 from ...core.dispatch import register_op
+from ...core.tensor import Tensor
 from ...ops._helpers import _op
 
 __all__ = ["scaled_dot_product_attention", "flash_attention"]
 
 
 def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
-              dropout_p=0.0):
-    # q,k,v: [B, L, H, D] (paddle flash_attn layout)
+              has_dropkey=False, dropout_p=0.0):
+    # q,k,v: [B, L, H, D] (paddle flash_attn layout); rest = [attn_mask][prng_key]
     qt = jnp.swapaxes(q, 1, 2)  # [B,H,L,D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -35,21 +37,46 @@ def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
         cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
         logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits, axis=-1)
+    if has_dropkey:
+        # dropout mask drawn inside the op from the key input — fused by XLA, fresh
+        # per execution under to_static (key is threaded program state)
+        key = rest[1] if has_mask else rest[0]
+        keep = jax.random.bernoulli(jax.random.wrap_key_data(key),
+                                    1.0 - dropout_p, probs.shape)
+        probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_p)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)  # back to [B,L,H,D]
 
 
-register_op("sdpa", _sdpa_fwd)
+register_op("sdpa", _sdpa_fwd, nondiff_inputs=(3, 4))
+
+
+def _flash_attn_pallas_fwd(q, k, v, causal=False):
+    from ...kernels.pallas.flash_attention import flash_attention_blhd
+    return flash_attention_blhd(q, k, v, causal=causal)
+
+
+# Pallas flash attention as a dispatch op: flows through the autograd tape; its
+# custom_vjp supplies the gradient under the generic jit(vjp) backward.
+register_op("flash_attn_pallas", _flash_attn_pallas_fwd)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
-    """paddle.nn.functional.scaled_dot_product_attention parity: [B, L, H, D] layout."""
+    """paddle.nn.functional.scaled_dot_product_attention parity: [B, L, H, D] layout.
+
+    Attention dropout follows the eager-dropout recipe (functional/common.py): the keep
+    mask is drawn host-side from the global RNG chain and passed as a nondiff input, so
+    the op stays a pure function of its inputs (cacheable executable)."""
     args = [query, key, value]
     if attn_mask is not None:
         args.append(attn_mask)
+    drop = float(dropout_p) if training else 0.0
+    if drop > 0.0:
+        args.append(Tensor(jax.random.key_data(rng.split_key())))
     return _op("sdpa", *args, causal=bool(is_causal), scale=None,
-               has_mask=attn_mask is not None, dropout_p=float(dropout_p))
+               has_mask=attn_mask is not None, has_dropkey=drop > 0.0,
+               dropout_p=drop)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -61,27 +88,33 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     flash-attention kernel; otherwise falls back to the XLA softmax-chain (which XLA
     fuses into a flash-like schedule anyway for moderate L).
     """
+    drop = float(dropout) if training else 0.0
+    if use_pallas and drop > 0.0:
+        raise ValueError("the Pallas flash-attention kernel has no dropout path; "
+                         "use dropout=0.0 or use_pallas=False")
     if use_pallas is None:
-        use_pallas = _pallas_usable(query)
+        # the pallas kernel has no dropout path; fall back when dropout is active
+        use_pallas = drop == 0.0 and _pallas_usable(query)
     if use_pallas:
-        from ...kernels.pallas.flash_attention import flash_attention_blhd
-        out = flash_attention_blhd(query, key, value, causal=causal)
-        if return_softmax:
-            return out, None
-        return out
-    out = _op("sdpa", query, key, value, causal=bool(causal), scale=None,
-              has_mask=False, dropout_p=float(dropout))
+        out = _op("flash_attn_pallas", query, key, value, causal=bool(causal))
+    else:
+        out = scaled_dot_product_attention(query, key, value, dropout_p=drop,
+                                           is_causal=bool(causal),
+                                           training=training)
     if return_softmax:
         return out, None
     return out
 
 
 def _pallas_usable(q):
-    try:
-        dev = q.value().devices() if hasattr(q, "value") else set()
-        if not any(d.platform in ("tpu",) for d in dev):
-            return False
-    except Exception:
-        return False
     shape = q.shape
-    return len(shape) == 4 and shape[1] >= 128 and shape[3] >= 64
+    if not (len(shape) == 4 and shape[1] >= 128 and shape[3] >= 64):
+        return False
+    arr = q.value() if hasattr(q, "value") else q
+    try:
+        devs = arr.devices()  # concrete array: decide by actual placement
+        return any(d.platform == "tpu" for d in devs)
+    except Exception:
+        # tracer (to_static / jit): no placement yet — decide by default backend,
+        # which is where the compiled program will run
+        return jax.default_backend() == "tpu"

@@ -34,7 +34,13 @@ def linear(x, weight, bias=None, name=None):
     return _op("linear", *args, has_bias=bias is not None)
 
 
-def _dropout_fwd(x, mask, p=0.5, mode="upscale_in_train"):
+def _dropout_fwd(x, key, p=0.5, mode="upscale_in_train", mask_shape=None):
+    # key is an input (8-byte PRNG key), mask drawn INSIDE the op: XLA fuses mask
+    # generation (no [x.shape] host→device mask transfer), and under to_static the
+    # key is threaded program state so each execution gets a fresh pattern
+    shape = mask_shape if mask_shape is not None else x.shape
+    mask = jax.random.bernoulli(jax.random.wrap_key_data(key), 1.0 - p, shape)
+    mask = jnp.broadcast_to(mask, x.shape)
     if mode == "upscale_in_train":
         return jnp.where(mask, x / (1.0 - p), 0.0)
     return jnp.where(mask, x, 0.0)
@@ -48,13 +54,13 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         if mode == "downscale_in_infer" and not training:
             return x * (1.0 - p)
         return x
-    shape = tuple(x.shape)
+    mask_shape = None
     if axis is not None:
         axes = static_int_list(axis)
-        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
-    keep = jax.random.bernoulli(rng.split_key(), 1.0 - float(p), shape)
-    mask = Tensor(jnp.broadcast_to(keep, tuple(x.shape)))
-    return _op("dropout", x, mask, p=float(p), mode=str(mode))
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    key = Tensor(jax.random.key_data(rng.split_key()))
+    return _op("dropout", x, key, p=float(p), mode=str(mode),
+               mask_shape=mask_shape)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

@@ -27,13 +27,21 @@ class Constant(Initializer):
         return jnp.full(shape, self.value, dtype)
 
 
+def _host_sample(sampler, shape, dtype):
+    # host-side sampling (rng.host_generator docstring: avoids one XLA compile per
+    # parameter shape at model-build time); ONE host→device push, no round-trips
+    arr = np.asarray(sampler(rng.host_generator(), shape), np.float32)
+    return jax.device_put(arr).astype(dtype) if str(dtype) != "float32" \
+        else jax.device_put(arr)
+
+
 class Uniform(Initializer):
     def __init__(self, low=-1.0, high=1.0):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype):
-        return jax.random.uniform(rng.split_key(), shape, dtype,
-                                  minval=self.low, maxval=self.high)
+        return _host_sample(
+            lambda g, s: g.uniform(self.low, self.high, s), shape, dtype)
 
 
 class Normal(Initializer):
@@ -41,7 +49,8 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype):
-        return jax.random.normal(rng.split_key(), shape, dtype) * self.std + self.mean
+        return _host_sample(
+            lambda g, s: g.normal(self.mean, self.std, s), shape, dtype)
 
 
 class TruncatedNormal(Initializer):
@@ -49,8 +58,14 @@ class TruncatedNormal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype):
-        z = jax.random.truncated_normal(rng.split_key(), -2.0, 2.0, shape, dtype)
-        return z * self.std + self.mean
+        def trunc(g, s):
+            z = g.normal(0.0, 1.0, s)
+            bad = np.abs(z) > 2.0
+            while bad.any():
+                z[bad] = g.normal(0.0, 1.0, bad.sum())
+                bad = np.abs(z) > 2.0
+            return z * self.std + self.mean
+        return _host_sample(trunc, shape, dtype)
 
 
 def calculate_fan(shape):
@@ -88,7 +103,7 @@ class XavierUniform(Initializer):
         fi = self.fan_in or fi
         fo = self.fan_out or fo
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
-        return jax.random.uniform(rng.split_key(), shape, dtype, -limit, limit)
+        return _host_sample(lambda g, s: g.uniform(-limit, limit, s), shape, dtype)
 
 
 class XavierNormal(Initializer):
@@ -100,7 +115,7 @@ class XavierNormal(Initializer):
         fi = self.fan_in or fi
         fo = self.fan_out or fo
         std = self.gain * math.sqrt(2.0 / (fi + fo))
-        return jax.random.normal(rng.split_key(), shape, dtype) * std
+        return _host_sample(lambda g, s: g.normal(0.0, std, s), shape, dtype)
 
 
 class KaimingUniform(Initializer):
@@ -114,7 +129,7 @@ class KaimingUniform(Initializer):
         fi = self.fan_in or fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
         limit = gain * math.sqrt(3.0 / fi)
-        return jax.random.uniform(rng.split_key(), shape, dtype, -limit, limit)
+        return _host_sample(lambda g, s: g.uniform(-limit, limit, s), shape, dtype)
 
 
 class KaimingNormal(Initializer):
@@ -128,7 +143,7 @@ class KaimingNormal(Initializer):
         fi = self.fan_in or fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
         std = gain / math.sqrt(fi)
-        return jax.random.normal(rng.split_key(), shape, dtype) * std
+        return _host_sample(lambda g, s: g.normal(0.0, std, s), shape, dtype)
 
 
 class Assign(Initializer):

@@ -1,0 +1,8 @@
+"""Vision datasets (reference: python/paddle/vision/datasets).
+
+Zero-egress environment: datasets load from a local `data_file` when given; with
+`backend="synthetic"` (or when no file exists and `download=True` is impossible) they
+generate deterministic synthetic samples with the real shapes/dtypes/label ranges so
+training pipelines and benchmarks run unmodified.
+"""
+from .datasets import MNIST, FashionMNIST, Cifar10, Cifar100, DatasetFolder  # noqa: F401

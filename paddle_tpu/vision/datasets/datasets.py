@@ -1,0 +1,176 @@
+"""Dataset implementations (reference: python/paddle/vision/datasets/{mnist,cifar}.py)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+class _SyntheticImageDataset(Dataset):
+    """Deterministic synthetic images: same shapes/dtypes/label space as the real set."""
+
+    _SHAPE = (28, 28)
+    _CLASSES = 10
+    _TRAIN_N = 60000
+    _TEST_N = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "numpy"
+        n = self._TRAIN_N if self.mode == "train" else self._TEST_N
+        # cap synthetic size so tests/benches don't materialize 60k images eagerly
+        self._n = min(n, int(os.environ.get("PADDLE_TPU_SYNTH_DATASET_CAP", "2048")))
+        self._rng_seed = 0 if self.mode == "train" else 1
+
+    def __len__(self):
+        return self._n
+
+    def _gen(self, idx):
+        rng = np.random.RandomState((self._rng_seed << 24) ^ idx)
+        img = rng.randint(0, 256, size=self._SHAPE + (1,)).astype(np.uint8)
+        label = np.array([idx % self._CLASSES], dtype=np.int64)
+        return img, label
+
+    def __getitem__(self, idx):
+        img, label = self._gen(idx)
+        if img.shape[-1] == 1:
+            img = img[:, :, 0]  # grayscale HW, reference MNIST returns HW image
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(_SyntheticImageDataset):
+    """MNIST; loads idx files when image_path/label_path given, else synthetic."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(image_path, label_path, mode, transform, download, backend)
+        self._images = self._labels = None
+        if (image_path and label_path and os.path.exists(image_path)
+                and os.path.exists(label_path)):
+            self._load_idx(image_path, label_path)
+
+    def _load_idx(self, image_path, label_path):
+        with open(image_path, "rb") as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], "big")
+        self._images = np.frombuffer(data, np.uint8, offset=16).reshape(n, 28, 28)
+        with open(label_path, "rb") as f:
+            ldata = f.read()
+        self._labels = np.frombuffer(ldata, np.uint8, offset=8).astype(np.int64)
+        self._n = n
+
+    def __getitem__(self, idx):
+        if self._images is not None:
+            img = self._images[idx]
+            label = np.array([self._labels[idx]], dtype=np.int64)
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, label
+        return super().__getitem__(idx)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImageDataset):
+    _SHAPE = (32, 32, 3)
+    _CLASSES = 10
+    _TRAIN_N = 50000
+    _TEST_N = 10000
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend=None):
+        super().__init__(None, None, mode, transform, download, backend)
+        self._data = None
+        if data_file and os.path.exists(data_file):
+            self._load(data_file)
+
+    _MEMBER_NAMES = {"train": [f"data_batch_{i}" for i in range(1, 6)],
+                     "test": ["test_batch"]}
+
+    def _load(self, data_file):
+        import tarfile
+        images, labels = [], []
+        names = self._MEMBER_NAMES["train" if self.mode == "train" else "test"]
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images.append(batch[b"data"])
+                    key = b"labels" if b"labels" in batch else b"fine_labels"
+                    labels.extend(batch[key])
+        self._data = (np.concatenate(images).reshape(-1, 3, 32, 32)
+                      .transpose(0, 2, 3, 1))
+        self._labels = np.asarray(labels, np.int64)
+        self._n = len(self._labels)
+
+    def _gen(self, idx):
+        rng = np.random.RandomState((self._rng_seed << 24) ^ idx)
+        img = rng.randint(0, 256, size=self._SHAPE).astype(np.uint8)
+        return img, np.array([idx % self._CLASSES], dtype=np.int64)
+
+    def __getitem__(self, idx):
+        if self._data is not None:
+            img = self._data[idx]
+            label = np.array([self._labels[idx]], dtype=np.int64)
+        else:
+            img, label = self._gen(idx)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class Cifar100(Cifar10):
+    _CLASSES = 100
+    # cifar-100-python archives name their members train/test, not data_batch_*
+    _MEMBER_NAMES = {"train": ["train"], "test": ["test"]}
+
+
+class DatasetFolder(Dataset):
+    """Directory-of-class-subdirs dataset (reference DatasetFolder); loader must be
+    provided since PIL is not assumed present."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.asarray(
+            __import__("PIL.Image", fromlist=["Image"]).open(p).convert("RGB")))
+        extensions = tuple(extensions) if extensions else (
+            ".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        if path.endswith(".npy"):
+            sample = np.load(path)
+        else:
+            sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.array([target], dtype=np.int64)

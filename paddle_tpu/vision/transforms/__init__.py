@@ -1,0 +1,10 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/transforms.py).
+
+NumPy-host implementations: transforms run in DataLoader workers on CPU (same as the
+reference, where transforms are python/PIL/cv2); the device only sees batched arrays.
+"""
+from .transforms import (  # noqa: F401
+    Compose, Resize, RandomCrop, CenterCrop, RandomHorizontalFlip,
+    RandomVerticalFlip, Normalize, Transpose, ToTensor, Pad, BrightnessTransform,
+    ContrastTransform, RandomResizedCrop,
+)

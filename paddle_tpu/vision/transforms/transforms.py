@@ -1,0 +1,219 @@
+"""NumPy-based image transforms.
+
+Reference analog: python/paddle/vision/transforms/transforms.py. Images are HWC numpy
+arrays (uint8 or float32); ToTensor/Transpose produce CHW float arrays, matching the
+reference's default `Transpose` + `Normalize` pipeline semantics.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "Resize", "RandomCrop", "CenterCrop", "RandomHorizontalFlip",
+    "RandomVerticalFlip", "Normalize", "Transpose", "ToTensor", "Pad",
+    "BrightnessTransform", "ContrastTransform", "RandomResizedCrop",
+]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def _resize(img, size):
+    """Nearest-neighbor resize (no PIL/cv2 dependency; adequate for training-data
+    pipelines and tests)."""
+    img = _as_hwc(img)
+    if isinstance(size, numbers.Number):
+        h, w = img.shape[:2]
+        if h <= w:
+            size = (int(size), int(size * w / h))
+        else:
+            size = (int(size * h / w), int(size))
+    oh, ow = size
+    h, w = img.shape[:2]
+    rows = (np.arange(oh) * (h / oh)).astype(np.int64).clip(0, h - 1)
+    cols = (np.arange(ow) * (w / ow)).astype(np.int64).clip(0, w - 1)
+    return img[rows[:, None], cols[None, :]]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class Resize:
+    def __init__(self, size, interpolation="nearest"):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize(img, self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if h < th or w < tw:
+            # pad up to the crop size (reference pad_if_needed behavior)
+            img = np.pad(img, ((0, max(0, th - h)), (0, max(0, tw - w)), (0, 0)),
+                         mode="constant")
+            h, w = img.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            aspect = random.uniform(*self.ratio)
+            tw = int(round((target_area * aspect) ** 0.5))
+            th = int(round((target_area / aspect) ** 0.5))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                return _resize(img[i:i + th, j:j + tw], self.size)
+        return _resize(CenterCrop(min(h, w))(img), self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[:, ::-1].copy()
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[::-1].copy()
+        return _as_hwc(img)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            mean = self.mean.reshape(-1, 1, 1)
+            std = self.std.reshape(-1, 1, 1)
+        else:
+            mean = self.mean
+            std = self.std
+        return (img - mean) / std
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference to_tensor)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = _as_hwc(img).astype(np.float32) / 255.0
+        if self.data_format == "CHW":
+            img = img.transpose(2, 0, 1)
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding  # left, top, right, bottom
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        l, t, r, b = self.padding
+        if self.padding_mode == "constant":
+            return np.pad(img, ((t, b), (l, r), (0, 0)), mode="constant",
+                          constant_values=self.fill)
+        return np.pad(img, ((t, b), (l, r), (0, 0)), mode=self.padding_mode)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        img = np.asarray(img, np.float32)
+        alpha = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return np.clip(img * alpha, 0, 255).astype(np.float32)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        img = np.asarray(img, np.float32)
+        alpha = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        mean = img.mean()
+        return np.clip(img * alpha + mean * (1 - alpha), 0, 255).astype(np.float32)

@@ -87,6 +87,49 @@ def test_to_static_bn_buffer_updates():
     assert not np.allclose(before, after), "BN running mean must update through trace"
 
 
+def test_to_static_bn_stats_accumulate_across_steps():
+    """Regression: buffer READS were baked as trace-time constants, so running
+    stats froze after the first compiled step (they now enter as program inputs)."""
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            return self.bn(x)
+
+    net = to_static(Net())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype("float32") + 3)
+    net(x)
+    after_one = net.bn._mean.numpy().copy()
+    net(x)
+    after_two = net.bn._mean.numpy()
+    # EMA toward batch mean must keep moving on the second execution
+    assert not np.allclose(after_one, after_two), \
+        "BN running mean frozen after first compiled step"
+
+
+def test_to_static_dropout_fresh_mask_per_step():
+    """Regression: host-side dropout masks were baked as constants into the traced
+    program; the RNG key is now threaded as program state."""
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(x)
+
+    paddle.seed(7)
+    net = Net()
+    net.train()
+    snet = to_static(net)
+    x = paddle.to_tensor(np.ones((4, 64), "float32"))
+    a = snet(x).numpy()
+    b = snet(x).numpy()
+    assert not np.array_equal(a, b), "dropout mask identical across compiled steps"
+
+
 def test_static_cond_in_trace():
     from paddle_tpu.static import cond
 
