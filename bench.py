@@ -1,0 +1,77 @@
+"""Driver benchmark: GPT causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config: GPT (BASELINE.md family, sized for one chip's HBM), bf16 compute via AMP-O2
+semantics (params fp32, matmuls bf16 — TPU-native mixed precision), full train step
+compiled to a single XLA executable (paddle_tpu.jit.TrainStep). vs_baseline is
+relative to REF_TOKENS_PER_SEC below — the first measured value on this hardware —
+so the driver's BENCH_r{N}.json series tracks perf across rounds.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# first self-measured value (round 1) on one v4 chip; later rounds compare to this
+REF_TOKENS_PER_SEC = 33064.0
+
+
+def main():
+    import jax
+    # persistent compile cache: XLA compiles through the tunnel are slow (~2min);
+    # cache hits across bench runs/rounds cut warmup to seconds
+    jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_bench")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    # GPT-medium-ish: fits one chip with Adam states; representative MXU shapes
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                    num_heads=16, max_position_embeddings=1024,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+
+    # AMP-O2 analog: bf16 activations/matmuls (params stay fp32 in the optimizer)
+    for _, p in model.named_parameters():
+        p._data = p.value().astype("bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+
+    batch, seq = 8, 1024
+    ids_np = np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq))
+    ids = paddle.to_tensor(ids_np.astype("int32"))
+
+    step = paddle.jit.TrainStep(model, opt)
+
+    # warmup (compile)
+    loss = step(ids, ids)
+    float(loss)
+    loss = step(ids, ids)
+    float(loss)
+
+    iters = 20
+    t0 = time.time()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    final = float(loss)  # blocks on the last step
+    dt = time.time() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    assert np.isfinite(final), f"loss diverged: {final}"
+    print(json.dumps({
+        "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / REF_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,8 @@ from .param_attr import ParamAttr  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+DataParallel = distributed.DataParallel
 
 
 def disable_static(place=None):  # parity no-op: eager is the default (and only) base mode

@@ -1,0 +1,26 @@
+"""paddle_tpu.distributed — the distributed stack.
+
+Reference analog: python/paddle/distributed (collective API, fleet, launch) over the C++
+ProcessGroup/NCCL layer (fluid/distributed/collective/process_group.h:53, SURVEY.md §2.3).
+
+TPU-native architecture (SURVEY.md §7): one global `jax.sharding.Mesh` replaces the
+per-axis NCCL communicator rings; collectives are XLA HLOs compiled into the programs
+that need them (shard_map + lax.psum/all_gather/ppermute) riding ICI/DCN, not eager
+library calls on comm streams. The ProcessGroup surface is preserved for API parity and
+eager use; under jit everything lowers to compiled collectives.
+"""
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+    get_mesh, set_mesh, device_mesh_shape,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .group import Group, new_group, get_group  # noqa: F401
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, broadcast, reduce, reduce_scatter,
+    alltoall, scatter, barrier, send, recv, ReduceOp, split, wait,
+)
+from .parallel import DataParallel  # noqa: F401
+from .sharding_api import shard_tensor, shard_parameter, replicate_tensor  # noqa: F401
+from . import fleet  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .fleet.recompute import recompute  # noqa: F401

@@ -1,0 +1,296 @@
+"""Eager collective API.
+
+Reference analog: python/paddle/distributed/communication/{all_reduce,all_gather,...}.py
+lowering to ProcessGroupNCCL (process_group_nccl.cc) calls on comm streams.
+
+TPU-native semantics — the "rank-stack" view: where the reference's rank r holds a
+local tensor T_r, here there is ONE global array whose leading axis indexes ranks
+(shape [n, ...], dim 0 sharded over the group's mesh axes). Collectives are ordinary
+jnp ops with sharding constraints; under jit XLA lowers them to ICI collective HLOs
+(all-reduce / all-gather / collective-permute) — the compiled equivalent of the
+reference's eager NCCL calls. Every function also accepts an unsharded array and
+places it onto the group first, so user scripts run unchanged on 1..N devices.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .group import Group, get_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jnp.sum,
+    ReduceOp.MAX: jnp.max,
+    ReduceOp.MIN: jnp.min,
+    ReduceOp.PROD: jnp.prod,
+}
+
+
+def _group_or_default(group) -> Group:
+    return group if group is not None else get_group(0)
+
+
+def _stack_spec(group: Group, ndim: int) -> P:
+    axes = group.axis_names
+    ax0 = axes[0] if axes and len(axes) == 1 else (tuple(axes) if axes else None)
+    return P(ax0, *([None] * (ndim - 1)))
+
+
+def _place_on_group(arr: jax.Array, group: Group) -> jax.Array:
+    """Shard dim 0 over the group axes (no-op if already so placed)."""
+    mesh = group.mesh
+    if mesh is None or group.nranks == 1:
+        return arr
+    target = NamedSharding(mesh, _stack_spec(group, arr.ndim))
+    sh = getattr(arr, "sharding", None)
+    if sh == target:
+        return arr
+    return jax.device_put(arr, target)
+
+
+def _unwrap(x):
+    return x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(op_key, mesh, axes, op=ReduceOp.SUM):
+    spec_in = lambda nd: NamedSharding(mesh, P(axes[0] if len(axes) == 1
+                                               else tuple(axes),
+                                               *([None] * (nd - 1))))
+    if op_key == "all_reduce":
+        def fn(x):
+            red = _REDUCERS.get(op, jnp.sum)
+            y = red(x, axis=0, keepdims=True)
+            if op == ReduceOp.AVG:
+                y = jnp.sum(x, axis=0, keepdims=True) / x.shape[0]
+            y = jnp.broadcast_to(y, x.shape)
+            return jax.lax.with_sharding_constraint(y, spec_in(x.ndim))
+    elif op_key == "reduce_scatter":
+        def fn(x):
+            red = _REDUCERS.get(op, jnp.sum)
+            y = red(x, axis=0)
+            if op == ReduceOp.AVG:
+                y = jnp.sum(x, axis=0) / x.shape[0]
+            return jax.lax.with_sharding_constraint(y, spec_in(x.ndim - 1))
+    elif op_key == "all_gather":
+        def fn(x):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*([None] * x.ndim))))
+    elif op_key == "alltoall":
+        def fn(x):
+            y = jnp.swapaxes(x, 0, 1)
+            return jax.lax.with_sharding_constraint(y, spec_in(x.ndim))
+    else:
+        raise KeyError(op_key)
+    return jax.jit(fn)
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """In the rank-stack view: every slice of dim 0 becomes the reduction of all
+    slices (each rank ends with the reduced value — reference all_reduce)."""
+    g = _group_or_default(group)
+    x = _unwrap(tensor)
+    if g.nranks <= 1:
+        return tensor
+    if x.shape[0] != g.nranks:
+        raise ValueError(
+            f"all_reduce expects the rank-stack layout [nranks={g.nranks}, ...]; "
+            f"got shape {tuple(x.shape)}. For sharded-model gradients use the "
+            f"compiled path (shardings on the train step).")
+    x = _place_on_group(x, g)
+    out = _jitted("all_reduce", g.mesh, g.axis_names, op)(x)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    """Only the dst slice gets the reduced value; others keep their input."""
+    g = _group_or_default(group)
+    x = _unwrap(tensor)
+    if g.nranks <= 1:
+        return tensor
+    x = _place_on_group(x, g)
+    red = _jitted("all_reduce", g.mesh, g.axis_names, op)(x)
+    out = x.at[dst].set(red[dst])
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def all_gather(tensor_list: Optional[List] = None, tensor=None,
+               group: Optional[Group] = None, sync_op: bool = True):
+    """Gather every rank's slice; returns the full (replicated) stack.
+
+    Call styles (reference parity): all_gather(tensor_list, tensor) appends each
+    rank's tensor to tensor_list; all_gather(tensor=t) returns the stacked Tensor.
+    """
+    g = _group_or_default(group)
+    if tensor is None and tensor_list is not None and not isinstance(tensor_list, list):
+        tensor, tensor_list = tensor_list, None
+    x = _unwrap(tensor)
+    if g.nranks > 1:
+        x = _place_on_group(x, g)
+        x = _jitted("all_gather", g.mesh, g.axis_names)(x)
+    stacked = Tensor(x)
+    if tensor_list is not None:
+        for i in range(x.shape[0]):
+            tensor_list.append(Tensor(x[i]))
+    return stacked
+
+
+def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
+    """Single-controller: every rank's object is the same python object."""
+    g = _group_or_default(group)
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    """Every slice of dim 0 becomes the src slice."""
+    g = _group_or_default(group)
+    x = _unwrap(tensor)
+    if g.nranks <= 1:
+        return tensor
+    x = _place_on_group(x, g)
+    y = jnp.broadcast_to(x[src:src + 1], x.shape)
+    y = jax.device_put(y, NamedSharding(g.mesh, _stack_spec(g, x.ndim)))
+    if isinstance(tensor, Tensor):
+        tensor._data = y
+        return tensor
+    return Tensor(y)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op: str = ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True):
+    """Input rank-stack [n, n, ...] (dim 0 = source rank, dim 1 = destination
+    chunk); output [n, ...] where slice k = reduction over sources of chunk k."""
+    g = _group_or_default(group)
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in src], axis=0)
+        x = jnp.broadcast_to(x[None], (g.nranks,) + x.shape) \
+            if x.ndim >= 1 and x.shape[0] != g.nranks else x
+    else:
+        x = _unwrap(src)
+    if g.nranks <= 1:
+        out = x if not isinstance(src, (list, tuple)) else x[0]
+    else:
+        x = _place_on_group(x, g)
+        out = _jitted("reduce_scatter", g.mesh, g.axis_names, op)(x)
+    if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None,
+             sync_op: bool = True):
+    """Rank-stack [n, n, ...]: out[j, i] = in[i, j] (chunk i of rank j ← chunk j of
+    rank i). List form gathers/ scatters python lists for reference parity."""
+    g = _group_or_default(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
+        x = x[None].repeat(g.nranks, 0) if x.ndim == 1 else x
+    else:
+        x = _unwrap(in_tensor_list)
+    if g.nranks > 1:
+        x = _place_on_group(x, g)
+        x = _jitted("alltoall", g.mesh, g.axis_names)(x)
+    else:
+        x = jnp.swapaxes(x, 0, 1) if x.ndim >= 2 else x
+    result = Tensor(x)
+    if isinstance(out_tensor_list, list):
+        for i in range(x.shape[0]):
+            out_tensor_list.append(Tensor(x[i]))
+    return result
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    """src's stack is distributed: slice k of the result is tensor_list[k]."""
+    g = _group_or_default(group)
+    if tensor_list is not None:
+        x = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+    else:
+        x = _unwrap(tensor)
+    if g.nranks > 1:
+        x = _place_on_group(x, g)
+    if isinstance(tensor, Tensor):
+        tensor._data = x
+        return tensor
+    return Tensor(x)
+
+
+# --------------------------------------------------------------------- p2p
+# Single-host eager p2p is an in-process mailbox (pipeline schedules use compiled
+# ppermute over the pipe axis instead — fleet/meta_parallel/pp_utils).
+
+_mailbox = {}
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    g = _group_or_default(group)
+    _mailbox[(g.id, dst)] = _unwrap(tensor)
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    g = _group_or_default(group)
+    for key in list(_mailbox):
+        if key[0] == g.id:
+            val = _mailbox.pop(key)
+            if isinstance(tensor, Tensor):
+                tensor._data = val
+            return tensor
+    raise RuntimeError(f"recv: no message pending from rank {src}")
+
+
+def barrier(group: Optional[Group] = None):
+    """Device-level sync: drain all pending async work."""
+    (jax.device_put(jnp.zeros(()), jax.devices()[0]) + 0).block_until_ready()
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream: bool = True):
+    x = _unwrap(tensor)
+    if hasattr(x, "block_until_ready"):
+        x.block_until_ready()
+    return tensor
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference paddle.distributed.split: build a TP linear/embedding layer."""
+    from .fleet.meta_parallel.mp_layers import (ColumnParallelLinear,
+                                                RowParallelLinear,
+                                                VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    else:
+        layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    return layer(x)

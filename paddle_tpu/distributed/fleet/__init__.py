@@ -1,0 +1,107 @@
+"""fleet — the hybrid-parallel training facade.
+
+Reference analog: python/paddle/distributed/fleet/fleet.py (init:288 /
+distributed_model / distributed_optimizer) dispatching wrappers by parallel mode
+(fleet/model.py:30) over a HybridCommunicateGroup (topology.py:140).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..env import _maybe_init_multihost, get_hcg
+from ..topology import AXES, CommunicateTopology, HybridCommunicateGroup
+from .strategy import DistributedStrategy
+from . import meta_parallel  # noqa: F401
+from .meta_optimizers import HybridParallelOptimizer, DygraphShardingOptimizer
+from .recompute import recompute  # noqa: F401
+
+_fleet_state = {"strategy": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    """fleet.init: build the hybrid topology mesh (reference fleet.py:288,385)."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    import jax
+    n = jax.device_count()
+    degrees = {
+        "data": int(hc.get("dp_degree", 1)),
+        "pipe": int(hc.get("pp_degree", 1)),
+        "sharding": int(hc.get("sharding_degree", 1)),
+        "sep": int(hc.get("sep_degree", 1)),
+        "model": int(hc.get("mp_degree", 1)),
+    }
+    fixed = 1
+    for v in degrees.values():
+        fixed *= max(v, 1)
+    if all(v <= 1 for v in degrees.values()):
+        degrees["data"] = n          # pure-DP default, like the reference
+    elif degrees["data"] in (0, -1) or fixed != n:
+        # infer dp to fill the machine (reference allows dp_degree=-1 = auto)
+        rest = 1
+        for k, v in degrees.items():
+            if k != "data":
+                rest *= max(v, 1)
+        if n % rest != 0:
+            raise ValueError(f"hybrid degrees {degrees} do not divide device "
+                             f"count {n}")
+        degrees["data"] = n // rest
+    _maybe_init_multihost()
+    topo = CommunicateTopology(AXES, [degrees[a] for a in AXES])
+    HybridCommunicateGroup(topo)  # builds + registers the global mesh
+    _fleet_state["strategy"] = strategy
+    _fleet_state["initialized"] = True
+    return None
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return get_hcg()
+
+
+def distributed_model(model):
+    """Wrap by parallel mode (reference fleet/model.py:30)."""
+    hcg = get_hcg()
+    if hcg is None:
+        init()
+        hcg = get_hcg()
+    mode = hcg.get_parallel_mode()
+    mp = meta_parallel
+    if mode == "pipeline":
+        return mp.PipelineParallel(model, hcg, _fleet_state["strategy"])
+    if mode == "sharding_parallel":
+        return mp.ShardingParallel(model, hcg, _fleet_state["strategy"])
+    if mode == "tensor_parallel":
+        return mp.TensorParallel(model, hcg, _fleet_state["strategy"])
+    from ..parallel import DataParallel
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    hcg = get_hcg()
+    strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+    if strategy.sharding or (hcg is not None
+                             and hcg.get_sharding_parallel_world_size() > 1):
+        return DygraphShardingOptimizer(optimizer, hcg, strategy)
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
+
+
+def worker_num() -> int:
+    import jax
+    return jax.process_count()
+
+
+def worker_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()

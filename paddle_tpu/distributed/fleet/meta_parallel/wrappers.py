@@ -1,0 +1,76 @@
+"""TensorParallel / ShardingParallel model wrappers.
+
+Reference analog: fleet/meta_parallel/tensor_parallel.py and sharding_parallel.py —
+thin wrappers that broadcast initial states across their groups. Here "broadcast" is
+placement: replicate what must agree, shard what the mode shards.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from ...env import get_mesh
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(t) for t in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def _shard_input(self, t):
+        mesh = get_mesh()
+        if (not isinstance(t, Tensor) or mesh is None or t.ndim == 0
+                or mesh.shape.get("data", 1) <= 1):
+            return t
+        spec = P("data", *([None] * (t.ndim - 1)))
+        t._data = jax.device_put(t.value(), NamedSharding(mesh, spec))
+        return t
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(_MetaParallelBase):
+    """TP wrapper: mp layers already placed their own shards; everything else is
+    replicated (the reference broadcasts non-TP params across the mp group)."""
+
+    def _prepare_for_model(self):
+        mesh = get_mesh()
+        if mesh is None:
+            return
+        for _, p in self._layers.named_parameters():
+            sh = getattr(p.value(), "sharding", None)
+            already_sharded = (isinstance(sh, NamedSharding)
+                               and any(s is not None for s in sh.spec))
+            if not already_sharded:
+                p._data = jax.device_put(
+                    p.value(), NamedSharding(mesh, P(*([None] * p.ndim))))
+
+
+class ShardingParallel(_MetaParallelBase):
+    """ZeRO wrapper: parameter placement is unchanged here (stage 1/2 shard optimizer
+    state and grads, handled by DygraphShardingOptimizer); stage 3 shards params via
+    group_sharded_parallel."""
+    pass

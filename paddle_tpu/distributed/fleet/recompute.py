@@ -1,0 +1,103 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference analog: fleet/recompute/recompute.py — a PyLayer that runs forward under
+no_grad saving only inputs + RNG state, then re-runs it with grad during backward
+(RNG replayed so dropout masks match).
+
+Same structure here on the tape: forward under no_grad, a custom GradNode whose
+backward re-executes the function eagerly (RNG state restored) and backpropagates
+through the recomputed subgraph via autograd.grad — parameter grads accumulate as a
+side effect exactly like the reference's inner backward. Under a to_static trace,
+jax.checkpoint is the whole story and we simply mark the region.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ...core import dispatch
+from ...core import random as rnd
+from ...core.autograd import GradNode, grad as autograd_grad
+from ...core.tensor import Tensor
+
+
+def _flatten_tensors(obj, out):
+    if isinstance(obj, Tensor):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _flatten_tensors(o, out)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            _flatten_tensors(o, out)
+
+
+def recompute(function, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs) -> Any:
+    """paddle.distributed.fleet.utils.recompute parity."""
+    if dispatch.in_trace() or not dispatch.is_grad_enabled():
+        # traced: XLA remat handles it; no-grad: nothing to save anyway
+        return function(*args, **kwargs)
+
+    in_tensors: list = []
+    _flatten_tensors((args, kwargs), in_tensors)
+    diff_inputs = [t for t in in_tensors if not t.stop_gradient]
+
+    rng_before = rnd.get_rng_state() if preserve_rng_state else None
+
+    with dispatch.no_grad():
+        outs = function(*args, **kwargs)
+
+    single = isinstance(outs, Tensor)
+    out_list = [outs] if single else [o for o in outs if isinstance(o, Tensor)]
+    if not diff_inputs:
+        return outs
+
+    def _detach(obj, mapping):
+        # sever the recomputed subgraph at the inputs: leaves here, so the inner
+        # backward cannot walk (and release) the OUTER graph's nodes
+        if isinstance(obj, Tensor):
+            if id(obj) not in mapping:
+                mapping[id(obj)] = Tensor(obj.value(),
+                                          stop_gradient=obj.stop_gradient)
+            return mapping[id(obj)]
+        if isinstance(obj, (list, tuple)):
+            mapped = [_detach(o, mapping) for o in obj]
+            return type(obj)(mapped) if isinstance(obj, tuple) else mapped
+        if isinstance(obj, dict):
+            return {k: _detach(v, mapping) for k, v in obj.items()}
+        return obj
+
+    def bwd(primals, saved_outs, cotangents):
+        rng_save = None
+        if rng_before is not None:
+            rng_save = rnd.get_rng_state()
+            rnd.set_rng_state(rng_before)
+        try:
+            mapping = {}
+            dargs = _detach(list(args), mapping)
+            dkwargs = _detach(kwargs, mapping)
+            detached_diff = [mapping[id(t)] for t in diff_inputs]
+            with dispatch.enable_grad():
+                re_out = function(*dargs, **dkwargs)
+            re_list = [re_out] if isinstance(re_out, Tensor) else \
+                [o for o in re_out if isinstance(o, Tensor)]
+            cots = [Tensor(c) for c in cotangents[:len(re_list)]]
+            grads = autograd_grad(re_list, detached_diff, grad_outputs=cots,
+                                  allow_unused=True)
+            return [g.value() if g is not None else None for g in grads]
+        finally:
+            if rng_save is not None:
+                rnd.set_rng_state(rng_save)
+
+    node = GradNode(
+        name="recompute", bwd_fn=bwd, mode="explicit",
+        saved_primals=None, saved_outs=None,
+        diff_idx=tuple(range(len(diff_inputs))),
+        input_tensors=tuple(diff_inputs),
+        out_metas=tuple((tuple(o.shape), o.dtype) for o in out_list))
+
+    for i, o in enumerate(out_list):
+        o.stop_gradient = False
+        o._grad_node = node
+        o._out_index = i
+    return outs

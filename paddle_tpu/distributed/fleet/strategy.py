@@ -1,0 +1,54 @@
+"""DistributedStrategy.
+
+Reference analog: framework/distributed_strategy.proto:310-360 + its Python wrapper
+fleet/base/distributed_strategy.py (the de-facto capability checklist, SURVEY.md §2.4).
+Feature booleans select behaviors; *_configs dicts carry knobs. Features whose work is
+subsumed by the compiler (fuse_all_reduce_ops, fp16_allreduce, hierarchical allreduce)
+are accepted and recorded for parity but are no-ops: XLA fuses/schedules collectives.
+"""
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # reference proto defaults (distributed_strategy.proto:310-360)
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                            "use_fp16_guard": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "sharding_degree": 1,
+                                 "offload": False, "comm_overlap": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.heter_ccl_mode = False
+        self.lars = False
+        self.lars_configs = {}
+        self.lamb = False
+        self.lamb_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.a_sync = False
+        self.elastic = False
+        self.auto = False
+        self.semi_auto = False
+        self.fuse_all_reduce_ops = True    # no-op: XLA fuses
+        self.fuse_grad_size_in_MB = 32     # no-op
+        self.fp16_allreduce = False        # no-op: grads keep their dtype
+        self.sync_batch_norm = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+        return f"DistributedStrategy({fields})"

@@ -1,0 +1,120 @@
+"""Communication groups.
+
+Reference analog: ProcessGroup (fluid/distributed/collective/process_group.h:53) and
+the per-gid registry (ProcessGroupIdMap :501); Python `new_group`
+(python/paddle/distributed/communication/group.py).
+
+TPU-native: a Group is a handle onto mesh axes (hybrid topology axes) or an ad-hoc
+sub-mesh (new_group(ranks)). No communicator state — XLA materializes the collective
+schedule at compile time; the group only names WHICH devices participate.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .env import get_mesh
+
+_group_registry = {}
+_next_gid = [1]  # 0 = global group
+
+
+class Group:
+    """A set of devices that collectives run over.
+
+    Either axis-aligned on the global mesh (`axis_names`) — the hybrid-topology case,
+    where the member devices at each coordinate are implied — or an explicit rank list
+    materialized as its own 1-D sub-mesh (`new_group`).
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 axis_names: Optional[Tuple[str, ...]] = None,
+                 ranks: Optional[List[int]] = None, gid: int = 0):
+        self._global_mesh = mesh
+        self.axis_names = tuple(axis_names) if axis_names else None
+        self.id = gid
+        if ranks is not None:
+            devices = np.asarray(jax.devices())[list(ranks)]
+            self.sub_mesh = Mesh(devices, ("_group",))
+            self._ranks = list(ranks)
+            self.axis_names = ("_group",)
+        else:
+            self.sub_mesh = None
+            self._ranks = None
+
+    # ------------------------------------------------------------- properties
+
+    @property
+    def mesh(self) -> Mesh:
+        if self.sub_mesh is not None:
+            return self.sub_mesh
+        return self._global_mesh if self._global_mesh is not None else get_mesh()
+
+    @property
+    def nranks(self) -> int:
+        if self._ranks is not None:
+            return len(self._ranks)
+        m = self.mesh
+        if m is None:
+            return 1
+        if self.axis_names is None:
+            return int(np.prod(m.devices.shape))
+        return int(np.prod([m.shape[a] for a in self.axis_names]))
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single-controller global view (per-host rank in multi-host)
+
+    @property
+    def ranks(self) -> List[int]:
+        if self._ranks is not None:
+            return self._ranks
+        return list(range(self.nranks))
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks}, id={self.id})"
+
+
+GLOBAL_GROUP_ID = 0
+
+
+def _global_group() -> Group:
+    if GLOBAL_GROUP_ID not in _group_registry:
+        mesh = get_mesh()
+        if mesh is None:
+            from .env import init_parallel_env
+            init_parallel_env()
+            mesh = get_mesh()
+        _group_registry[GLOBAL_GROUP_ID] = Group(
+            mesh=mesh, axis_names=tuple(mesh.axis_names), gid=GLOBAL_GROUP_ID)
+    return _group_registry[GLOBAL_GROUP_ID]
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _global_group()
+    return _group_registry[gid]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend: Optional[str] = None,
+              timeout=None) -> Group:
+    """Create a group over an explicit rank (device) list (reference new_group)."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    g = Group(ranks=list(ranks), gid=gid)
+    _group_registry[gid] = g
+    return g

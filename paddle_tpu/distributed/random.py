@@ -1,0 +1,24 @@
+"""TP-deterministic RNG (reference: fleet/meta_parallel/parallel_layers/random.py).
+
+The reference keeps separate CUDA RNG states per model-parallel context so dropout
+inside TP regions differs across mp ranks ("local") while elsewhere agreeing
+("global"). In the single-controller mesh world, dropout masks are global arrays —
+"local vs global" is automatic — but the tracker API is preserved because user code
+and the recompute RNG-replay path call it.
+"""
+from ..core.random import RNGStatesTracker
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 100):
+    import jax
+    from ..core import random as rng
+    _TRACKER.reset()
+    _TRACKER.add("global_seed", seed)
+    _TRACKER.add("local_seed", seed + 1024 + jax.process_index())
+    rng.seed(seed)

@@ -1,0 +1,61 @@
+"""Sharding annotation API.
+
+Reference analog: auto_parallel's shard_tensor on a ProcessMesh
+(/root/reference/python/paddle/distributed/auto_parallel/interface.py) — the
+semi-automatic SPMD path (SURVEY.md §2.4 auto-parallel row).
+
+TPU-native: an annotation IS the implementation. jax.device_put with a NamedSharding
+re-places the array across the mesh; every subsequent op (eager per-op executable or
+compiled program) consumes the sharding and XLA's SPMD partitioner inserts collectives.
+There is no separate Completion/Partitioner/Resharder pipeline to run — GSPMD plays
+those roles (completion = sharding propagation, reshard = mismatched-sharding copy).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import get_mesh
+
+
+def _as_spec(placements: Union[P, Sequence, None], ndim: int) -> P:
+    if placements is None:
+        return P()
+    if isinstance(placements, P):
+        return placements
+    dims = list(placements) + [None] * (ndim - len(list(placements)))
+    return P(*dims)
+
+
+def shard_tensor(tensor, mesh: Optional[Mesh] = None,
+                 placements: Union[P, Sequence, None] = None, dist_attr=None):
+    """Re-place a Tensor's storage across the mesh per the PartitionSpec.
+
+    placements: PartitionSpec or a per-dim list of mesh-axis names (None =
+    replicated on that dim), e.g. ["data", None] or P("model").
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return tensor
+    arr = tensor.value() if isinstance(tensor, Tensor) else tensor
+    spec = _as_spec(placements, arr.ndim)
+    out = jax.device_put(arr, NamedSharding(mesh, spec))
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def shard_parameter(param, axis: int, mesh_axis: str = "model",
+                    mesh: Optional[Mesh] = None):
+    """Shard one weight dim over one mesh axis (TP layers use this)."""
+    spec = [None] * param.ndim
+    spec[axis] = mesh_axis
+    return shard_tensor(param, mesh, spec)
+
+
+def replicate_tensor(tensor, mesh: Optional[Mesh] = None):
+    return shard_tensor(tensor, mesh, None)

@@ -1,0 +1,180 @@
+"""Hybrid-parallel topology.
+
+Reference analog: fleet/base/topology.py — CommunicateTopology (:54, a rank hypercube
+with axis order ["data","pipe","sharding","sep","model"]) and HybridCommunicateGroup
+(:140, one comm group per axis per coordinate).
+
+TPU-native: the hypercube IS a jax.sharding.Mesh. Axis order keeps "model" innermost
+(fastest-varying) so TP collectives ride nearest-neighbor ICI, exactly the property the
+reference encodes by putting model last in its rank-ordering. Instead of materializing
+N_axis × N_coord NCCL communicators, each "group" is a (mesh, axis-name) pair; compiled
+collectives reference the axis, and eager collectives shard_map over it.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .env import set_hcg, set_mesh
+
+# reference axis order, topology.py:54-60 (sep added: SURVEY.md §2.4 notes the
+# reference lacks SP; it is first-class here)
+AXES = ("data", "pipe", "sharding", "sep", "model")
+
+
+class CommunicateTopology:
+    """Rank hypercube with named axes (reference CommunicateTopology)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = AXES,
+                 dims: Sequence[int] = None):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        assert len(self._parallel_names) == len(self._dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in self._dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return [self._coord2rank[c] for c in self.coordinate if c[axis] == index]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All rank-groups along axis_name (reference get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for other in itertools.product(*(range(self._dims[i]) for i in other_axes)):
+            ranks = []
+            for a in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, a)
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Per-axis communication groups over one global mesh (reference :140).
+
+    Builds the jax Mesh with shape (dp, pp, sharding, sep, mp) over the devices and
+    exposes the reference's query surface (get_model_parallel_rank & co.). Groups are
+    lightweight axis handles usable by both eager collectives (shard_map) and compiled
+    programs (axis names in PartitionSpecs).
+    """
+
+    def __init__(self, topology: CommunicateTopology,
+                 devices: Optional[Sequence] = None):
+        self._topo = topology
+        devices = np.asarray(devices if devices is not None else jax.devices())
+        dims = tuple(topology._dims)
+        if int(np.prod(dims)) != devices.size:
+            raise ValueError(
+                f"topology world size {int(np.prod(dims))} != device count "
+                f"{devices.size}")
+        names = tuple(topology.get_hybrid_group_names())
+        self.mesh = Mesh(devices.reshape(dims), names)
+        set_mesh(self.mesh)
+        set_hcg(self)
+
+        from .group import Group  # local: group.py imports topology types
+        self._groups: Dict[str, Group] = {
+            name: Group(mesh=self.mesh, axis_names=(name,))
+            for name in names}
+        # reference "check group": dp+sharding combined for fused allreduce paths
+        self._dp_sharding_group = Group(mesh=self.mesh,
+                                        axis_names=("data", "sharding"))
+
+    # ----------------------------------------------------------- topology info
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        mp = self._topo.get_dim("model")
+        pp = self._topo.get_dim("pipe")
+        sharding = self._topo.get_dim("sharding")
+        if pp > 1:
+            return "pipeline"
+        if sharding > 1:
+            return "sharding_parallel"
+        if mp > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def _axis_rank(self, name: str) -> int:
+        # single-controller: the "current rank" notion only exists per-process in
+        # multi-host; within the global view the coordinate is program-relative.
+        return 0
+
+    # reference accessors (fleet user code calls these)
+    def get_data_parallel_world_size(self) -> int:
+        return self._topo.get_dim("data")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._topo.get_dim("model")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._topo.get_dim("pipe")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._topo.get_dim("sep")
+
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_rank("data")
+
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_rank("model")
+
+    def get_stage_id(self) -> int:
+        return self._axis_rank("pipe")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    # ----------------------------------------------------------- groups
+
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self):
+        return self._dp_sharding_group
+
+    def get_group(self, name: str):
+        return self._groups[name]

@@ -1,2 +1,3 @@
 from .api import to_static, not_to_static, save, load, TranslatedLayer, ignore_module  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
+from .train_step import TrainStep  # noqa: F401

@@ -1,0 +1,199 @@
+"""TrainStep — ONE compiled XLA executable for forward + backward + optimizer update.
+
+Reference analog: the static-graph training path (Executor.run over a ProgramDesc that
+contains forward, backward and optimizer ops — SURVEY.md §3.3); dygraph users get it
+via @to_static around the whole step. This is the peak-performance path on TPU: the
+entire step is a single XLA program, so the compiler fuses elementwise chains into the
+matmuls, schedules collectives (DP grad psum, TP activation collectives, ZeRO
+reshards) and overlaps them with compute — nothing returns to Python between ops.
+
+Works over any current parameter placement: in_shardings are taken from the live
+arrays, so the same TrainStep expresses single-chip, DP, TP, and ZeRO runs.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import random as _random
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer import Layer
+
+__all__ = ["TrainStep"]
+
+
+class TrainStep:
+    """Compile (model fwd → loss → grads → optimizer update) into one executable.
+
+    loss_fn(outputs, *labels) -> scalar Tensor; if None, the model must return the
+    loss itself (paddle GPTForCausalLM-style `model(ids, labels=...)` works by
+    passing labels through inputs).
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
+                 donate_params: bool = True):
+        # unwrap distributed facades down to the real Layer
+        self._model = model
+        while hasattr(self._model, "_layers"):
+            self._model = self._model._layers
+        self._opt = optimizer
+        while hasattr(self._opt, "_inner_opt"):
+            self._opt = self._opt._inner_opt
+        self._loss_fn = loss_fn
+        self._donate = donate_params
+        self._params: List[Parameter] = [p for _, p in
+                                         self._model.named_parameters()]
+        self._buffers = [b for _, b in self._model.named_buffers()]
+        self._buffers.append(_random.rng_state_tensor())
+        self._compiled = None
+        self._opt._ensure_all_states()
+        # ZeRO / hybrid optimizers place their states on construction paths that
+        # run inside step(); trigger placement explicitly when present
+        placer = getattr(optimizer, "_place_states", None)
+        if placer is not None:
+            placer()
+        # commit every array to its current placement: uncommitted inputs vs
+        # committed first-step outputs would otherwise trigger a second compile
+        for p in self._params:
+            p._data = jax.device_put(p._data)
+        for b in self._buffers:
+            b._data = jax.device_put(b._data)
+        for st in self._opt._accumulators.values():
+            for k in st:
+                st[k] = jax.device_put(st[k])
+        for k in list(self._opt._master_weights):
+            self._opt._master_weights[k] = jax.device_put(
+                self._opt._master_weights[k])
+
+    # ------------------------------------------------------------------ build
+
+    def _build(self, example_inputs):
+        params = self._params
+        buffers = self._buffers
+        model = self._model
+        loss_fn = self._loss_fn
+        opt = self._opt
+        opt_cls = type(opt)
+        n_p, n_b = len(params), len(buffers)
+
+        trainables = [p.trainable for p in params]
+        static = dict(opt._static_config())
+        static["lr_scales"] = tuple(
+            float(p.optimize_attr.get("learning_rate", 1.0))
+            for p in params if p.trainable)
+
+        def run_model(param_arrays, buffer_arrays, input_arrays):
+            ctx = dispatch.TraceContext()
+            saved_p = [p._data for p in params]
+            saved_b = [b._data for b in buffers]
+            dispatch.push_trace(ctx)
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                for b, a in zip(buffers, buffer_arrays):
+                    b._data = a
+                tensors = [Tensor(a) for a in input_arrays]
+                out = model(*tensors)
+                if loss_fn is not None:
+                    loss = loss_fn(out)
+                elif isinstance(out, Tensor):
+                    loss = out
+                else:
+                    loss = out[-1]  # (logits, loss) convention
+                updates = {id(t): arr for t, arr in ctx.buffer_updates}
+                new_buffers = tuple(updates.get(id(b), arr)
+                                    for b, arr in zip(buffers, buffer_arrays))
+                return loss.value(), new_buffers
+            finally:
+                dispatch.pop_trace()
+                ctx.restore()
+                for p, d in zip(params, saved_p):
+                    p._data = d
+                for b, d in zip(buffers, saved_b):
+                    b._data = d
+
+        # AMP-O2: per-param master-weight flag (fp32 copy lives in the optimizer,
+        # bf16/fp16 working copy in the model — reference multi_precision path)
+        use_master = [p.trainable and id(p) in opt._master_weights for p in params]
+
+        def step_fn(param_arrays, masters, states, buffer_arrays, scalars,
+                    input_arrays):
+            def loss_of(diff_params):
+                full = []
+                di = iter(diff_params)
+                for a, t in zip(param_arrays, trainables):
+                    full.append(next(di) if t else a)
+                return run_model(tuple(full), buffer_arrays, input_arrays)
+
+            diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_in)
+
+            # the update runs on the master copy where one exists (fp32 math),
+            # else directly on the param
+            upd_in = [m if um else a
+                      for a, m, um, t in zip(param_arrays, masters, use_master,
+                                             trainables) if t]
+            diff_states = [s for s, t in zip(states, trainables) if t]
+            new_upd, new_states_diff = opt_cls._update_rule(
+                upd_in, [g.astype(u.dtype) for g, u in zip(grads, upd_in)],
+                diff_states, scalars, **static)
+            new_params, new_masters, new_states = [], [], []
+            ui, si = iter(new_upd), iter(new_states_diff)
+            for a, m, s, t, um in zip(param_arrays, masters, states, trainables,
+                                      use_master):
+                if not t:
+                    new_params.append(a)
+                    new_masters.append(m)
+                    new_states.append(s)
+                    continue
+                u = next(ui)
+                new_states.append(next(si))
+                if um:
+                    new_masters.append(u)
+                    new_params.append(u.astype(a.dtype))
+                else:
+                    new_masters.append(m)
+                    new_params.append(u)
+            return (loss, tuple(new_params), tuple(new_masters),
+                    tuple(new_states), new_buffers)
+
+        donate = (1, 2, 3) if self._donate else ()
+        self._compiled = jax.jit(step_fn, donate_argnums=donate)
+
+    # ------------------------------------------------------------------ call
+
+    def __call__(self, *inputs):
+        input_arrays = tuple(t.value() if isinstance(t, Tensor) else jnp.asarray(t)
+                             for t in inputs)
+        if self._compiled is None:
+            self._build(input_arrays)
+        opt = self._opt
+        params = self._params
+        for p in params:
+            if p.trainable:
+                opt._ensure_state(p)
+        param_arrays = tuple(p.value() for p in params)
+        masters = tuple(opt._master_weights.get(id(p), ()) for p in params)
+        states = tuple(
+            {name: opt._accumulators[id(p)][name] for name in opt._state_names}
+            if p.trainable else {} for p in params)
+        buffer_arrays = tuple(b.value() for b in self._buffers)
+        scalars = opt._scalars(opt.get_lr())
+
+        loss, new_params, new_masters, new_states, new_buffers = self._compiled(
+            param_arrays, masters, states, buffer_arrays, scalars, input_arrays)
+
+        with dispatch.no_grad():
+            for p, a, m, s in zip(params, new_params, new_masters, new_states):
+                p._data = a
+                if p.trainable:
+                    opt._accumulators[id(p)] = dict(s)
+                if id(p) in opt._master_weights:
+                    opt._master_weights[id(p)] = m
+            for b, a in zip(self._buffers, new_buffers):
+                b._data = a
+        return Tensor(loss)

@@ -83,6 +83,12 @@ class Optimizer:
                 self._master_weights[pid] = p.value().astype(jnp.float32)
         return self._accumulators[pid]
 
+    def _ensure_all_states(self):
+        """Materialize state for every trainable param (used by ZeRO placement)."""
+        for p in self._parameter_list:
+            if p.trainable:
+                self._ensure_state(p)
+
     def _static_config(self):
         return (("weight_decay", self._weight_decay),)
 
@@ -106,6 +112,24 @@ class Optimizer:
         for p in params:
             self._ensure_state(p)
 
+        scalars = self._scalars(self.get_lr())  # advances step count ONCE
+        # pipeline parallelism places stages on disjoint submeshes; one jit cannot
+        # span disjoint device sets, so run one fused update per device group
+        groups = {}
+        for p, g in zip(params, grads):
+            try:
+                key = frozenset(p.value().sharding.device_set)
+            except Exception:
+                key = None
+            groups.setdefault(key, []).append((p, g))
+        if len(groups) > 1:
+            for pairs in groups.values():
+                self._step_group([p for p, _ in pairs], [g for _, g in pairs],
+                                 scalars)
+            return
+        self._step_group(params, grads, scalars)
+
+    def _step_group(self, params, grads, scalars):
         use_master = [id(p) in self._master_weights for p in params]
         param_vals = [self._master_weights[id(p)] if m else p.value()
                       for p, m in zip(params, use_master)]
@@ -113,7 +137,6 @@ class Optimizer:
         lr_scales = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
                           for p in params)
         states = [self._accumulators[id(p)] for p in params]
-        scalars = self._scalars(self.get_lr())
 
         static_key = self._static_config() + (("lr_scales", lr_scales),)
         new_params, new_states = _jitted_update(type(self), static_key)(
