@@ -249,19 +249,28 @@ _mailbox = {}
 
 
 def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """Enqueue onto the group's FIFO mailbox, tagged with the destination rank.
+
+    The single controller executes every logical rank's code in one process, so
+    sender identity is not modeled; messages never overwrite each other and are
+    delivered in send order. Real cross-device p2p is the compiled path
+    (ppermute over the pipe axis — fleet/meta_parallel/pp_utils)."""
     g = _group_or_default(group)
-    _mailbox[(g.id, dst)] = _unwrap(tensor)
+    _mailbox.setdefault(g.id, []).append((dst, _unwrap(tensor)))
 
 
 def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """Pop the oldest pending message in this group (FIFO — see send)."""
     g = _group_or_default(group)
-    for key in list(_mailbox):
-        if key[0] == g.id:
-            val = _mailbox.pop(key)
-            if isinstance(tensor, Tensor):
-                tensor._data = val
-            return tensor
-    raise RuntimeError(f"recv: no message pending from rank {src}")
+    queue = _mailbox.get(g.id)
+    if not queue:
+        raise RuntimeError(f"recv: no message pending in group {g.id} "
+                           f"(requested src={src})")
+    _, val = queue.pop(0)
+    if isinstance(tensor, Tensor):
+        tensor._data = val
+        return tensor
+    return Tensor(val)
 
 
 def barrier(group: Optional[Group] = None):

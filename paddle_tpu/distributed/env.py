@@ -83,7 +83,12 @@ def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,
     devices = np.asarray(jax.devices())
     if mesh_shape is None:
         mesh_shape = (len(devices),)
-        axis_names = axis_names or ("data",)
+    if axis_names is None:
+        axis_names = (("data",) if len(mesh_shape) == 1 else
+                      tuple(f"axis_{i}" for i in range(len(mesh_shape))))
+    if len(axis_names) != len(mesh_shape):
+        raise ValueError(f"axis_names {axis_names} does not match mesh_shape "
+                         f"{tuple(mesh_shape)}")
     mesh = Mesh(devices.reshape(tuple(mesh_shape)), tuple(axis_names))
     _env["mesh"] = mesh
     _env["initialized"] = True

@@ -26,18 +26,13 @@ def init(role_maker=None, is_collective: bool = True,
     import jax
     n = jax.device_count()
     degrees = {
-        "data": int(hc.get("dp_degree", 1)),
+        "data": int(hc.get("dp_degree", -1)),
         "pipe": int(hc.get("pp_degree", 1)),
         "sharding": int(hc.get("sharding_degree", 1)),
         "sep": int(hc.get("sep_degree", 1)),
         "model": int(hc.get("mp_degree", 1)),
     }
-    fixed = 1
-    for v in degrees.values():
-        fixed *= max(v, 1)
-    if all(v <= 1 for v in degrees.values()):
-        degrees["data"] = n          # pure-DP default, like the reference
-    elif degrees["data"] in (0, -1) or fixed != n:
+    if degrees["data"] in (0, -1):
         # infer dp to fill the machine (reference allows dp_degree=-1 = auto)
         rest = 1
         for k, v in degrees.items():
@@ -47,6 +42,16 @@ def init(role_maker=None, is_collective: bool = True,
             raise ValueError(f"hybrid degrees {degrees} do not divide device "
                              f"count {n}")
         degrees["data"] = n // rest
+    else:
+        # explicit degrees must multiply out to the device count — never
+        # silently override a user-set dp_degree (reference raises on mismatch)
+        prod = 1
+        for v in degrees.values():
+            prod *= max(v, 1)
+        if prod != n:
+            raise ValueError(
+                f"hybrid degrees {degrees} multiply to {prod} but "
+                f"{n} devices are available; set dp_degree=-1 to infer dp")
     _maybe_init_multihost()
     topo = CommunicateTopology(AXES, [degrees[a] for a in AXES])
     HybridCommunicateGroup(topo)  # builds + registers the global mesh

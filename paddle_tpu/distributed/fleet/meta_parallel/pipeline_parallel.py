@@ -71,7 +71,8 @@ class PipelineParallel(Layer):
                 scaler.scale(scaled).backward()
             else:
                 scaled.backward()
-            total = scaled if total is None else total + scaled.detach()
+            total = (scaled.detach() if total is None
+                     else total + scaled.detach())
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

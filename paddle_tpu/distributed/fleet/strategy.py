@@ -27,8 +27,9 @@ class DistributedStrategy:
                                  "schedule_mode": "1F1B"}
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        # dp_degree -1 = infer from device count (reference default: dp auto)
         self.hybrid_configs = {
-            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
             "sharding_degree": 1, "sep_degree": 1,
         }
         self.heter_ccl_mode = False

@@ -84,6 +84,12 @@ class TrainStep:
         static["lr_scales"] = tuple(
             float(p.optimize_attr.get("learning_rate", 1.0))
             for p in params if p.trainable)
+        # AdamW apply_decay_param_fun / Lamb exclusion compiled into the step
+        static["wd_scales"] = tuple(
+            opt._wd_scale(p) for p in params if p.trainable)
+        # grad clip (e.g. ClipGradByGlobalNorm) is pure jnp math — compile it in,
+        # matching eager Optimizer.step (reference static path compiles clip ops)
+        grad_clip = opt._grad_clip
 
         def run_model(param_arrays, buffer_arrays, input_arrays):
             ctx = dispatch.TraceContext()
@@ -131,6 +137,9 @@ class TrainStep:
             diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(diff_in)
+
+            if grad_clip is not None:
+                grads = [g for _, g in grad_clip(list(zip(diff_in, grads)))]
 
             # the update runs on the master copy where one exists (fp32 math),
             # else directly on the param

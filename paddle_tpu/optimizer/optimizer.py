@@ -92,6 +92,10 @@ class Optimizer:
     def _static_config(self):
         return (("weight_decay", self._weight_decay),)
 
+    def _wd_scale(self, p: Parameter) -> float:
+        """Per-param weight-decay multiplier (AdamW/Lamb exclusion hooks)."""
+        return 1.0
+
     def _scalars(self, lr):
         self._step_count += 1
         return {"lr": jnp.asarray(lr, jnp.float32),
@@ -136,9 +140,11 @@ class Optimizer:
         # per-param lr scale (ParamAttr learning_rate)
         lr_scales = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
                           for p in params)
+        wd_scales = tuple(self._wd_scale(p) for p in params)
         states = [self._accumulators[id(p)] for p in params]
 
-        static_key = self._static_config() + (("lr_scales", lr_scales),)
+        static_key = self._static_config() + (("lr_scales", lr_scales),
+                                              ("wd_scales", wd_scales))
         new_params, new_states = _jitted_update(type(self), static_key)(
             param_vals, [g.astype(v.dtype) for g, v in zip(grads, param_vals)],
             states, scalars)
@@ -215,10 +221,11 @@ class SGD(Optimizer):
     _state_names: List[str] = []
 
     @staticmethod
-    def _update_rule(params, grads, states, scalars, weight_decay=0.0, lr_scales=()):
+    def _update_rule(params, grads, states, scalars, weight_decay=0.0, lr_scales=(),
+                     wd_scales=()):
         lr = scalars["lr"]
-        new_params = [p - (lr * s) * _apply_wd(p, g, weight_decay)
-                      for p, g, s in zip(params, grads, lr_scales)]
+        new_params = [p - (lr * s) * _apply_wd(p, g, weight_decay * w)
+                      for p, g, s, w in zip(params, grads, lr_scales, wd_scales)]
         return new_params, states
 
 
@@ -239,11 +246,11 @@ class Momentum(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, momentum=0.9,
-                     use_nesterov=False, lr_scales=()):
+                     use_nesterov=False, lr_scales=(), wd_scales=()):
         lr = scalars["lr"]
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
-            g = _apply_wd(p, g, weight_decay)
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
+            g = _apply_wd(p, g, weight_decay * w)
             v = momentum * st["velocity"] + g
             if use_nesterov:
                 p2 = p - (lr * s) * (g + momentum * v)
@@ -273,22 +280,23 @@ class Adam(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, beta1=0.9,
-                     beta2=0.999, epsilon=1e-8, lr_scales=(), decouple_wd=False):
+                     beta2=0.999, epsilon=1e-8, lr_scales=(), wd_scales=(),
+                     decouple_wd=False):
         lr = scalars["lr"]
         t = scalars["step"]
         bc1 = 1.0 - beta1 ** t
         bc2 = 1.0 - beta2 ** t
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
             if not decouple_wd:
-                g = _apply_wd(p, g, weight_decay)
+                g = _apply_wd(p, g, weight_decay * w)
             m1 = beta1 * st["moment1"] + (1 - beta1) * g
             m2 = beta2 * st["moment2"] + (1 - beta2) * jnp.square(g)
             m1h = m1 / bc1
             m2h = m2 / bc2
             step_v = (lr * s) * m1h / (jnp.sqrt(m2h) + epsilon)
-            if decouple_wd and weight_decay:
-                step_v = step_v + (lr * s) * weight_decay * p
+            if decouple_wd and weight_decay * w:
+                step_v = step_v + (lr * s) * (weight_decay * w) * p
             new_params.append(p - step_v)
             new_states.append({"moment1": m1, "moment2": m2})
         return new_params, new_states
@@ -309,30 +317,11 @@ class AdamW(Adam):
     def _static_config(self):
         return super()._static_config() + (("decouple_wd", True),)
 
-    @no_grad()
-    def step(self):
-        if self._apply_decay_param_fun is not None:
-            # zero out decay for excluded params by splitting the step
-            wd = self._weight_decay
-            included = [p for p in self._parameter_list
-                        if self._apply_decay_param_fun(p.name)]
-            excluded = [p for p in self._parameter_list
-                        if not self._apply_decay_param_fun(p.name)]
-            all_params = self._parameter_list
-            saved_step = self._step_count
-            try:
-                self._parameter_list = included
-                self._weight_decay = wd
-                super().step()
-                self._parameter_list = excluded
-                self._weight_decay = 0.0
-                self._step_count = saved_step  # same logical step for both halves
-                super().step()
-            finally:
-                self._parameter_list = all_params
-                self._weight_decay = wd
-            return
-        super().step()
+    def _wd_scale(self, p):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            return 0.0
+        return 1.0
 
 
 class Adamax(Optimizer):
@@ -350,13 +339,13 @@ class Adamax(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, beta1=0.9,
-                     beta2=0.999, epsilon=1e-8, lr_scales=()):
+                     beta2=0.999, epsilon=1e-8, lr_scales=(), wd_scales=()):
         lr = scalars["lr"]
         t = scalars["step"]
         bc1 = 1.0 - beta1 ** t
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
-            g = _apply_wd(p, g, weight_decay)
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
+            g = _apply_wd(p, g, weight_decay * w)
             m = beta1 * st["moment"] + (1 - beta1) * g
             u = jnp.maximum(beta2 * st["inf_norm"], jnp.abs(g))
             new_params.append(p - (lr * s) / bc1 * m / (u + epsilon))
@@ -386,11 +375,11 @@ class Adagrad(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, epsilon=1e-6,
-                     lr_scales=()):
+                     lr_scales=(), wd_scales=()):
         lr = scalars["lr"]
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
-            g = _apply_wd(p, g, weight_decay)
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
+            g = _apply_wd(p, g, weight_decay * w)
             m = st["moment"] + jnp.square(g)
             new_params.append(p - (lr * s) * g / (jnp.sqrt(m) + epsilon))
             new_states.append({"moment": m})
@@ -411,11 +400,11 @@ class Adadelta(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, epsilon=1e-6,
-                     rho=0.95, lr_scales=()):
+                     rho=0.95, lr_scales=(), wd_scales=()):
         lr = scalars["lr"]
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
-            g = _apply_wd(p, g, weight_decay)
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
+            g = _apply_wd(p, g, weight_decay * w)
             asg = rho * st["avg_squared_grad"] + (1 - rho) * jnp.square(g)
             upd = g * jnp.sqrt(st["avg_squared_update"] + epsilon) / jnp.sqrt(asg + epsilon)
             asu = rho * st["avg_squared_update"] + (1 - rho) * jnp.square(upd)
@@ -442,11 +431,12 @@ class RMSProp(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, rho=0.95,
-                     epsilon=1e-6, momentum=0.0, centered=False, lr_scales=()):
+                     epsilon=1e-6, momentum=0.0, centered=False, lr_scales=(),
+                     wd_scales=()):
         lr = scalars["lr"]
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
-            g = _apply_wd(p, g, weight_decay)
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
+            g = _apply_wd(p, g, weight_decay * w)
             ms = rho * st["mean_square"] + (1 - rho) * jnp.square(g)
             if centered:
                 mg = rho * st["mean_grad"] + (1 - rho) * g
@@ -471,6 +461,11 @@ class Lamb(Optimizer):
         self._beta1, self._beta2, self._epsilon = float(beta1), float(beta2), float(epsilon)
         self._exclude_fn = exclude_from_weight_decay_fn
 
+    def _wd_scale(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return 1.0
+
     def _static_config(self):
         return super()._static_config() + (("beta1", self._beta1),
                                            ("beta2", self._beta2),
@@ -478,16 +473,16 @@ class Lamb(Optimizer):
 
     @staticmethod
     def _update_rule(params, grads, states, scalars, weight_decay=0.0, beta1=0.9,
-                     beta2=0.999, epsilon=1e-6, lr_scales=()):
+                     beta2=0.999, epsilon=1e-6, lr_scales=(), wd_scales=()):
         lr = scalars["lr"]
         t = scalars["step"]
         bc1 = 1.0 - beta1 ** t
         bc2 = 1.0 - beta2 ** t
         new_params, new_states = [], []
-        for p, g, st, s in zip(params, grads, states, lr_scales):
+        for p, g, st, s, w in zip(params, grads, states, lr_scales, wd_scales):
             m1 = beta1 * st["moment1"] + (1 - beta1) * g
             m2 = beta2 * st["moment2"] + (1 - beta2) * jnp.square(g)
-            r = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + epsilon) + weight_decay * p
+            r = (m1 / bc1) / (jnp.sqrt(m2 / bc2) + epsilon) + (weight_decay * w) * p
             w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
             r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
             trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

@@ -250,7 +250,7 @@ def test_recompute_dropout_rng_replay():
 
 def test_pipeline_layer_and_train_batch():
     strategy = DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 1, "pp_degree": 2,
                                "sharding_degree": 1, "sep_degree": 1}
     strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
     fleet.init(is_collective=True, strategy=strategy)

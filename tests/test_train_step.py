@@ -1,0 +1,106 @@
+"""TrainStep (one-executable train step) vs eager step parity.
+
+Reference analog: the static-graph path compiles grad clip and the AdamW decay
+split into the program (fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py; python/paddle/optimizer/adamw.py
+apply_decay_param_fun) — both paths must produce identical parameters.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x, labels):
+        h = self.fc2(F.relu(self.fc1(x)))
+        return F.cross_entropy(h, labels).mean()
+
+
+def _make(opt_factory):
+    paddle.seed(7)
+    model = MLP()
+    opt = opt_factory(model)
+    return model, opt
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (16, 1)).astype("int64"))
+    return x, y
+
+
+@pytest.mark.parametrize("use_clip", [False, True])
+def test_train_step_matches_eager_adamw_clip_and_decay_split(use_clip):
+    def factory(model):
+        return paddle.optimizer.AdamW(
+            learning_rate=0.1, weight_decay=0.5,
+            parameters=model.parameters(),
+            grad_clip=(nn.ClipGradByGlobalNorm(1.0) if use_clip else None),
+            apply_decay_param_fun=lambda n: "bias" not in (n or ""))
+
+    model_e, opt_e = _make(factory)
+    model_s, opt_s = _make(factory)
+    x, y = _data()
+
+    for _ in range(3):
+        loss = model_e(x, y)
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    step = paddle.jit.TrainStep(model_s, opt_s)
+    for _ in range(3):
+        loss_s = step(x, y)
+
+    for (n_e, p_e), (n_s, p_s) in zip(model_e.named_parameters(),
+                                      model_s.named_parameters()):
+        assert n_e == n_s
+        np.testing.assert_allclose(p_e.numpy(), p_s.numpy(), rtol=2e-5,
+                                   atol=2e-6, err_msg=n_e)
+
+
+def test_train_step_global_norm_clip_changes_update():
+    """With lr big enough, the clipped and unclipped trajectories must differ —
+    guards against clip being silently dropped from the compiled path."""
+    def clipped(model):
+        return paddle.optimizer.AdamW(learning_rate=0.1,
+                                      parameters=model.parameters(),
+                                      grad_clip=nn.ClipGradByGlobalNorm(1e-3))
+
+    def unclipped(model):
+        return paddle.optimizer.AdamW(learning_rate=0.1,
+                                      parameters=model.parameters())
+
+    x, y = _data()
+    outs = []
+    for factory in (clipped, unclipped):
+        model, opt = _make(factory)
+        step = paddle.jit.TrainStep(model, opt)
+        step(x, y)
+        outs.append(np.concatenate(
+            [p.numpy().ravel() for p in model.parameters()]))
+    assert not np.allclose(outs[0], outs[1])
+
+
+def test_eager_adamw_decay_split_excludes_bias():
+    """Decay-excluded params must not shrink when grads are zero."""
+    paddle.seed(3)
+    model = MLP()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.0, weight_decay=0.9, parameters=model.parameters(),
+        apply_decay_param_fun=lambda n: "bias" not in (n or ""))
+    # lr=0 → adam step contributes nothing; only (decoupled) decay could move
+    # params, and decay is scaled by lr → nothing moves; flip to check wiring:
+    wd_scales = [opt._wd_scale(p) for p in model.parameters()]
+    names = [n for n, _ in model.named_parameters()]
+    for n, s in zip(names, wd_scales):
+        assert s == (0.0 if "bias" in n else 1.0), (n, s)
