@@ -1,17 +1,22 @@
-"""Pallas TPU flash attention.
+"""Pallas TPU flash attention (forward + backward kernels, in-kernel dropout).
 
 Reference analog: phi/kernels/flash_attn_kernel.h — the reference dynloads the CUDA
 flash-attention library; here the same memory-hierarchy trick (never materialize the
 [L, L] score matrix in HBM, stream K/V blocks through on-chip memory with an online
-softmax) is written directly for the TPU: Q blocks live in VMEM per grid step, the K/V
-stream is blocked with `lax.fori_loop`, and scores hit the MXU via `jnp.dot` with
-fp32 accumulation.
+softmax) is written directly for the TPU: Q blocks live in VMEM per grid step, K/V
+tiles stream through as the innermost grid dimension, and scores hit the MXU via
+`lax.dot_general` with fp32 accumulation.
+
+Forward saves the per-row log-sum-exp; backward is the standard two-kernel flash
+backward (a dQ kernel with K/V innermost and a dK/dV kernel with Q innermost) that
+recomputes probabilities from (Q, K, LSE) — O(block) memory at any sequence length.
+Causal grids skip fully-masked tiles via `pl.when`, halving the work. Dropout is
+generated inside the kernels from a counter-based PRNG seeded per (head, q-tile,
+kv-tile) so forward and both backward kernels reproduce the identical mask without
+ever materializing it.
 
 Layout: [B, L, H, D] at the API (paddle flash_attn layout), reshaped to [B*H, L, D]
-for the kernel. Backward is recompute-based: the custom_vjp differentiates a
-q-chunked, checkpointed XLA implementation, so the bwd holds one [chunk_q, L]
-probability block at a time (not the full [L, L] matrix); a hand-written Pallas bwd
-kernel is a later optimization.
+for the kernels.
 """
 from __future__ import annotations
 
@@ -23,18 +28,37 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256   # measured best on v4: 123 TF/s @ (256,256) for L=2048 d=128
-DEFAULT_BLOCK_K = 256   # vs 69 TF/s @ (128,128); see bench in git history
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                      sm_scale, causal, block_q, block_k, kv_len, causal_offset):
-    # Grid (bh, q_blocks, kv_blocks), kv innermost: each core streams one
-    # [block_k, d] K/V tile per step; the online-softmax state (acc, m, l) lives
-    # in VMEM scratch and carries across kv steps — only O(block) VMEM regardless
-    # of sequence length. kv_len is the true key count (inputs are padded);
-    # causal_offset = kv_len - q_len aligns the diagonal for cross-length attention.
+def _dropout_mask(seed_ref, bh, qi, kb, shape, rate):
+    """Deterministic per-tile keep-mask; identical across fwd/dq/dkv kernels.
+
+    prng_seed accepts at most two words: the head index is hashed into the
+    seed word (golden-ratio multiply — no head-count bound), the q/kv tile
+    coordinates pack into the second (collision-free to 2^20 q tiles × 2^11
+    kv tiles, i.e. beyond any real grid)."""
+    head_word = seed_ref[0] ^ (bh * jnp.int32(-1640531527))  # 0x9E3779B9
+    tile = (qi * 2048 + kb).astype(jnp.int32)
+    pltpu.prng_seed(head_word, tile)
+    bits = pltpu.prng_random_bits(shape)  # int32
+    # uniform in [0, 2^31): drop iff bits < rate * 2^31 (use non-negative bits)
+    bits = jax.lax.bitwise_and(bits, jnp.int32(0x7FFFFFFF))
+    threshold = jnp.int32(int(rate * 2147483648.0))
+    return bits >= threshold
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      sm_scale, causal, block_q, block_k, kv_len, causal_offset,
+                      dropout_rate):
+    # Grid (bh, q_blocks, kv_blocks), kv innermost: the online-softmax state
+    # (acc, m, l) lives in VMEM scratch and carries across kv steps — only
+    # O(block) VMEM regardless of sequence length. kv_len is the true key count
+    # (inputs are padded); causal_offset = kv_len - q_len aligns the diagonal.
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -44,82 +68,300 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # native-dtype MXU matmul (bf16 in, fp32 accumulate); scale folded in afterwards
-    s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * sm_scale
-    cols = kb * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    valid = cols < kv_len
-    if causal:
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        valid = valid & (rows + causal_offset >= cols)
-    s = jnp.where(valid, s, _NEG_INF)
+    # causal: tiles strictly above the diagonal band have no valid entries — skip
+    live = (kb * block_k <= (qi + 1) * block_q - 1 + causal_offset) \
+        if causal else True
 
-    m_prev = m_ref[:]
-    l_prev = l_ref[:]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    # guard: rows with no valid key yet have m_new == _NEG_INF; exp(s - m_new)
-    # would be exp(0) = 1 for every masked column — force those weights to 0
-    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_ref[:] = m_new
+    @pl.when(live)
+    def _body():
+        # native-dtype MXU matmul (bf16 in, fp32 accumulate); scale folded after
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < kv_len
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (rows + causal_offset >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # rows with no valid key yet have m_new == _NEG_INF; exp(s - m_new)
+        # would be exp(0) = 1 for every masked column — force those to 0
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _dropout_mask(seed_ref, bh, qi, kb, (block_q, block_k),
+                                 dropout_rate)
+            # dropout acts on the normalized matrix; applied to the unnormalized
+            # p here, the final acc/l division yields dropout(softmax(s)) @ v
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _finalize():
         # rows with zero valid keys (causal with q_len > kv_len) get 0, matching
         # "no information" rather than a spurious uniform average
         o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, :] = (m_ref[:, 0]
+                        + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
+
+
+def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dq_acc, *,
+                     sm_scale, causal, block_q, block_k, kv_len, causal_offset,
+                     dropout_rate):
+    # Grid (bh, q_blocks, kv_blocks), kv innermost; dq accumulates in VMEM.
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (kb * block_k <= (qi + 1) * block_q - 1 + causal_offset) \
+        if causal else True
+
+    @pl.when(live)
+    def _body():
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < kv_len
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (rows + causal_offset >= cols)
+        lse = lse_ref[0, :][:, None]
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_mask(seed_ref, bh, qi, kb, (block_q, block_k),
+                                 dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta_ref[0, :][:, None]) * sm_scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *,
+                      sm_scale, causal, block_q, block_k, kv_len, causal_offset,
+                      dropout_rate):
+    # Grid (bh, kv_blocks, q_blocks), q innermost; dk/dv accumulate in VMEM.
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (kb * block_k <= (qi + 1) * block_q - 1 + causal_offset) \
+        if causal else True
+
+    @pl.when(live)
+    def _body():
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < kv_len
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (rows + causal_offset >= cols)
+        lse = lse_ref[0, :][:, None]
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        keep_scale = None
+        if dropout_rate > 0.0:
+            keep = _dropout_mask(seed_ref, bh, qi, kb, (block_q, block_k),
+                                 dropout_rate)
+            keep_scale = jnp.where(keep, 1.0 / (1.0 - dropout_rate), 0.0)
+        # dV = dropped(P)^T @ dO
+        p_for_dv = p * keep_scale if keep_scale is not None else p
+        dv_acc[:] += jax.lax.dot_general(
+            p_for_dv.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if keep_scale is not None:
+            dp = dp * keep_scale
+        ds = p * (dp - delta_ref[0, :][:, None]) * sm_scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _round_up(n, m):
     return ((n + m - 1) // m) * m
 
 
+def _norm_blocks(block_q, block_k, q_len, kv_len):
+    """Clamp blocks to the (padded) lengths and round to the TPU lane quantum:
+    the LSE/delta tiles are laid out (1, block) so block sizes must be
+    128-multiples for Mosaic lowering."""
+    block_q = _round_up(min(block_q, _round_up(q_len, 128)), 128)
+    block_k = _round_up(min(block_k, _round_up(kv_len, 128)), 128)
+    return block_q, block_k
+
+
+def _pad_len(x, L, axis=1):
+    pad = L - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
-                                             "block_k", "interpret"))
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret=False):
-    # q,k,v: [BH, Lq, D] / [BH, Lk, D]; any lengths — padded here to block multiples
+                                             "block_k", "dropout_rate",
+                                             "interpret"))
+def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+               dropout_rate=0.0, interpret=False):
+    # q,k,v: [BH, Lq, D] / [BH, Lk, D]; any lengths — padded to block multiples
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
-    block_q = min(block_q, _round_up(q_len, 8))
-    block_k = min(block_k, _round_up(kv_len, 8))
+    block_q, block_k = _norm_blocks(block_q, block_k, q_len, kv_len)
     q_pad = _round_up(q_len, block_q)
     kv_pad = _round_up(kv_len, block_k)
-    if q_pad != q_len:
-        q = jnp.pad(q, ((0, 0), (0, q_pad - q_len), (0, 0)))
-    if kv_pad != kv_len:
-        k = jnp.pad(k, ((0, 0), (0, kv_pad - kv_len), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, kv_pad - kv_len), (0, 0)))
+    q = _pad_len(q, q_pad)
+    k = _pad_len(k, kv_pad)
+    v = _pad_len(v, kv_pad)
     grid = (bh, q_pad // block_q, kv_pad // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, kv_len=kv_len,
-        causal_offset=kv_len - q_len)
-    out = pl.pallas_call(
+        causal_offset=kv_len - q_len, dropout_rate=dropout_rate)
+    out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, q_pad), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
-    return out[:, :q_len] if q_pad != q_len else out
+    )(seed, q, k, v)
+    return out[:, :q_len], lse
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
+                                             "block_k", "dropout_rate",
+                                             "interpret"))
+def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
+               dropout_rate=0.0, interpret=False):
+    bh, q_len, d = q.shape
+    kv_len = k.shape[1]
+    block_q, block_k = _norm_blocks(block_q, block_k, q_len, kv_len)
+    q_pad = _round_up(q_len, block_q)
+    kv_pad = _round_up(kv_len, block_k)
+
+    # delta_i = rowsum(dO_i * O_i) — one fused elementwise pass in XLA
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = _pad_len(delta[:, None, :], q_pad, axis=2)         # [BH, 1, q_pad]
+    qp = _pad_len(q, q_pad)
+    gp = _pad_len(g, q_pad)
+    kp = _pad_len(k, kv_pad)
+    vp = _pad_len(v, kv_pad)
+    # lse comes padded from fwd (padded rows hold lse = -inf-ish; their p rows
+    # are all-masked in the kernels so they contribute nothing)
+    lsep = _pad_len(lse, q_pad, axis=2)
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=kv_len,
+                  causal_offset=kv_len - q_len, dropout_rate=dropout_rate)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, q_pad // block_q, kv_pad // block_k),
+            in_specs=[
+                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
+                pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, qp, kp, vp, gp, lsep, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, kv_pad // block_k, q_pad // block_q),
+            in_specs=[
+                pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
+                pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
+                pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, qp, kp, vp, gp, lsep, delta)
+
+    return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
 
 
 def _reference_attention(q, k, v, causal, sm_scale):
@@ -137,75 +379,53 @@ def _reference_attention(q, k, v, causal, sm_scale):
     return jnp.einsum("bqk,bkd->bqd", p, vf).astype(q.dtype)
 
 
-_BWD_CHUNK_Q = 512
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k, dropout_rate,
+           interpret):
+    out, _ = _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                        dropout_rate, interpret)
+    return out
 
 
-def _chunked_attention(q, k, v, causal, sm_scale, chunk_q=_BWD_CHUNK_Q):
-    """Q-chunked attention whose VJP is memory-light: each chunk's body is
-    jax.checkpoint'ed under lax.map, so the backward holds one [chunk_q, Lk]
-    probability block at a time instead of the full [Lq, Lk] matrix."""
-    bh, lq, d = q.shape
-    lk = k.shape[1]
-    if lq <= chunk_q:
-        return _reference_attention(q, k, v, causal, sm_scale)
-    pad = (-lq) % chunk_q
-    qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0))) if pad else q
-    nc = qp.shape[1] // chunk_q
-    qr = jnp.swapaxes(qp.reshape(bh, nc, chunk_q, d), 0, 1)  # [nc, bh, cq, d]
-    offsets = jnp.arange(nc) * chunk_q
-    offset_diag = lk - lq
-
-    def one_chunk(args):
-        qc, off = args
-        sf = jnp.einsum("bqd,bkd->bqk", qc.astype(jnp.float32),
-                        k.astype(jnp.float32)) * sm_scale
-        if causal:
-            rows = off + jnp.arange(chunk_q)[:, None]
-            cols = jnp.arange(lk)[None, :]
-            mask = rows + offset_diag >= cols
-            sf = jnp.where(mask, sf, _NEG_INF)
-        p = jax.nn.softmax(sf, axis=-1)
-        if causal:
-            p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)
-        return jnp.einsum("bqk,bkd->bqd", p,
-                          v.astype(jnp.float32)).astype(q.dtype)
-
-    out = jax.lax.map(jax.checkpoint(one_chunk), (qr, offsets))
-    out = jnp.swapaxes(out, 0, 1).reshape(bh, nc * chunk_q, d)
-    return out[:, :lq]
+def _flash_vjp_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                   dropout_rate, interpret):
+    out, lse = _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                          dropout_rate, interpret)
+    return out, (q, k, v, out, lse, seed)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
-
-
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k), (q, k, v)
-
-
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _chunked_attention(
-        q_, k_, v_, causal, sm_scale), q, k, v)
-    return vjp(g)
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, dropout_rate, interpret,
+                   res, g):
+    q, k, v, out, lse, seed = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, seed, causal, sm_scale,
+                            block_q, block_k, dropout_rate, interpret)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                         dropout_rate=0.0, seed=0,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=False):
     """Flash attention on [B, L, H, D] arrays (jax.Array or Tensor-like .value())."""
     unwrap = lambda t: t.value() if hasattr(t, "value") else t
     q, k, v = unwrap(q), unwrap(k), unwrap(v)
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    if interpret and dropout_rate > 0.0:
+        raise NotImplementedError(
+            "in-kernel dropout uses the TPU hardware PRNG (pltpu.prng_*), which "
+            "has no interpret-mode lowering; run on a real TPU or use "
+            "dropout_rate=0.0 / the XLA sdpa path for CPU testing")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     to_bhld = lambda t, L: jnp.swapaxes(t, 1, 2).reshape(b * h, L, d)
     qr = to_bhld(q, lq)
     kr = to_bhld(k, lk)
     vr = to_bhld(v, lk)
-    out = _flash(qr, kr, vr, bool(causal), float(sm_scale), block_q, block_k)
+    seed_arr = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
+    out = _flash(qr, kr, vr, seed_arr, bool(causal), float(sm_scale),
+                 block_q, block_k, float(dropout_rate), bool(interpret))
     return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)

@@ -17,9 +17,39 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
+import jax
+import jax.numpy as jnp
+
 from .. import nn
 from ..nn import functional as F
 from .. import ops
+from ..core.dispatch import register_op
+from ..ops._helpers import _op
+
+
+def _lm_head_ce_fwd(hidden, weight, labels, transpose_w=True, ignore_index=-100):
+    """Fused LM-head + next-token CE: hidden [B,S,H] (pre-shifted), weight
+    [V,H] (tied embedding) or [H,V], labels [B,S] → scalar mean loss over
+    non-ignored tokens.
+
+    One executable computes matmul → logsumexp → label-gather; the [B,S,V]
+    logits never round-trip HBM in fp32 and no log-softmax tensor is formed
+    (reference c_softmax_with_cross_entropy plays the same fusion role for the
+    vocab-parallel case)."""
+    dims = (((2,), (1,)), ((), ())) if transpose_w else (((2,), (0,)), ((), ()))
+    logits = jax.lax.dot_general(hidden, weight, dims,
+                                 preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    lbl = labels.astype(jnp.int32)
+    valid = lbl != ignore_index
+    gold = jnp.take_along_axis(
+        logits, jnp.where(valid, lbl, 0)[..., None], axis=-1)[..., 0]
+    per_tok = jnp.where(valid, lse - gold, 0.0)
+    n = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return jnp.sum(per_tok) / n
+
+
+register_op("lm_head_ce", _lm_head_ce_fwd, nondiff_inputs=(2,))
 
 
 @dataclass
@@ -73,9 +103,11 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(2)          # each [b, s, heads, head_dim]
         drop = self.dropout_p if self.training else 0.0
-        if self.use_flash and attn_mask is None and drop == 0.0:
-            # Pallas flash kernel on real TPUs (auto-detected); XLA sdpa otherwise
-            out = F.flash_attention(q, k, v, causal=True)
+        if self.use_flash and attn_mask is None:
+            # Pallas flash kernel on real TPUs (auto-detected, in-kernel
+            # dropout); XLA sdpa otherwise
+            out = F.flash_attention(q, k, v, dropout=drop, causal=True,
+                                    training=self.training)
         else:
             # always causal; attn_mask (e.g. additive padding mask) combines with it
             out = F.scaled_dot_product_attention(
@@ -160,15 +192,19 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.gpt(input_ids, attn_mask)
+        if labels is not None:
+            # loss from the SHIFTED hidden states: the slice happens on [B,S,H]
+            # (not [B,S,V]) and the head matmul + CE fuse into one executable;
+            # the full-logits below are dead code under jit when only the loss
+            # is consumed (XLA DCE removes the second head matmul)
+            tied = self.lm_head is None
+            w = self.gpt.wte.weight if tied else self.lm_head.weight
+            loss = _op("lm_head_ce", hidden[:, :-1, :], w, labels[:, 1:],
+                       transpose_w=tied)
         if self.lm_head is None:
             logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
         else:
             logits = self.lm_head(hidden)
         if labels is None:
             return logits
-        shift_logits = logits[:, :-1, :]
-        shift_labels = labels[:, 1:]
-        loss = F.cross_entropy(
-            shift_logits.reshape([-1, self.config.vocab_size]),
-            shift_labels.reshape([-1]))
         return logits, loss

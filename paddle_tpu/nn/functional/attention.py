@@ -51,14 +51,17 @@ def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
 register_op("sdpa", _sdpa_fwd, nondiff_inputs=(3, 4))
 
 
-def _flash_attn_pallas_fwd(q, k, v, causal=False):
+def _flash_attn_pallas_fwd(q, k, v, *rest, causal=False, dropout_rate=0.0):
     from ...kernels.pallas.flash_attention import flash_attention_blhd
-    return flash_attention_blhd(q, k, v, causal=causal)
+    seed = rest[0] if rest else 0
+    return flash_attention_blhd(q, k, v, causal=causal,
+                                dropout_rate=dropout_rate, seed=seed)
 
 
 # Pallas flash attention as a dispatch op: flows through the autograd tape; its
-# custom_vjp supplies the gradient under the generic jit(vjp) backward.
-register_op("flash_attn_pallas", _flash_attn_pallas_fwd)
+# custom_vjp supplies the gradient under the generic jit(vjp) backward. The
+# dropout seed (input 3, when present) is a nondiff program-state input.
+register_op("flash_attn_pallas", _flash_attn_pallas_fwd, nondiff_inputs=(3,))
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
@@ -89,14 +92,17 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     fuses into a flash-like schedule anyway for moderate L).
     """
     drop = float(dropout) if training else 0.0
-    if use_pallas and drop > 0.0:
-        raise ValueError("the Pallas flash-attention kernel has no dropout path; "
-                         "use dropout=0.0 or use_pallas=False")
     if use_pallas is None:
-        # the pallas kernel has no dropout path; fall back when dropout is active
-        use_pallas = drop == 0.0 and _pallas_usable(query)
+        use_pallas = _pallas_usable(query)
     if use_pallas:
-        out = _op("flash_attn_pallas", query, key, value, causal=bool(causal))
+        args = [query, key, value]
+        if drop > 0.0:
+            # in-kernel counter-based dropout; seed drawn from the global RNG
+            # chain so to_static replays give fresh masks (threaded state)
+            seed = jax.random.key_data(rng.split_key()).ravel()[0].astype(jnp.int32)
+            args.append(Tensor(seed))
+        out = _op("flash_attn_pallas", *args, causal=bool(causal),
+                  dropout_rate=drop)
     else:
         out = scaled_dot_product_attention(query, key, value, dropout_p=drop,
                                            is_causal=bool(causal),

@@ -78,8 +78,6 @@ def test_bert_pretraining_loss():
 
 
 def test_flash_attention_pallas_interpret_matches_sdpa():
-    import functools
-
     import jax
     import jax.numpy as jnp
 
@@ -88,52 +86,74 @@ def test_flash_attention_pallas_interpret_matches_sdpa():
     q = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 2, 64), jnp.float32)
     k = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 2, 64), jnp.float32)
     v = jax.random.normal(jax.random.PRNGKey(2), (2, 128, 2, 64), jnp.float32)
-    orig = fa._flash_fwd
-    fa._flash_fwd = functools.partial(orig, interpret=True)
-    try:
-        for causal in (False, True):
-            out = fa.flash_attention_blhd(q, k, v, causal=causal, block_q=64,
-                                          block_k=64)
-            b, l, h, d = q.shape
-            r = lambda t: jnp.swapaxes(t, 1, 2).reshape(b * h, l, d)
-            ref = fa._reference_attention(r(q), r(k), r(v), causal,
-                                          1.0 / np.sqrt(d))
-            ref = jnp.swapaxes(ref.reshape(b, h, l, d), 1, 2)
-            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                       atol=2e-5, rtol=2e-3)
-    finally:
-        fa._flash_fwd = orig
+    for causal in (False, True):
+        out = fa.flash_attention_blhd(q, k, v, causal=causal, block_q=64,
+                                      block_k=64, interpret=True)
+        b, l, h, d = q.shape
+        r = lambda t: jnp.swapaxes(t, 1, 2).reshape(b * h, l, d)
+        ref = fa._reference_attention(r(q), r(k), r(v), causal,
+                                      1.0 / np.sqrt(d))
+        ref = jnp.swapaxes(ref.reshape(b, h, l, d), 1, 2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-3)
 
 
-def test_flash_attention_pallas_ragged_lengths():
-    """Regression: non-block-multiple and mismatched q/kv lengths (code-review
-    finding: the unpadded kernel double-counted clamped K/V blocks)."""
-    import functools
-
+def test_flash_attention_pallas_backward_matches_reference():
+    """The Pallas dQ/dK/dV kernels vs jax.grad of the fp32 reference."""
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.kernels.pallas import flash_attention as fa
 
-    orig = fa._flash_fwd
-    fa._flash_fwd = functools.partial(orig, interpret=True)
-    try:
-        for lq, lk in [(160, 160), (200, 128), (100, 300), (1, 256)]:
-            q = jax.random.normal(jax.random.PRNGKey(0), (1, lq, 2, 64))
-            k = jax.random.normal(jax.random.PRNGKey(1), (1, lk, 2, 64))
-            v = jax.random.normal(jax.random.PRNGKey(2), (1, lk, 2, 64))
-            for causal in (False, True):
-                out = fa.flash_attention_blhd(q, k, v, causal=causal)
-                r = lambda t, L: jnp.swapaxes(t, 1, 2).reshape(2, L, 64)
-                ref = fa._reference_attention(r(q, lq), r(k, lk), r(v, lk),
-                                              causal, 1.0 / np.sqrt(64))
-                ref = jnp.swapaxes(ref.reshape(1, 2, lq, 64), 1, 2)
-                # tolerance = fp32 softmax noise (both impls show ~5e-3 vs fp64
-                # on early causal rows); the pre-fix bug produced ~0.2
-                np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                           atol=2e-2)
-    finally:
-        fa._flash_fwd = orig
+    b, l, h, d = 1, 256, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, l, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, l, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, l, h, d), jnp.float32)
+
+    r = lambda t: jnp.swapaxes(t, 1, 2).reshape(b * h, l, d)
+    for causal in (False, True):
+        def loss_flash(q, k, v):
+            out = fa.flash_attention_blhd(q, k, v, causal=causal, block_q=64,
+                                          block_k=64, interpret=True)
+            return jnp.sum(out * out)
+
+        def loss_ref(q, k, v):
+            out = fa._reference_attention(r(q), r(k), r(v), causal,
+                                          1.0 / np.sqrt(d))
+            out = jnp.swapaxes(out.reshape(b, h, l, d), 1, 2)
+            return jnp.sum(out * out)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                       atol=5e-3, rtol=1e-2,
+                                       err_msg=f"d{name} causal={causal}")
+
+
+def test_flash_attention_pallas_ragged_lengths():
+    """Regression: non-block-multiple and mismatched q/kv lengths (code-review
+    finding: the unpadded kernel double-counted clamped K/V blocks)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.pallas import flash_attention as fa
+
+    for lq, lk in [(160, 160), (200, 128), (100, 300), (1, 256)]:
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, lq, 2, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, lk, 2, 64))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, lk, 2, 64))
+        for causal in (False, True):
+            out = fa.flash_attention_blhd(q, k, v, causal=causal,
+                                          interpret=True)
+            r = lambda t, L: jnp.swapaxes(t, 1, 2).reshape(2, L, 64)
+            ref = fa._reference_attention(r(q, lq), r(k, lk), r(v, lk),
+                                          causal, 1.0 / np.sqrt(64))
+            ref = jnp.swapaxes(ref.reshape(1, 2, lq, 64), 1, 2)
+            # tolerance = fp32 softmax noise (both impls show ~5e-3 vs fp64
+            # on early causal rows); the pre-fix bug produced ~0.2
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-2)
 
 
 def test_attention_dropout_active_in_training():
@@ -153,8 +173,6 @@ def test_attention_dropout_active_in_training():
 
 
 def test_flash_attention_pallas_grad():
-    import functools
-
     import jax
     import jax.numpy as jnp
 
@@ -163,21 +181,16 @@ def test_flash_attention_pallas_grad():
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 1, 32), jnp.float32)
     k = jax.random.normal(jax.random.PRNGKey(1), (1, 64, 1, 32), jnp.float32)
     v = jax.random.normal(jax.random.PRNGKey(2), (1, 64, 1, 32), jnp.float32)
-    orig = fa._flash_fwd
-    fa._flash_fwd = functools.partial(orig, interpret=True)
-    try:
-        g = jax.grad(lambda a, b, c: fa.flash_attention_blhd(
-            a, b, c, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
-        gref = jax.grad(lambda a, b, c: fa._reference_attention(
-            jnp.swapaxes(a, 1, 2).reshape(1, 64, 32),
-            jnp.swapaxes(b, 1, 2).reshape(1, 64, 32),
-            jnp.swapaxes(c, 1, 2).reshape(1, 64, 32), True,
-            1.0 / np.sqrt(32)).sum(), argnums=(0, 1, 2))(q, k, v)
-        for a, b in zip(g, gref):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
-                                       rtol=1e-3)
-    finally:
-        fa._flash_fwd = orig
+    g = jax.grad(lambda a, b, c: fa.flash_attention_blhd(
+        a, b, c, causal=True, interpret=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    gref = jax.grad(lambda a, b, c: fa._reference_attention(
+        jnp.swapaxes(a, 1, 2).reshape(1, 64, 32),
+        jnp.swapaxes(b, 1, 2).reshape(1, 64, 32),
+        jnp.swapaxes(c, 1, 2).reshape(1, 64, 32), True,
+        1.0 / np.sqrt(32)).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-3)
 
 
 def test_vision_transforms_pipeline():
@@ -203,3 +216,26 @@ def test_synthetic_datasets():
     c = Cifar10(mode="train")
     img, label = c[0]
     assert img.shape == (32, 32, 3)
+
+
+def test_gpt_fused_ce_honors_ignore_index():
+    """Fused lm_head_ce must mask ignore_index=-100 labels out of the mean
+    (code-review finding: take_along_axis on -100 poisoned the loss)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    cfg = gpt_tiny(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                   max_position_embeddings=32, hidden_dropout_prob=0.0,
+                   attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    ids_np = np.random.RandomState(0).randint(0, 64, (2, 16)).astype("int32")
+    ids = paddle.to_tensor(ids_np)
+    labels_pad = ids_np.astype("int64")
+    labels_pad[:, 8:] = -100  # padded tail
+    _, loss_pad = model(ids, labels=paddle.to_tensor(labels_pad))
+    assert np.isfinite(float(loss_pad))
+    # ignoring tokens must equal CE computed only over the kept prefix
+    _, loss_full = model(ids, labels=paddle.to_tensor(ids_np.astype("int64")))
+    assert float(loss_pad) != float(loss_full)
