@@ -10,6 +10,17 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Multi-host bootstrap MUST precede any XLA-backend touch (jax.distributed rule),
+# and importing the core modules below initializes the backend — so when the
+# launcher's env contract is present, federate processes here, first thing.
+# (Reference analog: init_parallel_env runs before any device work per rank.)
+import os as _os
+
+if _os.environ.get("PADDLE_MASTER") and \
+        int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+    from .distributed.env import _maybe_init_multihost as _mh
+    _mh()
+
 # core dtypes
 from .core.dtype import (  # noqa: F401
     bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,

@@ -59,14 +59,23 @@ class ParallelEnv:
 
 
 def _maybe_init_multihost():
-    """Initialize jax.distributed from the PADDLE_* env contract when present."""
+    """Initialize jax.distributed from the PADDLE_* env contract when present.
+
+    The launcher (paddle_tpu.distributed.launch) exports PADDLE_MASTER (jax
+    coordinator address), PADDLE_TRAINER_ID (process rank) and
+    PADDLE_TRAINERS_NUM (process world size); jax's coordination service is the
+    TCPStore analog, so bootstrap is just agreeing on that address."""
     master = os.environ.get(ENV_MASTER)
-    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if master and nnodes > 1 and jax.process_count() == 1:
-        node_rank = int(os.environ.get("PADDLE_NODE_RANK",
-                                       os.environ.get(ENV_RANK, "0")))
+    nproc = int(os.environ.get(ENV_WORLD_SIZE, "1"))
+    # NB: must not call jax.process_count() here — it would initialize the XLA
+    # backend, after which jax.distributed.initialize refuses to run
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    already = (is_init() if is_init is not None
+               else jax._src.distributed.global_state.client is not None)
+    if master and nproc > 1 and not already:
+        rank = int(os.environ.get(ENV_RANK, "0"))
         jax.distributed.initialize(coordinator_address=master,
-                                   num_processes=nnodes, process_id=node_rank)
+                                   num_processes=nproc, process_id=rank)
 
 
 def init_parallel_env(mesh_shape: Optional[Sequence[int]] = None,

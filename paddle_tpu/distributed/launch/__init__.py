@@ -1,0 +1,14 @@
+"""Distributed launcher — the `fleetrun` analog.
+
+Reference: python/paddle/distributed/launch/main.py (CLI), controllers/collective.py
+(pod build + env contract), controllers/master.py:27,65 (HTTP KV rendezvous),
+controllers/watcher.py (process supervision), phi/core/distributed/store/tcp_store.cc
+(bootstrap KV).
+
+TPU-native shape: the unit of launch is one process per HOST (jax owns every local
+chip), not one per device — `--nproc_per_node` exists for CPU-simulation tests and
+multi-slice hosts. Rank bootstrap = HTTP KV barrier; collective bootstrap =
+`jax.distributed.initialize` against the coordinator (the TCPStore analog lives
+inside jax's coordination service; we only have to agree on the address).
+"""
+from .main import launch, main  # noqa: F401

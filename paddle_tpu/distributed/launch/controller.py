@@ -1,0 +1,192 @@
+"""Pod controller: spawn rank processes with the PADDLE_* env contract + watch them.
+
+Reference analog: launch/controllers/collective.py (CollectiveController.build_pod
+sets PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS/... per process), launch/job/pod.py
+(process container) and controllers/watcher.py (liveness). Restart policy mirrors
+the reference's `--max_restart` elastic knob at level 0/1.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .master import Master
+
+ENV_PREFIX = "PADDLE_"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def this_host() -> str:
+    return socket.gethostbyname(socket.gethostname())
+
+
+@dataclass
+class LaunchContext:
+    script: List[str]                      # script + its args (or -m module ...)
+    nnodes: int = 1
+    nproc_per_node: int = 1
+    master: Optional[str] = None           # host:port of the KV/rendezvous server
+    node_rank: Optional[int] = None
+    job_id: str = "default"
+    log_dir: Optional[str] = None
+    devices: Optional[str] = None
+    max_restart: int = 0
+    envs: Dict[str, str] = field(default_factory=dict)
+
+
+class PodController:
+    """Builds and supervises the local pod (the node's rank processes)."""
+
+    def __init__(self, ctx: LaunchContext):
+        self.ctx = ctx
+        self.procs: List[subprocess.Popen] = []
+        self.logs: List[Optional[object]] = []
+        self._master: Optional[Master] = None
+
+    # ------------------------------------------------------------- rendezvous
+
+    def _rendezvous(self):
+        """Returns (node_rank, coordinator host:port).
+
+        Port layout: the --master port P serves the KV store; the jax
+        coordinator (inside global rank 0's worker) binds P+1 on the same host
+        — a job therefore reserves the (P, P+1) pair. maybe_serve + the P+1
+        probe below surface a busy pair early instead of a 300s rendezvous
+        timeout against some other job's sockets."""
+        ctx = self.ctx
+        if ctx.nnodes <= 1:
+            return 0, f"127.0.0.1:{free_port()}"
+        assert ctx.master, "--master is required when nnodes > 1"
+        self._master = Master(ctx.master, ctx.job_id, ctx.nnodes)
+        # with explicit ranks only node 0 serves (a non-zero node binding the
+        # master port would strand the fleet); with auto ranks, first bind wins
+        serving = False
+        if ctx.node_rank is None or ctx.node_rank == 0:
+            serving = self._master.maybe_serve()
+        if ctx.node_rank == 0 and not serving:
+            raise RuntimeError(
+                f"--node_rank 0 could not bind master {ctx.master}: port busy "
+                f"(another job? pick a master port whose P and P+1 are free)")
+        host, port = ctx.master.rsplit(":", 1)
+        if serving:
+            coord_probe = socket.socket()
+            try:
+                coord_probe.bind(("", int(port) + 1))
+            except OSError:
+                raise RuntimeError(
+                    f"jax coordinator port {int(port) + 1} (master port + 1) "
+                    f"is busy; pick a master port with a free successor")
+            finally:
+                coord_probe.close()
+        my_ep = f"{this_host()}:{free_port()}"
+        rank, peers = self._master.sync_peers(my_ep, ctx.node_rank)
+        return rank, f"{host}:{int(port) + 1}"
+
+    # ------------------------------------------------------------------ build
+
+    def _build_env(self, node_rank: int, local_rank: int,
+                   coordinator: str) -> Dict[str, str]:
+        ctx = self.ctx
+        nproc = ctx.nproc_per_node
+        world = ctx.nnodes * nproc
+        rank = node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update(ctx.envs)
+        env.update({
+            "PADDLE_MASTER": coordinator,
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(ctx.nnodes),
+            "PADDLE_NODE_RANK": str(node_rank),
+            "PADDLE_JOB_ID": ctx.job_id,
+        })
+        if ctx.devices is not None:
+            devices = ctx.devices.split(",")
+            if ctx.nproc_per_node > 1:
+                # split the visible set across local processes round-robin
+                devices = devices[local_rank::ctx.nproc_per_node]
+            env["PADDLE_DEVICES"] = ",".join(devices)
+            # the actual visibility knob libtpu/jax honor; without it two local
+            # processes would race for the same chips (exclusive lock)
+            env["TPU_VISIBLE_DEVICES"] = env["PADDLE_DEVICES"]
+        return env
+
+    def _spawn(self, node_rank: int, coordinator: str):
+        ctx = self.ctx
+        self.procs, self.logs = [], []
+        for local_rank in range(ctx.nproc_per_node):
+            env = self._build_env(node_rank, local_rank, coordinator)
+            cmd = [sys.executable] + ctx.script
+            log = None
+            if ctx.log_dir:
+                os.makedirs(ctx.log_dir, exist_ok=True)
+                rank = env["PADDLE_TRAINER_ID"]
+                log = open(os.path.join(ctx.log_dir, f"workerlog.{rank}"), "ab")
+            self.procs.append(subprocess.Popen(
+                cmd, env=env, stdout=log or None, stderr=log or None))
+            self.logs.append(log)
+
+    # ------------------------------------------------------------------ watch
+
+    def _poll(self) -> Optional[int]:
+        """None while all alive; else first non-None returncode (0 only if ALL 0)."""
+        codes = [p.poll() for p in self.procs]
+        if all(c == 0 for c in codes):
+            return 0
+        bad = [c for c in codes if c not in (None, 0)]
+        if bad:
+            return bad[0]
+        return None
+
+    def _terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self.logs:
+            if f:
+                f.close()
+
+    def run(self) -> int:
+        if self.ctx.max_restart > 0 and self.ctx.nnodes > 1:
+            # a local-pod restart would re-register a dead incarnation with the
+            # still-live jax coordinator and hang the fleet; whole-job restart
+            # needs master-coordinated teardown (reference elastic manager)
+            raise ValueError("--max_restart is only supported for single-node "
+                             "jobs (nnodes == 1)")
+        node_rank, coordinator = self._rendezvous()
+        restarts = 0
+        try:
+            while True:
+                self._spawn(node_rank, coordinator)
+                rc = None
+                while rc is None:
+                    time.sleep(0.5)
+                    rc = self._poll()
+                self._terminate()
+                if rc == 0 or restarts >= self.ctx.max_restart:
+                    return rc
+                restarts += 1
+                print(f"[launch] pod failed (rc={rc}); restart "
+                      f"{restarts}/{self.ctx.max_restart}", file=sys.stderr)
+        finally:
+            self._terminate()
+            if self._master is not None:
+                self._master.stop()

@@ -1,0 +1,57 @@
+"""CLI entry: `python -m paddle_tpu.distributed.launch [options] script.py args...`
+
+Reference analog: launch/main.py (fleetrun). Argument surface mirrors the subset of
+launch/context/args_envs.py:53-179 that is meaningful on TPU fleets; PS/IPU-specific
+groups are intentionally absent (the TPU build has no parameter-server runtime here).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .controller import LaunchContext, PodController
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job (fleetrun analog)")
+    p.add_argument("--master", default=None,
+                   help="host:port of the rendezvous master (node 0 serves it)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (TPU idiom: 1/host; >1 for CPU sim)")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="explicit node rank (else registration order)")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--devices", default=None,
+                   help="visible device selector, exported as PADDLE_DEVICES")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="restart the pod up to N times on failure (elastic L1)")
+    p.add_argument("script", nargs=argparse.REMAINDER,
+                   help="training script (or -m module) and its args")
+    return p
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    script = list(args.script)
+    if script and script[0] == "--":
+        script = script[1:]
+    if not script:
+        print("error: no training script given", file=sys.stderr)
+        return 2
+    ctx = LaunchContext(script=script, nnodes=args.nnodes,
+                        nproc_per_node=args.nproc_per_node, master=args.master,
+                        node_rank=args.node_rank, job_id=args.job_id,
+                        log_dir=args.log_dir, devices=args.devices,
+                        max_restart=args.max_restart)
+    return PodController(ctx).run()
+
+
+def main() -> int:
+    return launch(sys.argv[1:])

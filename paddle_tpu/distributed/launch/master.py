@@ -1,0 +1,170 @@
+"""HTTP KV master: rendezvous + barrier for multi-node launch.
+
+Reference analog: launch/controllers/master.py (HTTPMaster over a KVServer) and the
+TCPStore wait/set semantics (phi/core/distributed/store/tcp_store.cc). One node runs
+the server; every node PUTs its endpoint under a job-scoped prefix and polls GET
+until all peers registered — the result is a deterministic, rank-ordered peer list.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+class KVServer:
+    """Tiny in-memory KV over HTTP: PUT /k, GET /k, GET /prefix/ lists."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        store: Dict[str, bytes] = {}
+        lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with lock:
+                    store[self.path] = body
+                self.send_response(200)
+                self.end_headers()
+
+            def do_DELETE(self):
+                with lock:
+                    store.pop(self.path, None)
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                if self.path.endswith("/"):
+                    with lock:
+                        items = {k: v.decode() for k, v in store.items()
+                                 if k.startswith(self.path)}
+                    body = json.dumps(items).encode()
+                    self.send_response(200)
+                else:
+                    with lock:
+                        body = store.get(self.path)
+                    if body is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        self._base = f"http://{endpoint}"
+
+    def put(self, key: str, value: str) -> bool:
+        req = urllib.request.Request(f"{self._base}{key}", data=value.encode(),
+                                     method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(f"{self._base}{key}", timeout=5) as r:
+                return r.read().decode()
+        except OSError:
+            return None
+
+    def get_prefix(self, prefix: str) -> Dict[str, str]:
+        try:
+            with urllib.request.urlopen(f"{self._base}{prefix}", timeout=5) as r:
+                return json.loads(r.read().decode())
+        except OSError:
+            return {}
+
+
+class Master:
+    """Rendezvous: every node registers, waits for nnodes peers, gets rank order.
+
+    Node 0 (the one whose --master address is local and free) hosts the KVServer
+    in-process — reference HTTPMaster.launch() does exactly this.
+    """
+
+    def __init__(self, endpoint: str, job_id: str, nnodes: int):
+        self.endpoint = endpoint
+        self.job_id = job_id
+        self.nnodes = nnodes
+        self._server: Optional[KVServer] = None
+        self._client = KVClient(endpoint)
+
+    def maybe_serve(self) -> bool:
+        host, port = self.endpoint.rsplit(":", 1)
+        try:
+            srv = KVServer(int(port))
+        except OSError:
+            return False  # someone else (node 0) already bound it
+        self._server = srv
+        srv.start()
+        return True
+
+    def sync_peers(self, my_endpoint: str, node_rank: Optional[int],
+                   timeout: float = 300.0) -> Tuple[int, List[str]]:
+        """Register and barrier until nnodes endpoints present.
+
+        Returns (node_rank, ordered endpoint list). Explicit ranks win; otherwise
+        registration order (ties broken by endpoint sort) assigns ranks.
+        """
+        prefix = f"/{self.job_id}/nodes/"
+        key = f"{prefix}{node_rank if node_rank is not None else my_endpoint}"
+        deadline = time.time() + timeout
+        existing = self._client.get(key)
+        if existing is not None and existing != my_endpoint:
+            raise RuntimeError(
+                f"node_rank {node_rank} already registered by {existing}: "
+                f"duplicate --node_rank in job '{self.job_id}'")
+        while not self._client.put(key, my_endpoint):
+            if time.time() > deadline:
+                raise TimeoutError(f"master {self.endpoint} unreachable")
+            time.sleep(0.5)
+        while True:
+            peers = self._client.get_prefix(prefix)
+            if len(peers) >= self.nnodes:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous timeout: {len(peers)}/{self.nnodes} nodes")
+            time.sleep(0.5)
+
+        explicit = {k for k in peers if k[len(prefix):].isdigit()}
+        if explicit and len(explicit) < len(peers):
+            # mixing explicit and auto ranks would let two nodes claim one rank
+            raise RuntimeError(
+                "either every node or no node may pass --node_rank "
+                f"(job '{self.job_id}': {len(explicit)}/{len(peers)} explicit)")
+
+        def order_key(k: str):
+            tail = k[len(prefix):]
+            return (0, int(tail), "") if tail.isdigit() else (1, 0, tail)
+
+        ordered = [peers[k] for k in sorted(peers, key=order_key)]
+        if node_rank is not None:
+            return int(node_rank), ordered
+        return ordered.index(my_endpoint), ordered
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()

@@ -56,16 +56,23 @@ class TrainStep:
         if placer is not None:
             placer()
         # commit every array to its current placement: uncommitted inputs vs
-        # committed first-step outputs would otherwise trigger a second compile
+        # committed first-step outputs would otherwise trigger a second compile.
+        # Multi-host arrays are already committed (and bare device_put on a
+        # non-addressable array is an error) — leave them be.
+        def commit(a):
+            if getattr(a, "is_fully_addressable", True):
+                return jax.device_put(a)
+            return a
+
         for p in self._params:
-            p._data = jax.device_put(p._data)
+            p._data = commit(p._data)
         for b in self._buffers:
-            b._data = jax.device_put(b._data)
+            b._data = commit(b._data)
         for st in self._opt._accumulators.values():
             for k in st:
-                st[k] = jax.device_put(st[k])
+                st[k] = commit(st[k])
         for k in list(self._opt._master_weights):
-            self._opt._master_weights[k] = jax.device_put(
+            self._opt._master_weights[k] = commit(
                 self._opt._master_weights[k])
 
     # ------------------------------------------------------------------ build

@@ -52,6 +52,81 @@ def _lm_head_ce_fwd(hidden, weight, labels, transpose_w=True, ignore_index=-100)
 register_op("lm_head_ce", _lm_head_ce_fwd, nondiff_inputs=(2,))
 
 
+def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
+                         f2b, *rest, num_heads, hidden_dropout=0.0,
+                         attn_dropout=0.0, eps=1e-5, use_flash=False,
+                         remat="none"):
+    """All L transformer blocks as ONE `lax.scan` over stacked parameters.
+
+    TPU-native replacement for the reference's fused_multi_transformer op
+    (/root/reference/paddle/fluid/operators/fused/fused_multi_transformer_op.cu):
+    there the answer to per-layer overhead is a hand-fused CUDA megakernel; here
+    the L blocks become a single scan body that XLA compiles once (layers-fold
+    keeps compile time O(1) in depth) with an optional rematerialization policy
+    on the body. Stacked params carry a leading [L] dim.
+    """
+    b, s, h = x.shape
+    hd = h // num_heads
+    n_layers = l1w.shape[0]
+    keys = rest[0] if rest else jnp.zeros((n_layers, 2), jnp.uint32)
+
+    def ln(z, w, bias):
+        zf = z.astype(jnp.float32)
+        mu = jnp.mean(zf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(zf - mu), -1, keepdims=True)
+        return (((zf - mu) * jax.lax.rsqrt(var + eps)).astype(z.dtype) * w
+                + bias)
+
+    def drop(z, kd, salt):
+        if hidden_dropout <= 0.0:
+            return z
+        k = jax.random.fold_in(jax.random.wrap_key_data(kd), salt)
+        keep = jax.random.bernoulli(k, 1.0 - hidden_dropout, z.shape)
+        return z * keep.astype(z.dtype) / (1.0 - hidden_dropout)
+
+    def body(carry, per):
+        (l1w_, l1b_, qw_, qb_, pw_, pb_, l2w_, l2b_, f1w_, f1b_, f2w_, f2b_,
+         kd) = per
+        y = ln(carry, l1w_, l1b_)
+        qkv = y @ qw_ + qb_                      # [B,S,3H]
+        q, k, v = (t.reshape(b, s, num_heads, hd)
+                   for t in jnp.split(qkv, 3, axis=-1))
+        if use_flash:
+            from ..kernels.pallas.flash_attention import flash_attention_blhd
+            att = flash_attention_blhd(q, k, v, causal=True,
+                                       dropout_rate=attn_dropout,
+                                       seed=kd[0].astype(jnp.int32))
+        else:
+            qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            logits = (jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+                      * (1.0 / math.sqrt(hd))).astype(jnp.float32)
+            cm = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(cm, logits, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(logits, axis=-1).astype(qt.dtype)
+            if attn_dropout > 0.0:
+                k0 = jax.random.fold_in(jax.random.wrap_key_data(kd), 0)
+                keep = jax.random.bernoulli(k0, 1.0 - attn_dropout, probs.shape)
+                probs = probs * keep.astype(probs.dtype) / (1.0 - attn_dropout)
+            att = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vt), 1, 2)
+        att = att.reshape(b, s, h) @ pw_ + pb_
+        carry = carry + drop(att, kd, 1)
+        y = ln(carry, l2w_, l2b_)
+        y = jax.nn.gelu(y @ f1w_ + f1b_, approximate=True) @ f2w_ + f2b_
+        return carry + drop(y, kd, 2), None
+
+    if remat == "full":
+        body = jax.checkpoint(body)
+    elif remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    out, _ = jax.lax.scan(body, x, (l1w, l1b, qw, qb, pw, pb, l2w, l2b,
+                                    f1w, f1b, f2w, f2b, keys))
+    return out
+
+
+register_op("gpt_scan_blocks", _gpt_scan_blocks_fwd, nondiff_inputs=(13,))
+
+
 @dataclass
 class GPTConfig:
     vocab_size: int = 50304            # 50257 padded to a multiple of 128 for the MXU
@@ -66,6 +141,8 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     tie_word_embeddings: bool = True
     use_flash_attention: bool = True
+    scan_layers: bool = False          # fold blocks into one lax.scan (fast compile)
+    remat: str = "none"                # "none" | "dots" | "full" checkpoint policy
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -144,6 +221,67 @@ class GPTBlock(nn.Layer):
         return x
 
 
+class GPTScannedBlocks(nn.Layer):
+    """The full block stack as stacked [L, ...] parameters + one scan op.
+
+    Self-initializing (GPT-3 recipe baked in at creation); GPTModel._init_weights
+    skips these params so the stacked LN weights keep their ones/zeros init.
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        L, H, I = config.num_layers, config.hidden_size, config.intermediate_size
+        self.num_heads = config.num_heads
+        self.head_dim = H // config.num_heads
+        self.hidden_dropout = config.hidden_dropout_prob
+        self.attn_dropout = config.attention_dropout_prob
+        self.eps = config.layer_norm_epsilon
+        self.use_flash = config.use_flash_attention
+        self.remat = config.remat
+        std = config.initializer_range
+        normal = nn.initializer.Normal(mean=0.0, std=std)
+        resid = nn.initializer.Normal(mean=0.0, std=std / math.sqrt(2.0 * L))
+        ones = nn.initializer.Constant(1.0)
+        mk = self.create_parameter
+        self.ln1_weight = mk([L, H], default_initializer=ones)
+        self.ln1_bias = mk([L, H], is_bias=True)
+        self.qkv_weight = mk([L, H, 3 * H], default_initializer=normal)
+        self.qkv_bias = mk([L, 3 * H], is_bias=True)
+        self.proj_weight = mk([L, H, H], default_initializer=resid)
+        self.proj_bias = mk([L, H], is_bias=True)
+        self.ln2_weight = mk([L, H], default_initializer=ones)
+        self.ln2_bias = mk([L, H], is_bias=True)
+        self.fc1_weight = mk([L, H, I], default_initializer=normal)
+        self.fc1_bias = mk([L, I], is_bias=True)
+        self.fc2_weight = mk([L, I, H], default_initializer=resid)
+        self.fc2_bias = mk([L, H], is_bias=True)
+
+    def forward(self, x, attn_mask=None):
+        if attn_mask is not None:
+            raise ValueError("scan_layers path supports causal masking only "
+                             "(attn_mask must be None)")
+        b, s, _ = x.shape
+        training = self.training
+        drop = self.hidden_dropout if training else 0.0
+        adrop = self.attn_dropout if training else 0.0
+        from ..nn.functional.attention import flash_path_available
+        use_flash = (self.use_flash
+                     and flash_path_available(s, self.head_dim, x))
+        args = [x, self.ln1_weight, self.ln1_bias, self.qkv_weight,
+                self.qkv_bias, self.proj_weight, self.proj_bias,
+                self.ln2_weight, self.ln2_bias, self.fc1_weight, self.fc1_bias,
+                self.fc2_weight, self.fc2_bias]
+        if drop > 0.0 or adrop > 0.0:
+            from ..core import random as rng
+            base = rng.split_key()
+            L = int(self.ln1_weight.shape[0])
+            from ..core.tensor import Tensor as _T
+            args.append(_T(jax.random.key_data(jax.random.split(base, L))))
+        return _op("gpt_scan_blocks", *args, num_heads=self.num_heads,
+                   hidden_dropout=drop, attn_dropout=adrop, eps=self.eps,
+                   use_flash=use_flash, remat=self.remat)
+
+
 class GPTModel(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -151,7 +289,11 @@ class GPTModel(nn.Layer):
         self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
         self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
         self.drop = nn.Dropout(config.hidden_dropout_prob)
-        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        if config.scan_layers:
+            self.h = GPTScannedBlocks(config)
+        else:
+            self.h = nn.LayerList([GPTBlock(config)
+                                   for _ in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
         self._init_weights(config)
 
@@ -161,6 +303,8 @@ class GPTModel(nn.Layer):
         resid_scale = nn.initializer.Normal(
             mean=0.0, std=std / math.sqrt(2.0 * config.num_layers))
         for name, p in self.named_parameters():
+            if config.scan_layers and name.startswith("h."):
+                continue  # GPTScannedBlocks self-initializes its stacked params
             if p.ndim >= 2:
                 # GPT-2/3 init: residual-out projections scaled by 1/sqrt(2L)
                 init = (resid_scale if name.endswith(("out_proj.weight",
@@ -172,8 +316,11 @@ class GPTModel(nn.Layer):
         pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for block in self.h:
-            x = block(x, attn_mask)
+        if isinstance(self.h, GPTScannedBlocks):
+            x = self.h(x, attn_mask)
+        else:
+            for block in self.h:
+                x = block(x, attn_mask)
         return self.ln_f(x)
 
 
@@ -194,17 +341,15 @@ class GPTForCausalLM(nn.Layer):
         hidden = self.gpt(input_ids, attn_mask)
         if labels is not None:
             # loss from the SHIFTED hidden states: the slice happens on [B,S,H]
-            # (not [B,S,V]) and the head matmul + CE fuse into one executable;
-            # the full-logits below are dead code under jit when only the loss
-            # is consumed (XLA DCE removes the second head matmul)
+            # (not [B,S,V]) and the head matmul + CE fuse into one executable
             tied = self.lm_head is None
             w = self.gpt.wte.weight if tied else self.lm_head.weight
             loss = _op("lm_head_ce", hidden[:, :-1, :], w, labels[:, 1:],
                        transpose_w=tied)
+            # the logits are NOT materialized on the loss path — in eager that
+            # second [B,S,V] projection would really execute each step. Output
+            # structure is mode-independent: labels => (None, loss), always.
+            return None, loss
         if self.lm_head is None:
-            logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
-        else:
-            logits = self.lm_head(hidden)
-        if labels is None:
-            return logits
-        return logits, loss
+            return ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(hidden)

@@ -112,15 +112,24 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out
 
 
+def flash_path_available(seq_len, head_dim, sample=None) -> bool:
+    """The single gate for the Pallas flash kernel: tile minimums + TPU placement.
+
+    Shared by every caller (functional API, scanned GPT stack) so shape
+    constraints stay in one place. `sample` (Tensor or array) decides by actual
+    placement when concrete; tracers fall back to the default backend, which is
+    where the compiled program will run."""
+    if seq_len < 128 or head_dim < 64:
+        return False
+    if sample is not None:
+        arr = sample.value() if hasattr(sample, "value") else sample
+        try:
+            return any(d.platform == "tpu" for d in arr.devices())
+        except Exception:
+            pass
+    return jax.default_backend() == "tpu"
+
+
 def _pallas_usable(q):
     shape = q.shape
-    if not (len(shape) == 4 and shape[1] >= 128 and shape[3] >= 64):
-        return False
-    arr = q.value() if hasattr(q, "value") else q
-    try:
-        devs = arr.devices()  # concrete array: decide by actual placement
-        return any(d.platform == "tpu" for d in devs)
-    except Exception:
-        # tracer (to_static / jit): no placement yet — decide by default backend,
-        # which is where the compiled program will run
-        return jax.default_backend() == "tpu"
+    return len(shape) == 4 and flash_path_available(shape[1], shape[3], q)

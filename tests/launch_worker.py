@@ -1,0 +1,68 @@
+"""Rank worker used by test_launch.py — the TestDistBase trainer analog
+(reference test/legacy_test/test_dist_base.py:933 runs a small model per rank and
+compares losses). Each process simulates one 4-chip host (virtual CPU devices);
+the launcher's PADDLE_* env contract + jax.distributed bootstrap federate them
+into one 8-device fleet.
+
+`train_and_losses()` is shared with the in-process reference run in
+test_launch.py so the two can never drift apart. jax platform configuration only
+happens under __main__ (imports of this module must not reconfigure jax).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def train_and_losses(steps: int = 3):
+    """Deterministic 3-step DP training; returns the per-step losses."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    paddle.seed(0)
+
+    class WithLoss(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.net = paddle.nn.Sequential(
+                paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                paddle.nn.Linear(32, 4))
+
+        def forward(self, x, y):
+            out = self.net(x)
+            return paddle.nn.functional.mse_loss(out, y)
+
+    model = dist.DataParallel(WithLoss())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt)
+    xs = np.random.RandomState(1).randn(8, 16).astype("float32")
+    ys = np.random.RandomState(2).randn(8, 4).astype("float32")
+    return [float(step(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+            for _ in range(steps)]
+
+
+def main(outdir):
+    import jax
+
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    assert jax.device_count() == 8, \
+        f"expected 8 global devices, got {jax.device_count()}"
+    losses = train_and_losses()
+    rank = jax.process_index()
+    with open(os.path.join(outdir, f"loss_{rank}.json"), "w") as f:
+        json.dump({"rank": rank,
+                   "world": int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                   "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main(sys.argv[1])
