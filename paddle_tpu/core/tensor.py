@@ -27,7 +27,7 @@ from .device import Place, get_default_place
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
                  "name", "persistable", "trainable", "_version", "_retain_grad_flag",
-                 "__weakref__")
+                 "_grad_sharding", "__weakref__")
 
     def __init__(self, data, dtype=None, place: Optional[Place] = None,
                  stop_gradient: bool = True, name: Optional[str] = None):
@@ -165,6 +165,13 @@ class Tensor:
 
     def _accumulate_grad(self, g):
         # GradNodeAccumulation analog (reference: eager/accumulation/)
+        sh = getattr(self, "_grad_sharding", None)
+        if sh is not None:
+            # ZeRO stage-2 semantics: the gradient is sharded AT accumulation
+            # (reduce-scatter), never held replicated on the tape — reference
+            # GroupShardedStage2's slice-reduce hooks
+            import jax
+            g = jax.device_put(g, sh)
         if self._grad is None:
             self._grad = g
         else:

@@ -15,6 +15,8 @@ of the updated parameter back to its replicated placement.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -41,11 +43,67 @@ class HybridParallelOptimizer:
         return self._inner_opt
 
 
-def _shard_spec_for(shape, axis_size):
-    """First dim divisible by the sharding degree → shard it, else replicate."""
-    if len(shape) >= 1 and shape[0] % axis_size == 0 and shape[0] >= axis_size:
-        return P("sharding", *([None] * (len(shape) - 1)))
-    return P(*([None] * len(shape)))
+def _shard_spec_for(shape, axis_size, existing=None):
+    """Shard the largest dim divisible by the sharding degree.
+
+    `existing` (a PartitionSpec from the param's current placement, e.g. TP's
+    P(None, "model")) is preserved: the "sharding" axis lands on the largest
+    divisible dim that is still free, so ZeRO composes with tensor parallelism
+    instead of clobbering it (reference GroupShardedStage3 + mp hybrid)."""
+    spec = [None] * len(shape)
+    if existing is not None:
+        for i, s in enumerate(tuple(existing)[:len(shape)]):
+            spec[i] = s
+        used = {a for s in spec if s is not None
+                for a in (s if isinstance(s, tuple) else (s,))}
+        if "sharding" in used:
+            return P(*spec)  # already sharded (idempotent re-application)
+    best, best_size = -1, 0
+    for i, d in enumerate(shape):
+        if spec[i] is not None:
+            continue
+        if d % axis_size == 0 and d >= axis_size and d > best_size:
+            best, best_size = i, d
+    if best >= 0:
+        spec[best] = "sharding"
+    return P(*spec)
+
+
+def _existing_spec(arr):
+    """PartitionSpec of an array's current NamedSharding placement, if any."""
+    sh = getattr(arr, "sharding", None)
+    return getattr(sh, "spec", None)
+
+
+_HOST_MEMORY_OK: Optional[bool] = None
+
+
+def _host_memory_supported() -> bool:
+    """Probe once whether this backend supports pinned_host placements."""
+    global _HOST_MEMORY_OK
+    if _HOST_MEMORY_OK is None:
+        import jax.numpy as jnp
+        try:
+            dev = jax.devices()[0]
+            sharding = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host")
+            jax.device_put(jnp.zeros((1,)), sharding)
+            _HOST_MEMORY_OK = True
+        except Exception:
+            _HOST_MEMORY_OK = False
+    return _HOST_MEMORY_OK
+
+
+def _maybe_host(sharding, offload):
+    """Move a sharding to host memory for ZeRO offload where supported."""
+    if not offload:
+        return sharding
+    if not _host_memory_supported():
+        import warnings
+        warnings.warn("offload=True but this backend has no host memory kinds;"
+                      " optimizer states stay on device", stacklevel=3)
+        return sharding
+    return sharding.with_memory_kind("pinned_host")
 
 
 class DygraphShardingOptimizer(HybridParallelOptimizer):
@@ -57,9 +115,10 @@ class DygraphShardingOptimizer(HybridParallelOptimizer):
     reduce-scatter/all-gather traffic ZeRO describes.
     """
 
-    def __init__(self, optimizer, hcg=None, strategy=None):
+    def __init__(self, optimizer, hcg=None, strategy=None, offload=False):
         super().__init__(optimizer, hcg, strategy)
         self._sharding_placed = set()
+        self._offload = offload
 
     def _place_states(self):
         mesh = get_mesh()
@@ -70,19 +129,48 @@ class DygraphShardingOptimizer(HybridParallelOptimizer):
             pid = id(p)
             if pid in self._sharding_placed or pid not in opt._accumulators:
                 continue
+            existing = _existing_spec(p.value())
             states = opt._accumulators[pid]
             for name, arr in states.items():
-                spec = _shard_spec_for(arr.shape, mesh.shape["sharding"])
-                states[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+                spec = _shard_spec_for(arr.shape, mesh.shape["sharding"],
+                                       existing if arr.ndim == p.ndim else None)
+                sh = _maybe_host(NamedSharding(mesh, spec), self._offload)
+                states[name] = jax.device_put(arr, sh)
             if pid in opt._master_weights:
                 mw = opt._master_weights[pid]
-                spec = _shard_spec_for(mw.shape, mesh.shape["sharding"])
-                opt._master_weights[pid] = jax.device_put(
-                    mw, NamedSharding(mesh, spec))
+                spec = _shard_spec_for(mw.shape, mesh.shape["sharding"],
+                                       existing)
+                sh = _maybe_host(NamedSharding(mesh, spec), self._offload)
+                opt._master_weights[pid] = jax.device_put(mw, sh)
             self._sharding_placed.add(pid)
+
+    def _move_states(self, to_host: bool):
+        """Offload paging: states live on host between steps, on device during
+        the update (reference GroupShardedStage3 cpu_offload semantics)."""
+        opt = self._inner_opt
+        if not _host_memory_supported():
+            return  # _maybe_host already warned; nothing is paged
+
+        def move(arr):
+            sh = getattr(arr, "sharding", None)
+            if sh is None:
+                return arr
+            kind = "pinned_host" if to_host else "device"
+            return jax.device_put(arr, sh.with_memory_kind(kind))
+        for pid, states in opt._accumulators.items():
+            for name in states:
+                states[name] = move(states[name])
+        for pid in list(opt._master_weights):
+            opt._master_weights[pid] = move(opt._master_weights[pid])
 
     def step(self):
         # states are created lazily on first step; place them before the fused update
         self._inner_opt._ensure_all_states()
         self._place_states()
-        return self._inner_opt.step()
+        if not self._offload:
+            return self._inner_opt.step()
+        self._move_states(to_host=False)
+        try:
+            return self._inner_opt.step()
+        finally:
+            self._move_states(to_host=True)

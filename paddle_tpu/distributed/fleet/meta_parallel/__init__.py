@@ -9,4 +9,5 @@ from .mp_layers import (  # noqa: F401
 from .wrappers import TensorParallel, ShardingParallel  # noqa: F401
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .compiled_pipeline import CompiledPipeline, pipeline_apply  # noqa: F401
 from ...random import get_rng_state_tracker  # noqa: F401

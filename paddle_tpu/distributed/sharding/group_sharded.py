@@ -23,7 +23,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 from ..env import get_mesh
-from ..fleet.meta_optimizers import DygraphShardingOptimizer, _shard_spec_for
+from ..fleet.meta_optimizers import (DygraphShardingOptimizer, _existing_spec,
+                                     _shard_spec_for)
 
 
 class _GroupShardedModel(Layer):
@@ -33,13 +34,28 @@ class _GroupShardedModel(Layer):
         self._level = level
         mesh = get_mesh()
         self._axis_size = mesh.shape.get("sharding", 1) if mesh is not None else 1
-        if level == "p_g_os" and self._axis_size > 1:
-            self._shard_params(mesh)
+        if self._axis_size > 1:
+            if level == "p_g_os":
+                self._shard_params(mesh)
+            if level in ("os_g", "p_g_os"):
+                self._mark_grad_shardings(mesh)
 
     def _shard_params(self, mesh):
+        # compose with any existing placement (e.g. TP's "model" axis): the
+        # sharding axis takes the largest still-free divisible dim
         for _, p in self._layers.named_parameters():
-            spec = _shard_spec_for(tuple(p.shape), mesh.shape["sharding"])
+            spec = _shard_spec_for(tuple(p.shape), mesh.shape["sharding"],
+                                   _existing_spec(p.value()))
             p._data = jax.device_put(p.value(), NamedSharding(mesh, spec))
+
+    def _mark_grad_shardings(self, mesh):
+        # stage >= 2: gradients are sharded AT tape accumulation (see
+        # Tensor._accumulate_grad) — they never sit replicated between
+        # backward and step, which is the entire point of os_g
+        for _, p in self._layers.named_parameters():
+            spec = _shard_spec_for(tuple(p.shape), mesh.shape["sharding"],
+                                   _existing_spec(p.value()))
+            p._grad_sharding = NamedSharding(mesh, spec)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -58,17 +74,29 @@ class _GroupShardedModel(Layer):
 
 
 class _ShardingStage2Optimizer(DygraphShardingOptimizer):
-    """Stage 2: also reshard gradients onto the sharding axis before the update
-    (the reference's slice-reduce: each rank keeps only its grad shard)."""
+    """Stage 2/3 optimizer: states sharded (stage 1) + a grad-sharding contract.
+
+    Eager grads are already sharded at accumulation (_mark_grad_shardings);
+    `_grad_spec` additionally lets TrainStep compile the same semantics in as
+    `with_sharding_constraint` on the grads — XLA then emits reduce-scatter at
+    grad production instead of all-reduce + late reshard."""
+
+    def _grad_spec(self, p):
+        mesh = get_mesh()
+        if mesh is None or mesh.shape.get("sharding", 1) <= 1:
+            return None
+        spec = _shard_spec_for(tuple(p.shape), mesh.shape["sharding"],
+                               _existing_spec(p.value()))
+        return NamedSharding(mesh, spec)
 
     def step(self):
         mesh = get_mesh()
         if mesh is not None and mesh.shape.get("sharding", 1) > 1:
+            # safety net for grads produced outside the marked tape path
             for p in self._inner_opt._parameter_list:
-                if p._grad is not None:
-                    spec = _shard_spec_for(p._grad.shape, mesh.shape["sharding"])
-                    p._grad = jax.device_put(p._grad,
-                                             NamedSharding(mesh, spec))
+                if p._grad is not None and \
+                        getattr(p, "_grad_sharding", None) is not None:
+                    p._grad = jax.device_put(p._grad, p._grad_sharding)
         return super().step()
 
 
@@ -81,9 +109,9 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "os",
         raise ValueError(f"level must be os | os_g | p_g_os, got {level!r}")
     wrapped_model = _GroupShardedModel(model, level, group, offload)
     if level == "os":
-        wrapped_opt = DygraphShardingOptimizer(optimizer)
+        wrapped_opt = DygraphShardingOptimizer(optimizer, offload=offload)
     else:
-        wrapped_opt = _ShardingStage2Optimizer(optimizer)
+        wrapped_opt = _ShardingStage2Optimizer(optimizer, offload=offload)
     return wrapped_model, wrapped_opt, scaler
 
 

@@ -40,6 +40,9 @@ class TrainStep:
         while hasattr(self._model, "_layers"):
             self._model = self._model._layers
         self._opt = optimizer
+        # ZeRO>=2 wrappers declare how grads must come out of backward; capture
+        # before unwrapping so the constraint compiles into the step
+        self._grad_spec_fn = getattr(optimizer, "_grad_spec", None)
         while hasattr(self._opt, "_inner_opt"):
             self._opt = self._opt._inner_opt
         self._loss_fn = loss_fn
@@ -97,6 +100,12 @@ class TrainStep:
         # grad clip (e.g. ClipGradByGlobalNorm) is pure jnp math — compile it in,
         # matching eager Optimizer.step (reference static path compiles clip ops)
         grad_clip = opt._grad_clip
+        # ZeRO stage-2: force each grad sharded at production (reduce-scatter
+        # fused into the backward) rather than replicated-then-resharded
+        grad_shardings = None
+        if self._grad_spec_fn is not None:
+            grad_shardings = [self._grad_spec_fn(p) for p in params
+                              if p.trainable]
 
         def run_model(param_arrays, buffer_arrays, input_arrays):
             ctx = dispatch.TraceContext()
@@ -144,6 +153,11 @@ class TrainStep:
             diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(diff_in)
+
+            if grad_shardings is not None:
+                grads = tuple(
+                    g if sh is None else jax.lax.with_sharding_constraint(g, sh)
+                    for g, sh in zip(grads, grad_shardings))
 
             if grad_clip is not None:
                 grads = [g for _, g in grad_clip(list(zip(diff_in, grads)))]

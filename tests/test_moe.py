@@ -1,0 +1,87 @@
+"""MoE / expert parallelism tests (reference: incubate moe_layer tests)."""
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed import fleet
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+
+def _init_mesh(**kw):
+    cfg = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+           "sharding_degree": 8, "sep_degree": 1}
+    cfg.update(kw)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = cfg
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+def test_moe_identical_experts_match_dense_ffn():
+    """capacity ∞ + identical experts ⇒ MoE output == plain FFN output."""
+    _init_mesh()
+    paddle.seed(0)
+    H, I, E = 16, 32, 4
+    moe = MoELayer(H, I, E, gate="naive")
+    # make every expert identical
+    w1 = moe.w1.numpy().copy(); w1[:] = w1[0]; moe.w1.set_value(w1)
+    b1 = moe.b1.numpy().copy(); b1[:] = b1[0]; moe.b1.set_value(b1)
+    w2 = moe.w2.numpy().copy(); w2[:] = w2[0]; moe.w2.set_value(w2)
+    b2 = moe.b2.numpy().copy(); b2[:] = b2[0]; moe.b2.set_value(b2)
+
+    x_np = np.random.RandomState(0).randn(2, 8, H).astype("float32")
+    y = moe(paddle.to_tensor(x_np))
+
+    import jax
+    import jax.numpy as jnp
+    want = np.asarray(
+        jax.nn.gelu(jnp.asarray(x_np) @ jnp.asarray(w1[0]) + b1[0],
+                    approximate=True) @ jnp.asarray(w2[0]) + b2[0])
+    np.testing.assert_allclose(y.numpy(), want, rtol=2e-5, atol=2e-5)
+    assert np.isfinite(float(moe.aux_loss))
+
+
+def test_moe_trains_and_balances():
+    """Switch-gated MoE trains end-to-end with the aux loss; grads flow to the
+    gate and every expert that received tokens."""
+    _init_mesh()
+    paddle.seed(1)
+    H, I, E = 16, 32, 4
+    moe = MoELayer(H, I, E, gate="switch")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=moe.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 8, H)
+                         .astype("float32"))
+    target = paddle.to_tensor(np.random.RandomState(2).randn(4, 8, H)
+                              .astype("float32"))
+    losses = []
+    for _ in range(5):
+        y = moe(x)
+        loss = ((y - target) ** 2).mean() + moe.aux_loss * 0.01
+        loss.backward()
+        assert moe.gate_weight.grad is not None
+        assert moe.w1.grad is not None
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_expert_parallel_all_to_all():
+    """Experts sharded over the mesh: weights live distributed and the compiled
+    step contains the dispatch collective (all-to-all / equivalent)."""
+    _init_mesh()
+    paddle.seed(2)
+    H, I, E = 16, 32, 8
+    moe = MoELayer(H, I, E, gate="gshard", expert_axis="sharding")
+    assert "sharding" in str(moe.w1.value().sharding.spec)
+
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 16, H)
+                         .astype("float32"))
+    y = moe(x)
+    assert np.isfinite(y.numpy()).all()
+
+    # numerics must not depend on expert placement
+    moe2 = MoELayer(H, I, E, gate="gshard", expert_axis="")
+    moe2.set_state_dict({k: v for k, v in moe.state_dict().items()})
+    y2 = moe2(x)
+    np.testing.assert_allclose(y.numpy(), y2.numpy(), rtol=2e-5, atol=2e-5)
