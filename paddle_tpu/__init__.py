@@ -47,6 +47,7 @@ save = _io.save
 load = _io.load
 
 from . import nn  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import io  # noqa: F401,E402
@@ -55,6 +56,9 @@ from . import jit  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time as _time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,15 @@ class OpDef:
 
 
 _REGISTRY: Dict[str, OpDef] = {}
+
+# profiler host-tracer hook: fn(op_name, t_start, t_end) or None (see
+# paddle_tpu.profiler; reference platform/profiler/host_tracer.cc)
+_PROFILER_HOOK: Optional[Callable[[str, float, float], None]] = None
+
+
+def set_profiler_hook(hook: Optional[Callable[[str, float, float], None]]):
+    global _PROFILER_HOOK
+    _PROFILER_HOOK = hook
 
 
 def register_op(name: str, fwd: Callable, bwd: Optional[Callable] = None,
@@ -241,12 +251,18 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
     key = _attr_key(attrs)
     record = is_grad_enabled() and any(requires)
 
+    hook = _PROFILER_HOOK
+    t0 = _time.perf_counter() if hook is not None else 0.0
     if in_trace():
         # Inside a to_static trace: call the raw function so everything inlines into the
         # enclosing jit; no per-op executables, no autograd tape (grad via whole-graph vjp).
         outs = op.fwd(*arrays, **attrs)
     else:
         outs = _fwd_exec(name, key)(*arrays)
+    if hook is not None:
+        # host-side dispatch cost (the reference host tracer's op event analog;
+        # device time lives in the jax profiler trace)
+        hook(name, t0, _time.perf_counter())
 
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)

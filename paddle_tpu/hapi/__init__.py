@@ -1,0 +1,3 @@
+"""hapi — high-level Model API (reference python/paddle/hapi)."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401

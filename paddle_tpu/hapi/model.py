@@ -1,0 +1,273 @@
+"""hapi paddle.Model — fit/evaluate/predict (reference python/paddle/hapi/model.py:1018).
+
+The reference Model wraps dygraph/static dual-mode execution, DataParallel
+auto-wrap and AMP plumbing around a user network. Here training always runs the
+eager tape (TrainStep compilation is an orthogonal optimization the user can
+apply directly); distribution comes from wrapping the network before Model(...)
+or from the ambient mesh placements.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_batch_tensors(data):
+    """DataLoader batch -> (inputs, labels) tensor lists."""
+    if isinstance(data, (list, tuple)):
+        items = list(data)
+    else:
+        items = [data]
+    return [t if isinstance(t, Tensor) else to_tensor(np.asarray(t))
+            for t in items]
+
+
+class Model:
+    """High-level train/eval/predict facade over a Layer."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._save_dir = None
+
+    # -------------------------------------------------------------- prepare
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        return self
+
+    # -------------------------------------------------------------- batches
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _as_batch_tensors(inputs)
+        labels = _as_batch_tensors(labels) if labels is not None else []
+        outs = self.network(*inputs)
+        loss = self._loss(outs, *labels) if self._loss else outs
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(loss)]
+        for m in self._metrics:
+            m.update(*[x.numpy() for x in
+                       self._metric_inputs(m, outs, labels)])
+            metrics.append(m.accumulate())
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _as_batch_tensors(inputs)
+        labels = _as_batch_tensors(labels) if labels is not None else []
+        outs = self.network(*inputs)
+        loss = self._loss(outs, *labels) if self._loss else outs
+        metrics = [float(loss)]
+        for m in self._metrics:
+            m.update(*[x.numpy() for x in
+                       self._metric_inputs(m, outs, labels)])
+            metrics.append(m.accumulate())
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _as_batch_tensors(inputs)
+        outs = self.network(*inputs)
+        return outs
+
+    def _metric_inputs(self, metric, outs, labels):
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        compute = getattr(metric, "compute", None)
+        if compute is not None and labels:
+            r = compute(out, *labels)
+            return list(r) if isinstance(r, (list, tuple)) else [r]
+        return [out] + labels
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = (self._to_loader(eval_data, batch_size, False, False,
+                                       num_workers)
+                       if eval_data is not None else None)
+        self._save_dir = save_dir
+        self.stop_training = False
+        steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        cbks = config_callbacks(callbacks, self, epochs, steps,
+                                verbose=verbose, save_dir=save_dir,
+                                log_freq=log_freq)
+
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.set_params({"epochs": epochs, "steps": steps, "epoch": epoch,
+                             "verbose": verbose})
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch)
+                res = self.train_batch(ins, lbs)
+                logs = self._logs_from(res)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            history.append(logs)
+
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                history[-1] = {**logs, **{f"eval_{k}": v
+                                          for k, v in eval_logs.items()}}
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbks = config_callbacks(callbacks, self, 1,
+                                len(loader) if hasattr(loader, "__len__")
+                                else None, verbose=0)
+        return self._run_eval(loader, cbks)
+
+    def _run_eval(self, loader, cbks) -> dict:
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbs = self._split_batch(batch)
+            res = self.eval_batch(ins, lbs)
+            logs = self._logs_from(res)
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        cbks = config_callbacks(callbacks, self, 1, None, verbose=0)
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch)
+            out = self.predict_batch(ins)
+            outputs.append(out)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        if stack_outputs:
+            if outputs and isinstance(outputs[0], (list, tuple)):
+                # multi-output network: stack each output field separately
+                n_fields = len(outputs[0])
+                return [np.concatenate([b[i].numpy() for b in outputs], axis=0)
+                        for i in range(n_fields)]
+            return np.concatenate([o.numpy() for o in outputs], axis=0)
+        return outputs
+
+    # ------------------------------------------------------------- plumbing
+
+    def _split_batch(self, batch):
+        """(x, y) convention: last element is the label when a loss is set."""
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2 and self._loss:
+            return list(batch[:-1]), [batch[-1]]
+        return ([batch] if not isinstance(batch, (list, tuple))
+                else list(batch)), None
+
+    def _logs_from(self, res) -> dict:
+        vals = res if isinstance(res, list) else [res]
+        logs = {"loss": float(vals[0])}
+        for m, v in zip(self._metrics, vals[1:]):
+            v = v[0] if isinstance(v, (list, tuple)) else v
+            logs[m.name() if not isinstance(m.name(), (list, tuple))
+                 else m.name()[0]] = float(v)
+        return logs
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+        if data is None:
+            raise ValueError("data is required")
+        if isinstance(data, DataLoader) or (hasattr(data, "__iter__")
+                                            and not isinstance(data, Dataset)):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    # ----------------------------------------------------------- save/load
+
+    def save(self, path: str, training: bool = True):
+        """training=True: params (+ optimizer) checkpoint; False: inference
+        export via jit.save (requires self._inputs InputSpecs)."""
+        from .. import framework
+        if training:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            framework.io.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None and \
+                    hasattr(self._optimizer, "state_dict"):
+                framework.io.save(self._optimizer.state_dict(),
+                                  path + ".pdopt")
+        else:
+            from .. import jit
+            if self._inputs is None:
+                raise ValueError("Model(inputs=[InputSpec...]) is required "
+                                 "for inference save")
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        from .. import framework
+        state = framework.io.load(path + ".pdparams")
+        if skip_mismatch:
+            current = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in current and tuple(np.asarray(v).shape)
+                     == tuple(current[k].shape)}
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(framework.io.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None) -> dict:
+        total = 0
+        trainable = 0
+        for _, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.ndim else 1
+            total += n
+            if p.trainable:
+                trainable += n
+        return {"total_params": total, "trainable_params": trainable}

@@ -315,9 +315,30 @@ def save(layer, path, input_spec=None, **configs):
                     b._data = d
 
         param_arrays = [p.value() for p in params]
-        in_structs = [jax.ShapeDtypeStruct(
-            tuple(max(s, 1) if s != -1 else 1 for s in spec.shape), spec.dtype)
-            for spec in specs]
+        # -1 dims export as SYMBOLIC dimensions (jax.export shape polymorphism):
+        # a model saved with InputSpec([-1, 224, 224, 3]) serves ANY batch, like
+        # the reference's dynamic-batch pdmodel round-trip
+        scope = jax_export.SymbolicScope()
+        n_sym = 0
+        in_structs = []
+        for spec in specs:
+            if any(s == -1 for s in spec.shape):
+                names = []
+                for i, s in enumerate(spec.shape):
+                    if s == -1:
+                        if i == 0:
+                            # leading -1 dims share ONE symbol: multi-input
+                            # models agree on the batch dimension
+                            names.append("_batch")
+                        else:
+                            names.append(f"_dyn{n_sym}")
+                            n_sym += 1
+                    else:
+                        names.append(str(int(s)))
+                shape = jax_export.symbolic_shape(",".join(names), scope=scope)
+            else:
+                shape = tuple(int(s) for s in spec.shape)
+            in_structs.append(jax.ShapeDtypeStruct(shape, spec.dtype))
         jitted = jax.jit(pure_infer)
         exported = jax_export.export(jitted)(
             [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in param_arrays],

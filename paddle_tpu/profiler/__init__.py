@@ -1,0 +1,286 @@
+"""paddle.profiler — host events, op timing, Chrome trace export, stats.
+
+Reference analog: python/paddle/profiler/profiler.py (Profiler with
+scheduler(wait/warmup/active), RecordEvent, export_chrome_tracing),
+profiler_statistic.py (summary tables), platform/profiler/host_tracer.cc
+(host event recording around op execution) and chrometracing_logger.cc.
+
+TPU-native split: HOST events (op dispatch, user RecordEvent ranges, data
+loading) are recorded in-process exactly like the reference's host tracer;
+DEVICE timing belongs to the XLA runtime, so `use_device_trace=True` brackets
+the active window with jax.profiler.start_trace/stop_trace — the TensorBoard/
+perfetto trace is the CUPTI-tracer analog. Host events alone are meaningful on
+TPU: per-op host time IS dispatch cost, the thing eager mode needs to minimize.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..core import dispatch
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    CUSTOM_DEVICE = 3   # parity: the TPU is a "custom device" in reference terms
+    TPU = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+@dataclass
+class _HostEvent:
+    name: str
+    start: float
+    end: float
+    kind: str = "op"          # "op" | "user" | "stage"
+    tid: int = 0
+
+
+class _Recorder:
+    def __init__(self):
+        self.events: List[_HostEvent] = []
+        self.enabled = False
+
+    def emit(self, name, start, end, kind="op"):
+        if self.enabled:
+            self.events.append(_HostEvent(name, start, end, kind))
+
+
+_recorder = _Recorder()
+
+
+def _dispatch_hook(name: str, start: float, end: float):
+    _recorder.emit(name, start, end, "op")
+
+
+class RecordEvent:
+    """User-annotated range (reference paddle.profiler.RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is not None:
+            _recorder.emit(self.name, self._t0, time.perf_counter(), "user")
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference profiler.py make_scheduler: step number -> state."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler writing a Chrome trace JSON (reference
+    export_chrome_tracing / chrometracing_logger.cc)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      f".paddle_trace.json")
+        prof._export_chrome(path)
+        prof.last_export_path = path
+
+    return handler
+
+
+def load_profiler_result(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """reference paddle.profiler.Profiler.
+
+    with Profiler(scheduler=(2, 5)) as p:   # record steps [2, 5)
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    print(p.summary())
+    """
+
+    def __init__(self, *, targets: Optional[Sequence] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, use_device_trace: bool = False,
+                 trace_dir: Optional[str] = None):
+        if isinstance(scheduler, tuple):
+            start, stop = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=stop - start, repeat=1)
+        self._scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._use_device_trace = use_device_trace
+        self._trace_dir = trace_dir or "./profiler_trace"
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._step_times: List[float] = []
+        self._t_last = None
+        self._device_tracing = False
+        self.last_export_path: Optional[str] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self):
+        _recorder.events.clear()     # each profiler run owns a fresh recorder
+        self._notified = False
+        self._state = self._scheduler(self._step)
+        self._apply_state()
+        self._t_last = time.perf_counter()
+        return self
+
+    def stop(self):
+        self._set_recording(False)
+        if self._device_tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._device_tracing = False
+        if self._on_trace_ready is not None and _recorder.events \
+                and not self._notified:
+            self._on_trace_ready(self)
+            self._notified = True
+        self._state = ProfilerState.CLOSED
+
+    def step(self):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+        prev = self._state
+        self._step += 1
+        self._state = self._scheduler(self._step)
+        if prev != self._state:
+            self._apply_state()
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and self._state == ProfilerState.CLOSED \
+                and self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+            self._notified = True
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _apply_state(self):
+        rec = self._state in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        self._set_recording(rec and not self._timer_only)
+        if rec and self._use_device_trace and not self._device_tracing:
+            import jax
+            jax.profiler.start_trace(self._trace_dir)
+            self._device_tracing = True
+        if not rec and self._device_tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._device_tracing = False
+
+    def _set_recording(self, on: bool):
+        _recorder.enabled = on
+        dispatch.set_profiler_hook(_dispatch_hook if on else None)
+
+    # ------------------------------------------------------------- reporting
+
+    @property
+    def events(self) -> List[_HostEvent]:
+        return list(_recorder.events)
+
+    def summary(self, sorted_by: str = "total", row_limit: int = 30) -> str:
+        """Aggregated per-name table (reference profiler_statistic tables)."""
+        agg = {}
+        for e in _recorder.events:
+            dur = (e.end - e.start) * 1e3
+            entry = agg.setdefault((e.kind, e.name),
+                                   {"count": 0, "total": 0.0, "max": 0.0,
+                                    "min": float("inf")})
+            entry["count"] += 1
+            entry["total"] += dur
+            entry["max"] = max(entry["max"], dur)
+            entry["min"] = min(entry["min"], dur)
+        rows = sorted(agg.items(),
+                      key=lambda kv: kv[1].get(sorted_by, kv[1]["total"]),
+                      reverse=True)[:row_limit]
+        out = [f"{'Name':<40}{'Kind':<8}{'Calls':>8}{'Total(ms)':>12}"
+               f"{'Avg(ms)':>10}{'Max(ms)':>10}{'Min(ms)':>10}"]
+        out.append("-" * len(out[0]))
+        for (kind, name), s in rows:
+            avg = s["total"] / max(s["count"], 1)
+            out.append(f"{name[:39]:<40}{kind:<8}{s['count']:>8}"
+                       f"{s['total']:>12.3f}{avg:>10.3f}{s['max']:>10.3f}"
+                       f"{s['min']:>10.3f}")
+        if self._step_times:
+            total = sum(self._step_times)
+            out.append("-" * len(out[0]))
+            out.append(f"steps: {len(self._step_times)}  total {total:.3f}s  "
+                       f"avg {total / len(self._step_times) * 1e3:.2f}ms/step")
+        return "\n".join(out)
+
+    def step_info(self) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        avg = sum(self._step_times) / len(self._step_times)
+        return (f"avg step {avg * 1e3:.2f}ms, ips {1.0 / avg:.2f} steps/s "
+                f"over {len(self._step_times)} steps")
+
+    def _export_chrome(self, path: str):
+        t0 = min((e.start for e in _recorder.events), default=0.0)
+        events = [{"name": e.name, "ph": "X", "pid": os.getpid(),
+                   "tid": {"op": 1, "user": 2, "stage": 3}.get(e.kind, 9),
+                   "ts": (e.start - t0) * 1e6, "dur": (e.end - e.start) * 1e6,
+                   "cat": e.kind} for e in _recorder.events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def reset(self):
+        _recorder.events.clear()
+        self._step_times.clear()

@@ -1,0 +1,55 @@
+"""Throughput timer (reference python/paddle/profiler/timer.py benchmark())."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class Benchmark:
+    """Reader/step throughput tracker: begin() → step(N) per batch → end()."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._steps = 0
+        self._items = 0
+        self._step_times = []
+
+    def begin(self):
+        self.reset()
+        self._t0 = self._t_last = time.perf_counter()
+
+    def step(self, num_samples: int = 1):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+        self._steps += 1
+        self._items += num_samples
+
+    def end(self) -> dict:
+        total = (time.perf_counter() - self._t0) if self._t0 else 0.0
+        avg = (sum(self._step_times) / len(self._step_times)
+               if self._step_times else 0.0)
+        return {
+            "steps": self._steps,
+            "total_time_s": total,
+            "avg_step_ms": avg * 1e3,
+            "ips": self._items / total if total > 0 else 0.0,
+        }
+
+    def report(self) -> str:
+        s = self.end()
+        return (f"{s['steps']} steps in {s['total_time_s']:.3f}s, "
+                f"{s['avg_step_ms']:.2f} ms/step, {s['ips']:.1f} items/s")
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """Global benchmark singleton (reference paddle.profiler.utils.benchmark)."""
+    return _benchmark

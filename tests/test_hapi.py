@@ -1,0 +1,88 @@
+"""hapi Model tests (reference: test_model.py patterns)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import Dataset
+
+
+class XorDataset(Dataset):
+    """Learnable toy task: 2-bit xor with noise."""
+    def __init__(self, n=128, seed=0):
+        rs = np.random.RandomState(seed)
+        self.x = rs.randint(0, 2, (n, 2)).astype("float32")
+        self.y = (self.x[:, 0].astype(int) ^ self.x[:, 1].astype(int))
+        self.x += rs.randn(n, 2).astype("float32") * 0.05
+        self.y = self.y.astype("int64")[:, None]
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return paddle.nn.Sequential(paddle.nn.Linear(2, 16), paddle.nn.Tanh(),
+                                paddle.nn.Linear(16, 2))
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.05,
+                                        parameters=model.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    history = model.fit(XorDataset(128), XorDataset(64, seed=1),
+                        batch_size=32, epochs=8, shuffle=False, verbose=0)
+    assert len(history) == 8
+    assert history[-1]["loss"] < history[0]["loss"]
+
+    logs = model.evaluate(XorDataset(64, seed=2), batch_size=32, verbose=0)
+    assert logs["acc"] > 0.9, logs
+
+    preds = model.predict(XorDataset(16, seed=3), batch_size=8,
+                          stack_outputs=True)
+    assert preds.shape == (16, 2)
+
+    info = model.summary()
+    assert info["total_params"] == 2 * 16 + 16 + 16 * 2 + 2
+
+    # save/load round trip restores weights
+    model.save(str(tmp_path / "ckpt"))
+    model2 = paddle.Model(_mlp())
+    model2.prepare(loss=paddle.nn.CrossEntropyLoss(),
+                   metrics=paddle.metric.Accuracy())
+    model2.load(str(tmp_path / "ckpt"))
+    logs2 = model2.evaluate(XorDataset(64, seed=2), batch_size=32, verbose=0)
+    np.testing.assert_allclose(logs2["acc"], logs["acc"], rtol=1e-6)
+
+
+def test_early_stopping_stops():
+    paddle.seed(1)
+    model = paddle.Model(_mlp())
+    # lr=0 → no improvement ever → patience triggers
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.0,
+                                       parameters=model.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=1, verbose=0,
+                                        save_best_model=False)
+    history = model.fit(XorDataset(64), XorDataset(32, seed=1), batch_size=32,
+                        epochs=10, verbose=0, callbacks=[es])
+    assert model.stop_training
+    assert len(history) < 10, "early stopping never fired"
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    model = paddle.Model(_mlp())
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=sched, parameters=model.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    lrcb = paddle.callbacks.LRScheduler(by_step=False, by_epoch=True)
+    model.fit(XorDataset(32), batch_size=16, epochs=4, verbose=0,
+              callbacks=[lrcb])
+    assert sched.last_lr < 0.1  # stepped at least twice
