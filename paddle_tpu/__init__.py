@@ -57,6 +57,10 @@ from . import static  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import device  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

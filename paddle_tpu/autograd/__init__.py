@@ -54,7 +54,10 @@ class _PyLayerNode(GradNode):
         self.py_backward = py_backward
         self.fwd_inputs = fwd_inputs
 
-    def run(self, cotangents):
+    def run(self, cotangents, create_graph: bool = False):
+        if create_graph:
+            raise NotImplementedError(
+                "double grad through a PyLayer is not supported")
         if self.released:
             raise RuntimeError(f"{self.name} backward ran twice without retain_graph")
         self.check_versions()

@@ -21,7 +21,8 @@ class GradNode:
     """One node of the reverse graph: knows how to turn output cotangents into input grads."""
 
     __slots__ = ("name", "bwd_fn", "mode", "saved_primals", "saved_outs", "diff_idx",
-                 "input_tensors", "out_metas", "released", "_saved_versions")
+                 "input_tensors", "out_metas", "released", "_saved_versions",
+                 "_attr_key", "_in_items")
 
     def __init__(self, name, bwd_fn, mode, saved_primals, saved_outs, diff_idx,
                  input_tensors, out_metas):
@@ -74,24 +75,45 @@ class GradNode:
             return cotangents
         out = []
         for c in cotangents:
+            # create_graph cotangents are Tensors: align the inner array
+            # in-place (placement doesn't affect the recorded history)
+            inner = c._data if hasattr(c, "_data") else c
             # only a DISJOINT device set marks a stage boundary; overlapping sets
             # (e.g. single-device input + mesh-wide weight) are jit-compatible
-            if (isinstance(c, _jax.Array)
-                    and not (c.sharding.device_set & all_devs)):
+            if (isinstance(inner, _jax.Array)
+                    and not (inner.sharding.device_set & all_devs)):
                 sh = ref.sharding
                 target = (NamedSharding(sh.mesh, _P())
                           if isinstance(sh, NamedSharding) else sh)
-                c = _jax.device_put(c, target)
+                aligned = _jax.device_put(inner, target)
+                if hasattr(c, "_data"):
+                    c._data = aligned
+                else:
+                    c = aligned
             out.append(c)
         return tuple(out)
 
-    def run(self, cotangents: Tuple) -> List:
-        """Returns list of (input_tensor, grad_array) pairs for diff inputs."""
+    def run(self, cotangents: Tuple, create_graph: bool = False) -> List:
+        """Returns list of (input_tensor, grad) pairs for diff inputs.
+
+        create_graph=True replays the vjp through the dispatcher so the grads
+        carry their own GradNodes (double-grad); cotangents are then Tensors."""
         if self.released:
             raise RuntimeError(
                 f"trying to run backward of {self.name} a second time "
                 f"(specify retain_graph=True the first time)")
         self.check_versions()
+        if create_graph:
+            if self.mode == "explicit":
+                raise NotImplementedError(
+                    f"double grad through op '{self.name}' (explicit backward) "
+                    f"is not supported; use the generic-vjp form of the op")
+            from . import dispatch
+            cotangents = self._align_cotangent_devices(cotangents)
+            grads = dispatch.record_bwd_call(
+                self.name, self._attr_key, self.diff_idx, self._in_items,
+                cotangents)
+            return list(zip(self.input_tensors, grads))
         cotangents = self._align_cotangent_devices(cotangents)
         if self.mode == "explicit":
             grads = self.bwd_fn(self.saved_primals, self.saved_outs, cotangents)
@@ -144,9 +166,17 @@ def _build_indegree(roots: Sequence[GradNode]) -> Dict[GradNode, int]:
 
 
 def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
-                 retain_graph: bool = False):
-    """Reference analog: egr::RunBackward (eager/backward.cc:104)."""
+                 retain_graph: bool = False, create_graph: bool = False,
+                 accumulate_into: Optional[set] = None):
+    """Reference analog: egr::RunBackward (eager/backward.cc:104).
+
+    create_graph=True keeps cotangents as Tensors and records every vjp on the
+    tape (higher-order grads). accumulate_into (a set of tensor ids) restricts
+    which leaves receive .grad — paddle.grad's only_inputs semantics."""
     from .tensor import Tensor
+
+    def _may_acc(t):
+        return accumulate_into is None or id(t) in accumulate_into
 
     grad_tensors = grad_tensors or [None] * len(tensors)
     if len(grad_tensors) != len(tensors):
@@ -162,6 +192,10 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         else:
             buf[slot] = buf[slot] + g
 
+    def _zero_ct(meta):
+        z = _zeros_like_meta(meta)
+        return Tensor(z) if create_graph else z
+
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient:
             raise RuntimeError("cannot call backward() on a tensor with stop_gradient=True")
@@ -172,11 +206,15 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                     f"(shape {t.shape})")
             g_arr = jnp.ones(t.shape, t.dtype)
         else:
-            g_arr = g.value() if isinstance(g, Tensor) else jnp.asarray(g)
+            g_arr = g.value() if isinstance(g, Tensor) and not create_graph \
+                else (g if isinstance(g, Tensor) else jnp.asarray(g))
+        if create_graph and not isinstance(g_arr, Tensor):
+            g_arr = Tensor(g_arr)
         node = t._grad_node
         if node is None:
             # backward on a leaf: grad goes straight to .grad
-            t._accumulate_grad(g_arr)
+            if _may_acc(t):
+                t._accumulate_grad(t._apply_grad_hooks(g_arr))
             continue
         buf = buffers.setdefault(node, [None] * len(node.out_metas))
         _acc(buf, t._out_index, g_arr)
@@ -200,19 +238,22 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         visited.add(node)
         buf = buffers.pop(node, [None] * len(node.out_metas))
         cotangents = tuple(
-            b if b is not None else _zeros_like_meta(m)
+            b if b is not None else _zero_ct(m)
             for b, m in zip(buf, node.out_metas))
-        for t, g in node.run(cotangents):
+        for t, g in node.run(cotangents, create_graph=create_graph):
             if g is None:
                 continue
+            # hooks fire as the grad is produced — intermediates included —
+            # and a replacement rewrites the cotangent flowing upstream
+            g = t._apply_grad_hooks(g)
             p = t._grad_node
             if p is None:
-                if not t.stop_gradient:
+                if not t.stop_gradient and _may_acc(t):
                     t._accumulate_grad(g)
             else:
                 pbuf = buffers.setdefault(p, [None] * len(p.out_metas))
                 _acc(pbuf, t._out_index, g)
-                if t._retain_grad_flag and not t.stop_gradient:
+                if t._retain_grad_flag and not t.stop_gradient and _may_acc(t):
                     t._accumulate_grad(g)
         if not retain_graph:
             node.release()
@@ -229,17 +270,16 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
          only_inputs=True, allow_unused=False):
     """paddle.grad analog (reference: GeneralGrad in eager/backward.cc).
 
-    First-order only for now (create_graph raises); computes d(outputs)/d(inputs)
-    without touching .grad of other leaves.
+    Computes d(outputs)/d(inputs) without touching .grad of other leaves.
+    create_graph=True records the backward on the tape (recorded-vjp ops), so
+    the returned grads are differentiable — double/higher-order grad.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError("create_graph=True (double grad) not yet supported")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph  # paddle semantics: create implies retain
 
     # Snapshot and clear target grads, run backward, collect, restore.
     saved = [(t, t._grad, t._retain_grad_flag) for t in inputs]
@@ -247,7 +287,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         t._grad = None
         t._retain_grad_flag = True
     try:
-        run_backward(outputs, grad_outputs, retain_graph=retain_graph)
+        run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                     create_graph=create_graph,
+                     accumulate_into={id(t) for t in inputs})
         results = []
         for t in inputs:
             if t._grad is None:
@@ -256,6 +298,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
                         "one of the inputs has no gradient path from outputs "
                         "(pass allow_unused=True to get None)")
                 results.append(None)
+            elif isinstance(t._grad, Tensor):
+                # create_graph path: the grad carries its own GradNode
+                results.append(t._grad)
             else:
                 results.append(Tensor(t._grad, stop_gradient=True))
         return results

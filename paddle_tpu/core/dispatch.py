@@ -49,6 +49,10 @@ def set_profiler_hook(hook: Optional[Callable[[str, float, float], None]]):
     _PROFILER_HOOK = hook
 
 
+# (name, attr_key, diff_idx, n_in) -> registered vjp-op name (double grad)
+_VJP_NAMES: Dict[Tuple, str] = {}
+
+
 def register_op(name: str, fwd: Callable, bwd: Optional[Callable] = None,
                 nondiff_inputs: Sequence[int] = ()) -> OpDef:
     op = OpDef(name, fwd, bwd, nondiff_inputs)
@@ -288,5 +292,50 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
                             diff_idx=diff_idx,
                             input_tensors=tuple(in_tensors[i] for i in diff_idx),
                             out_metas=tuple((o.shape, o.dtype) for o in outs_t))
+            # double-grad support: keep what record_bwd_call needs to replay
+            # this node's vjp THROUGH the dispatcher (create_graph=True)
+            node._attr_key = key
+            node._in_items = tuple(t if t is not None else a
+                                   for t, a in zip(in_tensors, arrays))
 
     return wrap_outputs(outs_t, single, node)
+
+
+def record_bwd_call(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...],
+                    in_items: Tuple, cotangents: Tuple):
+    """Run an op's generic vjp AS a dispatched op, so the backward computation
+    is itself recorded on the tape — the mechanism behind create_graph=True
+    (reference analog: GradNodes emitting ops with their own GradNodes,
+    enabling eager double grad / GeneralGrad higher-order paths).
+
+    The vjp op's own backward is jit(vjp(vjp_fwd)) — nested jax.vjp gives the
+    second-order derivative. Returns grad Tensors aligned with diff_idx.
+    """
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+    n_in = len(in_items)
+    # full-key map (not a truncated hash): a collision would silently run a
+    # vjp with someone else's baked-in attrs/diff_idx
+    vkey = (name, attr_key, diff_idx, n_in)
+    vname = _VJP_NAMES.get(vkey)
+    if vname is None:
+        vname = f"vjp~{name}~{len(_VJP_NAMES)}"
+        _VJP_NAMES[vkey] = vname
+    if vname not in _REGISTRY:
+        def vjp_fwd(*args):
+            primals, cts = args[:n_in], args[n_in:]
+
+            def f(*diff_primals):
+                full = list(primals)
+                for slot, p in zip(diff_idx, diff_primals):
+                    full[slot] = p
+                out = op.fwd(*full, **attrs)
+                return out if isinstance(out, (tuple, list)) else (out,)
+
+            _, vjp_fn = jax.vjp(f, *[primals[i] for i in diff_idx])
+            grads = vjp_fn(tuple(cts))
+            return grads if len(grads) > 1 else grads[0]
+
+        register_op(vname, vjp_fwd)
+    outs = apply_op(vname, tuple(in_items) + tuple(cotangents))
+    return outs if isinstance(outs, tuple) else (outs,)

@@ -27,7 +27,7 @@ from .device import Place, get_default_place
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
                  "name", "persistable", "trainable", "_version", "_retain_grad_flag",
-                 "_grad_sharding", "__weakref__")
+                 "_grad_sharding", "_hooks", "__weakref__")
 
     def __init__(self, data, dtype=None, place: Optional[Place] = None,
                  stop_gradient: bool = True, name: Optional[str] = None):
@@ -163,10 +163,26 @@ class Tensor:
         else:
             self._grad = value.value() if isinstance(value, Tensor) else jnp.asarray(value)
 
+    def _apply_grad_hooks(self, g):
+        """Run registered backward hooks on a flowing gradient; hooks fire when
+        this tensor's grad is PRODUCED (leaf or intermediate) and a returned
+        value replaces the cotangent for everything downstream — reference
+        Tensor.register_hook semantics."""
+        hooks = getattr(self, "_hooks", None)
+        if not hooks:
+            return g
+        for hook in list(hooks.values()):
+            t_in = g if isinstance(g, Tensor) else Tensor(g)
+            r = hook(t_in)
+            if r is not None:
+                g = r if isinstance(g, Tensor) else \
+                    (r.value() if isinstance(r, Tensor) else r)
+        return g
+
     def _accumulate_grad(self, g):
         # GradNodeAccumulation analog (reference: eager/accumulation/)
         sh = getattr(self, "_grad_sharding", None)
-        if sh is not None:
+        if sh is not None and not isinstance(g, Tensor):
             # ZeRO stage-2 semantics: the gradient is sharded AT accumulation
             # (reduce-scatter), never held replicated on the tape — reference
             # GroupShardedStage2's slice-reduce hooks
@@ -176,6 +192,22 @@ class Tensor:
             self._grad = g
         else:
             self._grad = self._grad + g
+
+    def register_hook(self, hook):
+        """Backward hook on this tensor's gradient (reference
+        Tensor.register_hook); returns a removable handle."""
+        hooks = getattr(self, "_hooks", None)
+        if hooks is None:
+            hooks = {}
+            self._hooks = hooks
+        hid = max(hooks, default=-1) + 1
+        hooks[hid] = hook
+
+        class _Handle:
+            def remove(_self):
+                hooks.pop(hid, None)
+
+        return _Handle()
 
     def backward(self, grad_tensor=None, retain_graph: bool = False):
         from .autograd import run_backward

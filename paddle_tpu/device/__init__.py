@@ -1,0 +1,110 @@
+"""paddle.device — device selection + memory stats.
+
+Reference analog: python/paddle/device (set_device/get_device) and the memory
+stat surface paddle.device.cuda.max_memory_allocated backed by
+fluid/memory/stats.cc's thread-local stat registry.
+
+TPU-native: HBM accounting comes from the runtime itself —
+jax Device.memory_stats() exposes bytes_in_use / peak_bytes_in_use maintained
+by the TPU allocator. No Python-side ledger can be more truthful than that; on
+backends without memory_stats (CPU tests) we fall back to summing live jax
+arrays per device.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    get_device, set_device, device_count, Place, CPUPlace, TPUPlace,
+    is_compiled_with_tpu,
+)
+
+__all__ = ["set_device", "get_device", "device_count", "memory_allocated",
+           "max_memory_allocated", "max_memory_reserved", "memory_reserved",
+           "empty_cache", "synchronize", "cuda"]
+
+
+def _device(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    return device
+
+
+def _live_bytes(dev) -> int:
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            if dev in arr.devices():
+                for sh in arr.addressable_shards:
+                    if sh.device == dev:
+                        total += sh.data.nbytes
+        except Exception:
+            pass
+    return total
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference
+    paddle.device.cuda.memory_allocated)."""
+    dev = _device(device)
+    stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    if stats and "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return _live_bytes(dev)
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak allocated bytes (reference max_memory_allocated)."""
+    dev = _device(device)
+    stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    if stats:
+        for key in ("peak_bytes_in_use", "largest_alloc_size"):
+            if key in stats:
+                return int(stats[key])
+    return _live_bytes(dev)
+
+
+def memory_reserved(device=None) -> int:
+    dev = _device(device)
+    stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    if stats and "bytes_reserved" in stats:
+        return int(stats["bytes_reserved"])
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    """Hint the runtime to release cached blocks (XLA manages HBM; the
+    meaningful analog is dropping Python references + a GC pass)."""
+    import gc
+    gc.collect()
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device finishes."""
+    import jax.numpy as jnp
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda parity alias (maps to the TPU device)."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    synchronize = staticmethod(synchronize)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+
+cuda = _CudaNamespace()

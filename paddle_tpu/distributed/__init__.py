@@ -24,3 +24,7 @@ from .sharding_api import shard_tensor, shard_parameter, replicate_tensor  # noq
 from . import fleet  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .fleet.recompute import recompute  # noqa: F401
+from . import auto_parallel  # noqa: F401,E402
+from . import checkpoint  # noqa: F401,E402
+from .auto_parallel import ProcessMesh  # noqa: F401,E402
+from . import launch  # noqa: F401,E402

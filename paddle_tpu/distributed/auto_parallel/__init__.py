@@ -1,0 +1,173 @@
+"""Auto-parallel (semi-automatic SPMD) surface.
+
+Reference analog: python/paddle/distributed/auto_parallel/ — shard_tensor
+annotations on a ProcessMesh (interface.py), dist-attr completion/partitioning/
+resharding (completion.py, partitioner.py, reshard.py ~3k LoC) and Engine
+(engine.py:55 fit/evaluate/predict).
+
+TPU-native: the reference hand-implements GSPMD — propagate shardings, split
+the program per rank, insert collectives. XLA's SPMD partitioner IS that
+machinery, so the surface here maps 1:1 onto it: ProcessMesh -> jax Mesh,
+shard_tensor -> device_put with a NamedSharding, and the "completion +
+partition + reshard" pipeline happens inside jit. Engine compiles the whole
+training step (TrainStep) over whatever annotations the user placed.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "to_static"]
+
+
+class ProcessMesh:
+    """reference auto_parallel/process_mesh.py — a named mesh of ranks."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = arr.shape
+        self._dim_names = list(dim_names or
+                               [f"d{i}" for i in range(arr.ndim)])
+        devs = np.asarray(jax.devices())
+        if devs.size < arr.size:
+            raise ValueError(f"ProcessMesh needs {arr.size} devices, "
+                             f"have {devs.size}")
+        # rank ids index into the device list (reference: process_ids)
+        self._jax_mesh = Mesh(devs[arr.reshape(-1)].reshape(arr.shape),
+                              tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self._shape))))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def shard_tensor(x, process_mesh: ProcessMesh, shard_spec: Sequence):
+    """Annotate (= place) a tensor: shard_spec is a list of mesh-dim names or
+    None per tensor dim (reference interface.shard_tensor)."""
+    spec = P(*[s for s in shard_spec])
+    arr = x.value() if isinstance(x, Tensor) else jax.numpy.asarray(x)
+    placed = jax.device_put(arr, NamedSharding(process_mesh.mesh, spec))
+    if isinstance(x, Tensor):
+        x._data = placed
+        return x
+    return Tensor(placed)
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh, in_shard_specs=None,
+             out_shard_specs=None):
+    """Constrain an op's output placements (reference interface.shard_op);
+    inputs are annotated by shard_tensor, outputs by with_sharding_constraint."""
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is None:
+            return out
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        fixed = []
+        for o, spec in zip(outs, out_shard_specs):
+            if spec is None or not isinstance(o, Tensor):
+                fixed.append(o)
+                continue
+            sh = NamedSharding(process_mesh.mesh, P(*spec))
+            fixed.append(Tensor(jax.device_put(o.value(), sh)))
+        return fixed[0] if len(fixed) == 1 else tuple(fixed)
+
+    return wrapped
+
+
+class Engine:
+    """reference auto_parallel/engine.py Engine — whole-program distributed
+    training driven by annotations; here one compiled TrainStep per model."""
+
+    def __init__(self, model: Layer, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._step = None
+
+    def _ensure_step(self):
+        if self._step is None:
+            from ...jit import TrainStep
+            loss_fn = self._loss
+            model = self._model
+
+            class _WithLoss(Layer):
+                def __init__(self):
+                    super().__init__()
+                    self._m = model
+
+                def forward(self, x, y):
+                    out = self._m(x)
+                    return loss_fn(out, y)
+
+            self._wrapped = _WithLoss()
+            self._step = TrainStep(self._wrapped, self._optimizer)
+
+    def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
+            verbose: int = 0):
+        from ...io import DataLoader, Dataset
+        loader = (train_data if not isinstance(train_data, Dataset)
+                  else DataLoader(train_data, batch_size=batch_size,
+                                  shuffle=False))
+        self._ensure_step()
+        history = []
+        for _ in range(epochs):
+            last = None
+            for batch in loader:
+                x, y = batch
+                last = float(self._step(x, y))
+            history.append(last)
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1):
+        from ...core.dispatch import no_grad
+        from ...io import DataLoader, Dataset
+        loader = (eval_data if not isinstance(eval_data, Dataset)
+                  else DataLoader(eval_data, batch_size=batch_size))
+        losses = []
+        with no_grad():
+            for x, y in loader:
+                out = self._model(x)
+                losses.append(float(self._loss(out, y)))
+        return float(np.mean(losses))
+
+    def predict(self, data, batch_size: int = 1):
+        from ...core.dispatch import no_grad
+        from ...io import DataLoader, Dataset
+        loader = (data if not isinstance(data, Dataset)
+                  else DataLoader(data, batch_size=batch_size))
+        outs = []
+        with no_grad():
+            for batch in loader:
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                outs.append(self._model(x))
+        return outs
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference auto_parallel to_static helper: returns an Engine."""
+    return Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)

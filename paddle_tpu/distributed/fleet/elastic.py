@@ -1,0 +1,117 @@
+"""Elastic training manager.
+
+Reference analog: python/paddle/distributed/fleet/elastic/manager.py:124
+(ElasticManager: etcd leases + watches on the node prefix, scale-in/out
+detection, endpoint rewrite, local trainer restart).
+
+TPU-native: no etcd — the launcher's HTTP KV master doubles as the membership
+store. Each node heartbeats its endpoint under <job>/elastic/; the manager
+watches the peer set, and on a membership change invokes the registered
+callback (typically: checkpoint + relaunch with the new world). On TPU pods,
+preemption-aware checkpointing matters more than live rescale (slices are
+restored whole), so the manager favors clean save-and-restart over in-place
+endpoint rewrite.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..launch.master import KVClient
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Membership watcher + restart policy over the KV master."""
+
+    def __init__(self, master_endpoint: str, job_id: str, my_endpoint: str,
+                 np_target: int, heartbeat_interval: float = 2.0,
+                 ttl: float = 6.0):
+        self._kv = KVClient(master_endpoint)
+        self._prefix = f"/{job_id}/elastic/"
+        self._me = my_endpoint
+        self._np = np_target
+        self._interval = heartbeat_interval
+        self._ttl = ttl
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._on_change: Optional[Callable[[List[str]], None]] = None
+        self._last_peers: Optional[List[str]] = None
+        self.status = ElasticStatus.HOLD
+
+    # ------------------------------------------------------------- lifecycle
+
+    def register(self, on_change: Optional[Callable] = None):
+        """Start heartbeating + watching (reference manager.start)."""
+        self._on_change = on_change
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        watch = threading.Thread(target=self._watch_loop, daemon=True)
+        self._threads = [hb, watch]
+        hb.start()
+        watch.start()
+
+    def exit(self, completed: bool = True):
+        self.status = (ElasticStatus.COMPLETED if completed
+                       else ElasticStatus.EXIT)
+        self._stop.set()
+        self._kv.put(self._prefix + self._me, "")  # tombstone
+
+    # ----------------------------------------------------------------- loops
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self._kv.put(self._prefix + self._me, str(time.time()))
+            self._stop.wait(self._interval)
+
+    def _live_peers(self) -> List[str]:
+        now = time.time()
+        peers = []
+        for key, stamp in self._kv.get_prefix(self._prefix).items():
+            if not stamp:
+                continue  # tombstoned
+            try:
+                if now - float(stamp) <= self._ttl:
+                    peers.append(key[len(self._prefix):])
+            except ValueError:
+                pass
+        return sorted(peers)
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            peers = self._live_peers()
+            if self._last_peers is None:
+                self._last_peers = peers
+            elif peers != self._last_peers:
+                # scale-in (dead node) or scale-out (join): reference rewrites
+                # PADDLE_TRAINER_ENDPOINTS and restarts local trainers
+                self._last_peers = peers
+                self.status = ElasticStatus.RESTART
+                if self._on_change is not None:
+                    self._on_change(peers)
+            self._stop.wait(self._interval)
+
+    # ------------------------------------------------------------------ info
+
+    def world_ready(self) -> bool:
+        return len(self._live_peers()) >= self._np
+
+    def wait_for_world(self, timeout: float = 60.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.world_ready():
+                return True
+            time.sleep(0.2)
+        return False
+
+    def peers(self) -> List[str]:
+        return self._live_peers()

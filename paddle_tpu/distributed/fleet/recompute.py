@@ -16,7 +16,7 @@ from typing import Any
 
 from ...core import dispatch
 from ...core import random as rnd
-from ...core.autograd import GradNode, grad as autograd_grad
+from ...core.autograd import GradNode, run_backward
 from ...core.tensor import Tensor
 
 
@@ -82,9 +82,13 @@ def recompute(function, *args, preserve_rng_state: bool = True,
             re_list = [re_out] if isinstance(re_out, Tensor) else \
                 [o for o in re_out if isinstance(o, Tensor)]
             cots = [Tensor(c) for c in cotangents[:len(re_list)]]
-            grads = autograd_grad(re_list, detached_diff, grad_outputs=cots,
-                                  allow_unused=True)
-            return [g.value() if g is not None else None for g in grads]
+            # run_backward (not grad()): parameter grads must accumulate as a
+            # side effect, like the reference's inner backward — grad() is
+            # deliberately side-effect-free on non-input leaves
+            for d in detached_diff:
+                d._retain_grad_flag = True
+            run_backward(re_list, cots)
+            return [d._grad for d in detached_diff]
         finally:
             if rng_save is not None:
                 rnd.set_rng_state(rng_save)

@@ -1,0 +1,106 @@
+"""paddle.fft — spectral ops (reference python/paddle/fft.py, which wraps the
+phi fft kernels; here each transform lowers to XLA's FFT HLO via jnp.fft)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import register_op
+from .ops._helpers import _op
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk(name, jfn, n_arg="n"):
+    def fwd(x, *, n=None, axis=-1, norm="backward"):
+        kw = {n_arg: n} if n is not None else {}
+        return jfn(x, axis=axis, norm=norm, **kw)
+
+    register_op(f"fft_{name}", fwd)
+
+    op_name = f"fft_{name}"
+
+    def api(x, n=None, axis=-1, norm="backward", name=None):
+        return _op(op_name, x, n=n, axis=axis, norm=norm)
+
+    api.__name__ = name
+    api.__doc__ = f"paddle.fft.{name} (XLA FFT lowering)."
+    return api
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+
+
+def _mk_nd(name, jfn):
+    def fwd(x, *, s=None, axes=None, norm="backward"):
+        kw = {"s": tuple(s) if s is not None else None,
+              "axes": tuple(axes) if axes is not None else None}
+        return jfn(x, norm=norm, **kw)
+
+    register_op(f"fft_{name}", fwd)
+
+    op_name = f"fft_{name}"
+
+    def api(x, s=None, axes=None, norm="backward", name=None):
+        s_t = tuple(s) if s is not None else None
+        a_t = tuple(axes) if axes is not None else None
+        return _op(op_name, x, s=s_t, axes=a_t, norm=norm)
+
+    api.__name__ = name
+    return api
+
+
+fftn = _mk_nd("fftn", jnp.fft.fftn)
+ifftn = _mk_nd("ifftn", jnp.fft.ifftn)
+rfftn = _mk_nd("rfftn", jnp.fft.rfftn)
+irfftn = _mk_nd("irfftn", jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def _shift_fwd(x, *, axes=None, inverse=False):
+    fn = jnp.fft.ifftshift if inverse else jnp.fft.fftshift
+    return fn(x, axes=axes)
+
+
+register_op("fft_shift", _shift_fwd)
+
+
+def fftshift(x, axes=None, name=None):
+    a = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return _op("fft_shift", x, axes=a, inverse=False)
+
+
+def ifftshift(x, axes=None, name=None):
+    a = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return _op("fft_shift", x, axes=a, inverse=True)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))

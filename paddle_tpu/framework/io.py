@@ -16,16 +16,39 @@ import numpy as np
 from ..core.tensor import Parameter, Tensor
 
 _PROTOCOL = 4
+# arrays beyond this many bytes are stored as flat chunks — the reference
+# (io.py:646) does the same to survive pickle's single-object frame limits
+_CHUNK_BYTES = 2 ** 31 - 1024
+
+
+class _ChunkedArray:
+    __slots__ = ("chunks", "shape", "dtype")
+
+    def __init__(self, arr: "np.ndarray"):
+        flat = arr.reshape(-1)
+        step = max(1, _CHUNK_BYTES // max(arr.itemsize, 1))
+        self.chunks = [flat[i:i + step] for i in range(0, flat.size, step)]
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+
+    def assemble(self) -> "np.ndarray":
+        return np.concatenate(self.chunks).reshape(self.shape)
 
 
 class _TensorPayload:
     __slots__ = ("array", "stop_gradient", "is_parameter", "name")
 
     def __init__(self, array, stop_gradient, is_parameter, name):
+        if getattr(array, "nbytes", 0) > _CHUNK_BYTES:
+            array = _ChunkedArray(array)
         self.array = array
         self.stop_gradient = stop_gradient
         self.is_parameter = is_parameter
         self.name = name
+
+    def get_array(self):
+        return (self.array.assemble() if isinstance(self.array, _ChunkedArray)
+                else self.array)
 
 
 def _pack(obj: Any) -> Any:
@@ -43,13 +66,14 @@ def _pack(obj: Any) -> Any:
 
 def _unpack(obj: Any, return_numpy: bool = False) -> Any:
     if isinstance(obj, _TensorPayload):
+        arr = obj.get_array()
         if return_numpy:
-            return obj.array
+            return arr
         if obj.is_parameter:
-            t = Parameter(obj.array, name=obj.name or None)
+            t = Parameter(arr, name=obj.name or None)
             t.stop_gradient = obj.stop_gradient
             return t
-        t = Tensor(obj.array, stop_gradient=obj.stop_gradient)
+        t = Tensor(arr, stop_gradient=obj.stop_gradient)
         t.name = obj.name
         return t
     if isinstance(obj, dict):

@@ -1,0 +1,190 @@
+"""Inference engine: Config + Predictor (+ multi-clone serving).
+
+Reference analog: paddle/fluid/inference/api/analysis_config.cc (AnalysisConfig),
+analysis_predictor.cc (AnalysisPredictor: load → IR pass pipeline → optimized
+program → NaiveExecutor; ZeroCopyTensor IO; Clone() shares weights for
+multi-thread serving) and paddle_pass_builder.cc (pass lists).
+
+TPU-native: the "optimized program" is the serialized StableHLO executable from
+jit.save — XLA already ran the fusion/layout/memory passes the reference's ~40 IR
+passes hand-implement, at export time. What remains here is the serving surface:
+config object, named IO handles, per-clone streams sharing one weight set, and a
+compiled-executable cache per input signature.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
+
+
+class Config:
+    """reference AnalysisConfig (the TPU-meaningful subset; GPU/TRT/MKLDNN
+    toggles are accepted as no-ops for porting convenience)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes <prefix>.pdmodel + <prefix>.pdiparams; accept either
+        # the prefix or the full .pdmodel path
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._memory_optim = True
+        self._enable_profile = False
+        self._device = "tpu"
+        self._disabled = False
+        self.extra = {}
+
+    def set_model(self, prog: str, params: Optional[str] = None):
+        if prog.endswith(".pdmodel"):
+            prog = prog[:-len(".pdmodel")]
+        self._prefix = prog
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # --- toggles kept for API parity (XLA supersedes them) ---
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator is the TPU here
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x: bool = True):
+        self._memory_optim = x
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_ir_optim(self, x: bool = True):
+        pass  # XLA optimized at export; no-op
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # no TRT on TPU; serving path is already compiled
+
+    def enable_mkldnn(self):
+        pass
+
+
+class _IOHandle:
+    """ZeroCopyTensor analog: named input/output buffer view."""
+
+    def __init__(self, name: str, runner: "Predictor", index: int,
+                 is_input: bool):
+        self.name = name
+        self._runner = runner
+        self._index = index
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        assert self._is_input
+        self._runner._feed[self._index] = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the fed array
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert not self._is_input
+        return np.asarray(self._runner._fetch[self._index])
+
+    def shape(self):
+        buf = (self._runner._feed if self._is_input
+               else self._runner._fetch)[self._index]
+        return list(buf.shape) if buf is not None else None
+
+
+class Predictor:
+    """reference AnalysisPredictor over the exported XLA program."""
+
+    def __init__(self, config: Config, _shared=None):
+        from .. import jit
+        self._config = config
+        if _shared is not None:
+            self._layer = _shared  # Clone(): same weights + executable
+        else:
+            self._layer = jit.load(config.model_dir())
+        specs = getattr(self._layer, "_input_specs", None)
+        n_in = len(specs) if specs else self._infer_n_inputs()
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._feed: List[Optional[np.ndarray]] = [None] * n_in
+        self._fetch: List[np.ndarray] = []
+        self._output_names: List[str] = []
+        self._lock = threading.Lock()
+
+    def _infer_n_inputs(self) -> int:
+        # exported signature is (param_arrays, input_arrays): inputs are the
+        # avals beyond the parameter count
+        ex = self._layer._exported
+        n_params = len(self._layer._param_arrays)
+        try:
+            return max(1, len(ex.in_avals) - n_params)
+        except TypeError:
+            return 1
+
+    # ----------------------------------------------------------------- IO
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(name, self, self._input_names.index(name), True)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["output_0"]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        idx = int(name.rsplit("_", 1)[-1]) if "_" in name else 0
+        return _IOHandle(name, self, idx, False)
+
+    # ---------------------------------------------------------------- run
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Either pass arrays directly or pre-fill via input handles."""
+        from ..core.tensor import Tensor
+        feed = list(inputs) if inputs is not None else self._feed
+        if any(f is None for f in feed):
+            missing = [n for n, f in zip(self._input_names, feed) if f is None]
+            raise ValueError(f"inputs not set: {missing}")
+        with self._lock:
+            out = self._layer(*[np.asarray(f) for f in feed])
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        self._fetch = [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                       for o in outs]
+        self._output_names = [f"output_{i}" for i in range(len(self._fetch))]
+        return [o.copy() for o in self._fetch]
+
+    def clone(self) -> "Predictor":
+        """Weight-sharing clone for multi-thread serving (reference
+        AnalysisPredictor::Clone) — each clone has its own IO buffers/lock; the
+        executable and parameter arrays are shared (immutable on device)."""
+        return Predictor(self._config, _shared=self._layer)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """reference PredictorPool: N clones for concurrent serving."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)

@@ -1,12 +1,17 @@
-"""DataLoader (reference: python/paddle/fluid/reader.py:311 + dataloader/ worker
-machinery). Worker processes there; worker threads + a bounded prefetch queue here —
-the heavy lifting (decode/augment) is numpy which releases the GIL, and the device
-transfer is async into HBM. A C++ feeder (reference data_feed.cc analog) can slot in
-under the same interface later.
+"""DataLoader (reference: python/paddle/fluid/reader.py:311 + dataloader/worker.py).
+
+num_workers > 0 runs __getitem__ in forked WORKER PROCESSES (the reference's
+multiprocess outstanding-queue design): workers inherit the dataset via fork —
+no dataset pickling — fetch samples for a batch, and ship them back through the
+pool; the parent collates and owns the device transfer. A thread then prefetches
+collated batches into a bounded queue so host input work overlaps device steps.
+Set use_process_workers=False to fall back to thread workers (e.g. if the
+dataset touches fork-unsafe state such as the TPU runtime itself).
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as mp
 import queue
 import threading
 from typing import Callable, Optional
@@ -16,6 +21,27 @@ import numpy as np
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+
+# fork-inherited worker state (reference worker.py passes it over pipes; fork
+# makes the dataset visible for free and start cost O(1) in dataset size).
+# _FORK_LOCK serializes the assign→fork window so two concurrently-starting
+# loaders cannot hand each other's dataset to their workers.
+_FORK_STATE = {}
+_FORK_LOCK = threading.Lock()
+
+
+def _worker_init(counter, init_fn):
+    with counter.get_lock():
+        wid = counter.value
+        counter.value += 1
+    _FORK_STATE["worker_id"] = wid
+    if init_fn is not None:
+        init_fn(wid)
+
+
+def _worker_fetch(indices):
+    ds = _FORK_STATE["dataset"]
+    return [ds[i] for i in indices]
 
 
 def default_collate_fn(batch):
@@ -49,11 +75,25 @@ class _PrefetchIterator:
 
     def _run(self):
         try:
-            for item in self._produce():
-                if self._stop.is_set():
-                    return
-                self._q.put(item)
-            self._q.put(self._END)
+            gen = self._produce()
+            try:
+                for item in gen:
+                    # bounded put that notices abandonment: a consumer that
+                    # stopped iterating would otherwise leave this thread
+                    # blocked forever (and leak any worker-process pool the
+                    # generator's finally would have torn down)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+                self._q.put(self._END)
+            finally:
+                if hasattr(gen, "close"):
+                    gen.close()  # runs the generator's finally (pool teardown)
         except BaseException as e:  # propagate worker errors to the consumer
             self._err = e
             self._q.put(self._END)
@@ -73,6 +113,9 @@ class _PrefetchIterator:
     def close(self):
         self._stop.set()
 
+    def __del__(self):
+        self._stop.set()
+
 
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
@@ -81,11 +124,14 @@ class DataLoader:
                  collate_fn: Optional[Callable] = None, num_workers: int = 0,
                  use_buffer_reader: bool = True, prefetch_factor: int = 2,
                  use_shared_memory: bool = True, timeout: int = 0,
-                 worker_init_fn=None, persistent_workers: bool = False):
+                 worker_init_fn=None, persistent_workers: bool = False,
+                 use_process_workers: bool = True):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self._use_process_workers = use_process_workers
+        self._worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -122,7 +168,10 @@ class DataLoader:
             for i in range(len(self.dataset)):
                 yield self.dataset[i]
         else:
-            if self.num_workers > 1:
+            if self.num_workers > 1 and self._use_process_workers \
+                    and "fork" in mp.get_all_start_methods():
+                yield from self._produce_multiprocess()
+            elif self.num_workers > 1:
                 # thread-pool fetch: numpy augmentation releases the GIL
                 import concurrent.futures as cf
                 with cf.ThreadPoolExecutor(self.num_workers) as pool:
@@ -132,6 +181,28 @@ class DataLoader:
             else:
                 for indices in self.batch_sampler:
                     yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _produce_multiprocess(self):
+        """Process workers: one batch of __getitem__ calls per task, results
+        streamed back in order (reference _DataLoaderIterMultiProcess)."""
+        ctx = mp.get_context("fork")
+        with _FORK_LOCK:
+            _FORK_STATE["dataset"] = self.dataset
+            counter = ctx.Value("i", 0)
+            try:
+                pool = ctx.Pool(self.num_workers, initializer=_worker_init,
+                                initargs=(counter, self._worker_init_fn))
+            finally:
+                # workers captured the dataset at fork; drop the global ref
+                _FORK_STATE.pop("dataset", None)
+        try:
+            batches = pool.imap(_worker_fetch, list(self.batch_sampler),
+                                chunksize=1)
+            for samples in batches:
+                yield self.collate_fn(samples)
+        finally:
+            pool.terminate()
+            pool.join()
 
     def __iter__(self):
         if self.num_workers > 0:

@@ -358,9 +358,10 @@ def save(layer, path, input_spec=None, **configs):
 class TranslatedLayer(Layer):
     """Loaded inference program (reference: TranslatedLayer in jit/translated_layer.py)."""
 
-    def __init__(self, exported, params, buffers):
+    def __init__(self, exported, params, buffers, input_specs=None):
         super().__init__()
         self._exported = exported
+        self._input_specs = input_specs  # [(shape, dtype_str)] from save time
         self._param_arrays = [p.value() for p in params.values()]
         for name, p in params.items():
             self.add_parameter(name.replace(".", "__"), p)
@@ -382,4 +383,5 @@ def load(path, **configs) -> TranslatedLayer:
     with open(path + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(f.read())
     state = fio.load(path + ".pdiparams")
-    return TranslatedLayer(exported, state["params"], state["buffers"])
+    return TranslatedLayer(exported, state["params"], state["buffers"],
+                           input_specs=state.get("input_specs"))

@@ -1,0 +1,191 @@
+"""Quantization: QAT (fake-quant training) + PTQ (observer calibration).
+
+Reference analog: python/paddle/quantization (config-driven QuantConfig with
+quanters/observers, QAT.quantize / PTQ.quantize + convert) over the fake_quant
+ops (fluid/operators/fake_quantize_op.*).
+
+TPU-native: fake-quant is a registered op with a straight-through-estimator
+backward; converted models carry int8 weight arrays + scales and dequantize at
+load into the matmul (XLA folds the dequant multiply into the GEMM epilogue).
+int8 MXU matmuls are a further lowering XLA applies where profitable.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._helpers import _op
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "quant_dequant"]
+
+
+def _qdq_fwd(x, scale, *, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _qdq_bwd(primals, outs, cotangents, *, bits=8):
+    # straight-through estimator, gated to the representable range
+    x, scale = primals
+    (g,) = cotangents
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    inside = (jnp.abs(x) <= s).astype(g.dtype)
+    return (g * inside, jnp.zeros_like(scale))
+
+
+register_op("quant_dequant", _qdq_fwd, bwd=_qdq_bwd, nondiff_inputs=(1,))
+
+
+def quant_dequant(x, scale, bits: int = 8):
+    return _op("quant_dequant", x, scale, bits=bits)
+
+
+class AbsmaxObserver:
+    """Running abs-max activation observer (reference AbsmaxObserver)."""
+
+    def __init__(self, momentum: float = 0.9):
+        self._momentum = momentum
+        self.scale: Optional[float] = None
+
+    def observe(self, x) -> float:
+        val = float(np.abs(np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x)).max())
+        if self.scale is None:
+            self.scale = val
+        else:
+            self.scale = self._momentum * self.scale + \
+                (1 - self._momentum) * val
+        return self.scale
+
+
+class QuantConfig:
+    """reference paddle.quantization.QuantConfig (subset: global activation /
+    weight quanter settings by bit width)."""
+
+    def __init__(self, activation=None, weight=None, a_bits: int = 8,
+                 w_bits: int = 8):
+        self.a_bits = a_bits
+        self.w_bits = w_bits
+        self.activation = activation
+        self.weight = weight
+        self._types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        types = layer_types if isinstance(layer_types, (list, tuple)) \
+            else [layer_types]
+        self._types.extend(types)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on weight (per-channel) and activation."""
+
+    def __init__(self, inner, config: QuantConfig, calibrating: bool = False):
+        super().__init__()
+        self._inner = inner
+        self._cfg = config
+        self._observer = AbsmaxObserver()
+        self._calibrating = calibrating
+
+    def forward(self, x):
+        from ..nn import functional as F
+        w = self._inner.weight
+        # per-output-channel weight scale
+        w_scale = Tensor(jnp.max(jnp.abs(w.value()), axis=0, keepdims=True))
+        wq = quant_dequant(w, w_scale, bits=self._cfg.w_bits)
+        a_scale = self._observer.observe(x)
+        if not self._calibrating:
+            xq = quant_dequant(x, Tensor(jnp.asarray(a_scale, jnp.float32)),
+                               bits=self._cfg.a_bits)
+        else:
+            xq = x  # observe-only pass (PTQ calibration)
+        return F.linear(xq, wq, self._inner.bias)
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner.bias
+
+
+class ConvertedLinear(Layer):
+    """Inference form: int8 weights + scales, dequantized into the GEMM."""
+
+    def __init__(self, quanted: QuantedLinear):
+        super().__init__()
+        cfg = quanted._cfg
+        w = quanted._inner.weight.numpy()
+        qmax = 2.0 ** (cfg.w_bits - 1) - 1
+        scale = np.maximum(np.abs(w).max(axis=0, keepdims=True), 1e-8)
+        self.qweight = (np.clip(np.round(w / scale * qmax), -qmax, qmax)
+                        .astype(np.int8))
+        self.w_scale = (scale / qmax).astype(np.float32)
+        self.a_scale = float(quanted._observer.scale or 1.0)
+        self.bias = quanted._inner.bias
+        self.bits = cfg.w_bits
+
+    def forward(self, x):
+        from ..nn import functional as F
+        w = Tensor(jnp.asarray(self.qweight, jnp.float32)
+                   * jnp.asarray(self.w_scale))
+        return F.linear(x, w, self.bias)
+
+
+def _swap_layers(model: Layer, fn):
+    for name, child in list(model.named_children()):
+        replaced = fn(child)
+        if replaced is not None:
+            setattr(model, name, replaced)
+        else:
+            _swap_layers(child, fn)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (reference paddle.quantization.QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        from ..nn import Linear
+
+        def swap(layer):
+            if isinstance(layer, Linear):
+                return QuantedLinear(layer, self._config)
+            return None
+
+        return _swap_layers(model, swap)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        def swap(layer):
+            if isinstance(layer, QuantedLinear):
+                return ConvertedLinear(layer)
+            return None
+
+        return _swap_layers(model, swap)
+
+
+class PTQ(QAT):
+    """Post-training quantization: calibrate observers, then convert."""
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        from ..nn import Linear
+
+        def swap(layer):
+            if isinstance(layer, Linear):
+                return QuantedLinear(layer, self._config, calibrating=True)
+            return None
+
+        return _swap_layers(model, swap)

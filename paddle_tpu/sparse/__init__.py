@@ -1,0 +1,166 @@
+"""paddle.sparse — COO/CSR tensors + sparse nn ops.
+
+Reference analog: python/paddle/sparse (SparseCooTensor/SparseCsrTensor over
+phi/core/sparse_coo_tensor.h kernels).
+
+TPU-native: backed by jax.experimental.sparse.BCOO — XLA lowers sparse
+contractions to gather/scatter/segment-sum, which is how the MXU-less sparse
+path works on TPU. CSR is stored as its COO equivalent with the crows
+materialized on demand (the TPU has no CSR-native kernel to preserve).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "matmul", "masked_matmul", "relu", "nn"]
+
+
+class SparseCooTensor:
+    """Minimal sparse tensor wrapper (indices [ndim, nnz], values [nnz])."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def crows(self) -> Tensor:
+        """CSR row pointers (2-D only), materialized from COO."""
+        assert len(self._bcoo.shape) == 2
+        rows = np.asarray(self._bcoo.indices[:, 0])
+        n = self._bcoo.shape[0]
+        counts = np.bincount(rows, minlength=n)
+        return to_tensor(np.concatenate([[0], np.cumsum(counts)])
+                         .astype("int64"))
+
+    def cols(self) -> Tensor:
+        assert len(self._bcoo.shape) == 2
+        return Tensor(self._bcoo.indices[:, 1].astype(jnp.int64))
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def astype(self, dtype) -> "SparseCooTensor":
+        from ..core.dtype import convert_dtype
+        return SparseCooTensor(
+            jsparse.BCOO((self._bcoo.data.astype(convert_dtype(dtype)),
+                          self._bcoo.indices), shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _dense_value(x):
+    if isinstance(x, Tensor):
+        return x.value()
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo.todense()
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True):
+    """Build a COO tensor; indices [ndim, nnz] (reference layout)."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    vals = jnp.asarray(values.value() if isinstance(values, Tensor)
+                       else np.asarray(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """CSR input surface; stored COO-backed (see module docstring)."""
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    return sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def add(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    return SparseCooTensor(_coo_add(x._bcoo, y._bcoo))
+
+
+def _coo_add(a: jsparse.BCOO, b: jsparse.BCOO) -> jsparse.BCOO:
+    data = jnp.concatenate([a.data, b.data])
+    idx = jnp.concatenate([a.indices, b.indices], axis=0)
+    return jsparse.BCOO((data, idx), shape=a.shape).sum_duplicates(
+        nse=a.nse + b.nse)
+
+
+def matmul(x, y) -> Tensor:
+    """sparse @ dense -> dense (reference paddle.sparse.matmul)."""
+    if isinstance(x, SparseCooTensor):
+        return Tensor(x._bcoo @ _dense_value(y))
+    return Tensor(_dense_value(x) @ y._bcoo)
+
+
+def masked_matmul(x, y, mask: SparseCooTensor) -> SparseCooTensor:
+    """(x @ y) sampled at mask's sparsity (SDDMM, reference masked_matmul)."""
+    xv, yv = _dense_value(x), _dense_value(y)
+    idx = mask._bcoo.indices            # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(mask.shape)))
+
+
+def relu(x: SparseCooTensor) -> SparseCooTensor:
+    b = x._bcoo
+    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
+                                        shape=b.shape))
+
+
+class _SparseNN:
+    """paddle.sparse.nn subset (functional forms)."""
+
+    @staticmethod
+    def functional_relu(x):
+        return relu(x)
+
+
+nn = _SparseNN()

@@ -1,0 +1,400 @@
+"""Round-2 long-tail components: inference predictor, fft, sparse,
+auto-parallel, distributed checkpoint, device memory stats, process-worker
+DataLoader, double grad, tensor hooks."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------------ inference
+
+def test_inference_predictor_and_clone(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([-1, 4], "float32")])
+
+    config = paddle.inference.Config(prefix)
+    config.enable_memory_optim()
+    pred = paddle.inference.create_predictor(config)
+
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+    names = pred.get_input_names()
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    outs = pred.run()
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(pred.get_output_handle("output_0").copy_to_cpu(),
+                               want, rtol=1e-5, atol=1e-6)
+
+    clone = pred.clone()
+    assert clone._layer is pred._layer  # weights + executable shared
+    outs2 = clone.run([x])
+    np.testing.assert_allclose(outs2[0], want, rtol=1e-5, atol=1e-6)
+
+    pool = paddle.inference.PredictorPool(config, size=3)
+    assert len(pool) == 3
+    np.testing.assert_allclose(pool.retrieve(2).run([x])[0], want, rtol=1e-5,
+                               atol=1e-6)
+
+
+# ------------------------------------------------------------------------ fft
+
+def test_fft_round_trip_and_grad():
+    x_np = np.random.RandomState(0).randn(4, 16).astype("float32")
+    x = paddle.to_tensor(x_np)
+    f = paddle.fft.rfft(x)
+    back = paddle.fft.irfft(f, n=16)
+    np.testing.assert_allclose(back.numpy(), x_np, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.fft.fft2(x).numpy(),
+                               np.fft.fft2(x_np), rtol=1e-3, atol=1e-4)
+    sh = paddle.fft.fftshift(paddle.fft.fftfreq(8))
+    assert sh.numpy()[0] == pytest.approx(-0.5)
+
+    y = paddle.to_tensor(x_np)
+    y.stop_gradient = False
+    mag = (paddle.fft.rfft(y).abs() ** 2).sum()
+    mag.backward()
+    assert y.grad is not None and np.isfinite(y.grad.numpy()).all()
+
+
+# --------------------------------------------------------------------- sparse
+
+def test_sparse_coo_csr_ops():
+    dense = np.array([[0, 1.5, 0], [2.0, 0, 0], [0, 0, 3.0]], "float32")
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = np.array([1.5, 2.0, 3.0], "float32")
+    sp = paddle.sparse.sparse_coo_tensor(idx, vals, [3, 3])
+    assert sp.nnz == 3
+    np.testing.assert_allclose(sp.to_dense().numpy(), dense)
+
+    # csr surface maps to the same tensor
+    csr = paddle.sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 0, 2], vals, [3, 3])
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    np.testing.assert_allclose(np.asarray(sp.crows().numpy()), [0, 1, 2, 3])
+
+    y = np.random.RandomState(1).randn(3, 2).astype("float32")
+    np.testing.assert_allclose(paddle.sparse.matmul(sp, y).numpy(), dense @ y,
+                               rtol=1e-5, atol=1e-6)
+    s2 = paddle.sparse.add(sp, sp)
+    np.testing.assert_allclose(s2.to_dense().numpy(), 2 * dense)
+    neg = paddle.sparse.sparse_coo_tensor(idx, -vals, [3, 3])
+    np.testing.assert_allclose(paddle.sparse.relu(neg).to_dense().numpy(),
+                               np.zeros_like(dense))
+    # SDDMM
+    a = np.random.RandomState(2).randn(3, 4).astype("float32")
+    b = np.random.RandomState(3).randn(4, 3).astype("float32")
+    mm = paddle.sparse.masked_matmul(a, b, sp)
+    full = a @ b
+    np.testing.assert_allclose(mm.values().numpy(),
+                               full[idx[0], idx[1]], rtol=1e-5, atol=1e-5)
+
+
+# -------------------------------------------------------------- auto-parallel
+
+def test_auto_parallel_shard_tensor_and_engine():
+    import jax
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.distributed.auto_parallel import shard_tensor, Engine
+
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    t = paddle.to_tensor(np.random.RandomState(0).randn(8, 8).astype("float32"))
+    shard_tensor(t, mesh, ["x", "y"])
+    assert "x" in str(t.value().sharding.spec)
+    assert "y" in str(t.value().sharding.spec)
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    for _, p in net.named_parameters():
+        if p.ndim == 2 and p.shape[0] % 2 == 0:
+            shard_tensor(p, mesh, ["x", None])
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+
+    class DS(paddle.io.Dataset):
+        def __init__(self):
+            rs = np.random.RandomState(0)
+            self.x = rs.randn(32, 8).astype("float32")
+            self.y = rs.randn(32, 4).astype("float32")
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+        def __len__(self):
+            return 32
+
+    eng = Engine(net, loss=paddle.nn.MSELoss(), optimizer=opt)
+    hist = eng.fit(DS(), epochs=3, batch_size=8)
+    assert hist[-1] < hist[0]
+    assert np.isfinite(eng.evaluate(DS(), batch_size=8))
+
+
+# ------------------------------------------------------ distributed checkpoint
+
+def test_distributed_checkpoint_sharded_roundtrip(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("s",))
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 8)
+    net.weight._data = jax.device_put(net.weight.value(),
+                                      NamedSharding(mesh, P("s", None)))
+    w0 = net.weight.numpy().copy()
+
+    ckpt.save_state_dict(dict(net.state_dict()), str(tmp_path / "sd"))
+
+    net2 = paddle.nn.Linear(16, 8)
+    net2.weight._data = jax.device_put(net2.weight.value(),
+                                       NamedSharding(mesh, P("s", None)))
+    ckpt.load_state_dict(str(tmp_path / "sd"), dict(net2.state_dict()))
+    np.testing.assert_allclose(net2.weight.numpy(), w0)
+    # placement survives the round trip
+    assert "s" in str(net2.weight.value().sharding.spec)
+
+
+def test_checkpoint_auto_resume(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    for step in (10, 20, 30, 40):
+        (net(x) ** 2).mean().backward()
+        opt.step(); opt.clear_grad()
+        ckpt.save_checkpoint(str(tmp_path), step, model=net, optimizer=opt,
+                             extra={"lr": 0.01}, keep=2)
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 40
+    assert sorted(os.listdir(tmp_path)) == ["step_30", "step_40"]  # pruned
+
+    w_final = net.weight.numpy().copy()
+    net2 = paddle.nn.Linear(4, 4)
+    opt2 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net2.parameters())
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2, optimizer=opt2)
+    assert info["step"] == 40 and info["lr"] == 0.01
+    np.testing.assert_allclose(net2.weight.numpy(), w_final)
+    assert ckpt.load_checkpoint(str(tmp_path / "nothing")) is None
+
+
+# -------------------------------------------------------------- device memory
+
+def test_device_memory_stats():
+    x = paddle.to_tensor(np.ones((256, 256), "float32"))
+    _ = (x + 1).numpy()
+    alloc = paddle.device.memory_allocated()
+    peak = paddle.device.max_memory_allocated()
+    assert alloc > 0 and peak >= alloc // 2
+    assert paddle.device.cuda.max_memory_allocated() == \
+        paddle.device.max_memory_allocated()
+    paddle.device.synchronize()
+
+
+# ------------------------------------------------------- process-worker loader
+
+def test_dataloader_process_workers():
+    class SquareDS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.full((3,), i * i, "float32"), np.int64(i)
+        def __len__(self):
+            return 12
+
+    seen_ids = []
+    loader = paddle.io.DataLoader(
+        SquareDS(), batch_size=4, shuffle=False, num_workers=2,
+        worker_init_fn=lambda wid: seen_ids.append(wid))
+    batches = list(loader)
+    assert len(batches) == 3
+    xs = np.concatenate([b[0].numpy() for b in batches])
+    np.testing.assert_allclose(xs[:, 0], [i * i for i in range(12)])
+    ys = np.concatenate([b[1].numpy() for b in batches])
+    np.testing.assert_array_equal(ys, np.arange(12))
+
+
+# ------------------------------------------------------------ double grad etc.
+
+def test_double_grad_simple():
+    """d2/dx2 of x^3 = 6x via paddle.grad(create_graph=True)."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], "float32"))
+    x.stop_gradient = False
+    y = (x * x * x).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 9.0]), rtol=1e-5)
+    (g2,) = paddle.grad(g1.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]), rtol=1e-5)
+
+
+def test_double_grad_gradient_penalty():
+    """WGAN-GP style: penalty = (||d loss/d x||_2 - 1)^2 trains."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype("float32"))
+    x.stop_gradient = False
+    out = lin(x).sum()
+    (gx,) = paddle.grad(out, x, create_graph=True)
+    penalty = ((gx ** 2).sum(axis=1).sqrt() - 1.0) ** 2
+    penalty.mean().backward()
+    assert lin.weight.grad is not None
+    assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+def test_register_hook_scales_and_removes():
+    x = paddle.to_tensor(np.ones(3, "float32"))
+    x.stop_gradient = False
+    handle = x.register_hook(lambda g: g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6, 6, 6])
+    x.clear_grad()
+    handle.remove()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3, 3, 3])
+
+
+# --------------------------------------------------------------- quantization
+
+def test_qat_quantize_train_convert():
+    from paddle_tpu.quantization import QAT, PTQ, QuantConfig
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    x_np = np.random.RandomState(0).randn(16, 8).astype("float32")
+    ref = net(paddle.to_tensor(x_np)).numpy()
+
+    qat = QAT(QuantConfig(a_bits=8, w_bits=8))
+    qnet = qat.quantize(net)
+    out_q = qnet(paddle.to_tensor(x_np))
+    # 8-bit fake-quant should stay close to the fp32 output
+    assert np.abs(out_q.numpy() - ref).max() < 0.25 * np.abs(ref).max() + 0.1
+
+    # QAT training: grads flow through the straight-through estimator
+    target = paddle.to_tensor(np.zeros((16, 4), "float32"))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=qnet.parameters())
+    losses = []
+    for _ in range(5):
+        loss = ((qnet(paddle.to_tensor(x_np)) - target) ** 2).mean()
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    converted = qat.convert(qnet)
+    out_c = converted(paddle.to_tensor(x_np))
+    assert np.isfinite(out_c.numpy()).all()
+    from paddle_tpu.quantization import ConvertedLinear  # noqa
+    first = converted[0]
+    assert first.qweight.dtype == np.int8
+
+
+def test_ptq_calibrate_convert():
+    from paddle_tpu.quantization import PTQ, QuantConfig
+
+    paddle.seed(1)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    x_np = np.random.RandomState(1).randn(32, 8).astype("float32")
+    ref = net(paddle.to_tensor(x_np)).numpy()
+
+    ptq = PTQ(QuantConfig())
+    qnet = ptq.quantize(net)
+    for i in range(4):  # calibration passes feed the observers
+        qnet(paddle.to_tensor(x_np[i * 8:(i + 1) * 8]))
+    converted = ptq.convert(qnet)
+    out = converted(paddle.to_tensor(x_np)).numpy()
+    assert np.abs(out - ref).max() < 0.25 * np.abs(ref).max() + 0.1
+
+
+# -------------------------------------------------------------------- elastic
+
+def test_elastic_manager_detects_scale_change(tmp_path):
+    import socket
+    from paddle_tpu.distributed.launch.master import KVServer, KVClient
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    srv = KVServer(port)
+    srv.start()
+    try:
+        ep = f"127.0.0.1:{port}"
+        m1 = ElasticManager(ep, "jobE", "hostA:1", np_target=2,
+                            heartbeat_interval=0.1, ttl=1.0)
+        m2 = ElasticManager(ep, "jobE", "hostB:1", np_target=2,
+                            heartbeat_interval=0.1, ttl=1.0)
+        changes = []
+        m1.register(on_change=lambda peers: changes.append(list(peers)))
+        m2.register()
+        assert m1.wait_for_world(timeout=10)
+        assert sorted(m1.peers()) == ["hostA:1", "hostB:1"]
+
+        # scale-in: hostB exits -> m1 sees the change
+        m2.exit()
+        deadline = __import__("time").time() + 10
+        while (not changes or changes[-1] != ["hostA:1"]) \
+                and __import__("time").time() < deadline:
+            __import__("time").sleep(0.1)
+        assert changes and changes[-1] == ["hostA:1"], changes
+        from paddle_tpu.distributed.fleet.elastic import ElasticStatus
+        assert m1.status == ElasticStatus.RESTART
+        m1.exit()
+    finally:
+        srv.stop()
+
+
+def test_register_hook_on_intermediate_rewrites_upstream_grad():
+    """Hook on an INTERMEDIATE fires and its return replaces the cotangent
+    flowing upstream (review finding: hooks only fired on leaves)."""
+    a = paddle.to_tensor(np.ones(2, "float32"))
+    a.stop_gradient = False
+    b = a * 2.0
+    b.register_hook(lambda g: g * 0.0)
+    c = (b * 3.0).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [0.0, 0.0])
+
+
+def test_grad_does_not_touch_other_leaves():
+    """paddle.grad must not write .grad of leaves outside `inputs` (and under
+    create_graph must not leave Tensor-typed grads on parameters)."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(3, 1)
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    x.stop_gradient = False
+    (gx,) = paddle.grad(lin(x).sum(), x, create_graph=True)
+    assert lin.weight._grad is None and lin.bias._grad is None
+    (gx2,) = paddle.grad(lin(x).sum(), x)
+    assert lin.weight._grad is None
+
+
+def test_dataloader_abandoned_iterator_no_leak():
+    """Breaking out of iteration must tear the worker pool down (producer
+    generator closed), not leave forked processes behind."""
+    import multiprocessing as mp
+
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.zeros(4, "float32")
+        def __len__(self):
+            return 64
+
+    loader = paddle.io.DataLoader(DS(), batch_size=4, num_workers=2)
+    it = iter(loader)
+    next(it)
+    it.close()
+    del it
+    import gc, time
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if len(mp.active_children()) == 0:
+            break
+        time.sleep(0.2)
+    assert len(mp.active_children()) == 0, mp.active_children()
