@@ -59,6 +59,7 @@ from . import autograd  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402

@@ -22,7 +22,7 @@ class GradNode:
 
     __slots__ = ("name", "bwd_fn", "mode", "saved_primals", "saved_outs", "diff_idx",
                  "input_tensors", "out_metas", "released", "_saved_versions",
-                 "_attr_key", "_in_items")
+                 "_attr_key", "_in_items", "_out_refs")
 
     def __init__(self, name, bwd_fn, mode, saved_primals, saved_outs, diff_idx,
                  input_tensors, out_metas):
@@ -196,6 +196,17 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         z = _zeros_like_meta(meta)
         return Tensor(z) if create_graph else z
 
+    # leaf grads buffer until the walk ends so hooks fire ONCE on the fully
+    # accumulated gradient (not per consumer partial)
+    leaf_acc: Dict[int, list] = {}
+
+    def _leaf_add(t, g):
+        ent = leaf_acc.get(id(t))
+        if ent is None:
+            leaf_acc[id(t)] = [t, g]
+        else:
+            ent[1] = ent[1] + g
+
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient:
             raise RuntimeError("cannot call backward() on a tensor with stop_gradient=True")
@@ -214,7 +225,7 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         if node is None:
             # backward on a leaf: grad goes straight to .grad
             if _may_acc(t):
-                t._accumulate_grad(t._apply_grad_hooks(g_arr))
+                _leaf_add(t, g_arr)
             continue
         buf = buffers.setdefault(node, [None] * len(node.out_metas))
         _acc(buf, t._out_index, g_arr)
@@ -222,6 +233,8 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
             roots.append(node)
 
     if not roots:
+        for t, g in leaf_acc.values():
+            t._accumulate_grad(t._apply_grad_hooks(g))
         return
 
     indeg = _build_indegree(roots)
@@ -237,24 +250,32 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
             continue
         visited.add(node)
         buf = buffers.pop(node, [None] * len(node.out_metas))
-        cotangents = tuple(
-            b if b is not None else _zero_ct(m)
-            for b, m in zip(buf, node.out_metas))
+        # the node's output cotangents are now FULLY accumulated (every
+        # consumer ran): fire the output tensors' hooks here — once, on the
+        # total — and satisfy retain_grad with the post-hook value
+        out_refs = getattr(node, "_out_refs", None)
+        cts = []
+        for i, (b, m) in enumerate(zip(buf, node.out_metas)):
+            ct = b if b is not None else _zero_ct(m)
+            t_out = (out_refs[i]() if out_refs and i < len(out_refs)
+                     and out_refs[i] is not None else None)
+            if t_out is not None and b is not None:
+                ct = t_out._apply_grad_hooks(ct)
+                if t_out._retain_grad_flag and not t_out.stop_gradient \
+                        and _may_acc(t_out):
+                    t_out._accumulate_grad(ct)
+            cts.append(ct)
+        cotangents = tuple(cts)
         for t, g in node.run(cotangents, create_graph=create_graph):
             if g is None:
                 continue
-            # hooks fire as the grad is produced — intermediates included —
-            # and a replacement rewrites the cotangent flowing upstream
-            g = t._apply_grad_hooks(g)
             p = t._grad_node
             if p is None:
                 if not t.stop_gradient and _may_acc(t):
-                    t._accumulate_grad(g)
+                    _leaf_add(t, g)
             else:
                 pbuf = buffers.setdefault(p, [None] * len(p.out_metas))
                 _acc(pbuf, t._out_index, g)
-                if t._retain_grad_flag and not t.stop_gradient and _may_acc(t):
-                    t._accumulate_grad(g)
         if not retain_graph:
             node.release()
         for t in node.input_tensors:
@@ -264,6 +285,10 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
             pending[p] -= 1
             if pending[p] == 0:
                 ready.append(p)
+
+    # flush leaves: hooks see the accumulated total exactly once
+    for t, g in leaf_acc.values():
+        t._accumulate_grad(t._apply_grad_hooks(g))
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,

@@ -1,0 +1,95 @@
+"""Host memory arena (native) + stat surface.
+
+Reference analog: fluid/memory/allocation/allocator_facade.cc choosing
+auto_growth_best_fit + memory/stats.cc. On TPU the device allocator is XLA's;
+this arena manages HOST staging memory (input pipeline, checkpoint I/O) with
+the same policy and exposes the reference's stat counters.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .native import load_library
+
+__all__ = ["HostArena", "host_arena", "host_memory_stats"]
+
+
+def _lib():
+    lib = load_library("host_allocator")
+    lib.host_arena_create.restype = ctypes.c_void_p
+    lib.host_arena_create.argtypes = [ctypes.c_size_t]
+    lib.host_arena_alloc.restype = ctypes.c_void_p
+    lib.host_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.host_arena_free.restype = ctypes.c_int
+    lib.host_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.host_arena_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+    lib.host_arena_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class HostArena:
+    """Best-fit arena; `buffer(shape, dtype)` returns a numpy array whose
+    memory lives in the arena (freed via release(arr))."""
+
+    def __init__(self, initial_bytes: int = 1 << 20):
+        self._lib = _lib()
+        self._h = self._lib.host_arena_create(initial_bytes)
+        if not self._h:
+            raise MemoryError("host_arena_create failed")
+        self._live = {}
+
+    def buffer(self, shape, dtype) -> np.ndarray:
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        ptr = self._lib.host_arena_alloc(self._h, max(nbytes, 1))
+        if not ptr:
+            raise MemoryError(f"arena alloc of {nbytes} bytes failed")
+        buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dt, count=int(np.prod(shape))) \
+            .reshape(shape)
+        self._live[arr.__array_interface__["data"][0]] = ptr
+        return arr
+
+    def release(self, arr: np.ndarray):
+        base = arr.__array_interface__["data"][0]
+        ptr = self._live.pop(base, None)
+        if ptr is None:
+            raise ValueError("array was not allocated from this arena")
+        self._lib.host_arena_free(self._h, ptr)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.host_arena_stats(self._h, out)
+        return {"allocated": int(out[0]), "reserved": int(out[1]),
+                "peak_allocated": int(out[2]), "chunks": int(out[3])}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.host_arena_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+_global: Optional[HostArena] = None
+_global_lock = threading.Lock()
+
+
+def host_arena() -> HostArena:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = HostArena(1 << 22)
+        return _global
+
+
+def host_memory_stats() -> dict:
+    """paddle.device.host_memory_stats(): the memory/stats.cc counter surface
+    for the host staging arena."""
+    return host_arena().stats()

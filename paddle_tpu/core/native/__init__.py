@@ -1,0 +1,42 @@
+"""Native (C++) runtime components, built on demand with the system toolchain.
+
+Reference analog: the C++ core the reference ships prebuilt (SURVEY.md §2.2).
+Here each component is a single translation unit compiled to a shared library
+at first use (g++ -O2 -shared) and bound via ctypes — this image has no
+pybind11, and the CPython ABI surface these components need is tiny.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile <name>.cpp in this directory to _<name>.so (if stale) and load."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        out = os.path.join(_DIR, f"_{name}.so")
+        if not os.path.exists(out) or \
+                os.path.getmtime(out) < os.path.getmtime(src):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   src, "-o", out + ".tmp"]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native build of {name} failed:\n{proc.stderr[-2000:]}")
+            os.replace(out + ".tmp", out)
+        lib = ctypes.CDLL(out)
+        _CACHE[name] = lib
+        return lib

@@ -1,0 +1,153 @@
+// Auto-growth best-fit host arena allocator with stats.
+//
+// Reference analog: paddle/fluid/memory/allocation/auto_growth_best_fit_
+// allocator.cc (the default allocator strategy) + memory/stats.cc (the
+// DEVICE_MEMORY_STAT ledger behind max_memory_allocated). Device HBM on TPU is
+// owned by the XLA runtime, so the native allocator's remaining real estate is
+// HOST memory: staging buffers for the input pipeline and checkpoint I/O.
+// Same policy as the reference: geometric chunk growth, best-fit free list,
+// neighbor coalescing on free, and an allocated/reserved/peak stat surface.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <set>
+#include <vector>
+
+namespace {
+
+struct Block {
+  char* ptr;
+  size_t size;
+  bool free;
+  Block* prev = nullptr;  // address-ordered neighbors within the chunk
+  Block* next = nullptr;
+};
+
+struct Arena {
+  std::mutex mu;
+  // free blocks ordered by (size, ptr): lower_bound = best fit
+  std::set<std::pair<size_t, Block*>> free_blocks;
+  std::map<char*, Block*> by_ptr;  // allocated blocks
+  std::vector<std::pair<char*, size_t>> chunks;
+  size_t chunk_next = 0;       // next chunk size (geometric growth)
+  size_t allocated = 0;        // bytes handed out
+  size_t reserved = 0;         // bytes malloc'd from the OS
+  size_t peak_allocated = 0;
+
+  explicit Arena(size_t initial) : chunk_next(initial < 4096 ? 4096 : initial) {}
+};
+
+constexpr size_t kAlign = 64;
+
+size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+void insert_free(Arena* a, Block* b) {
+  b->free = true;
+  a->free_blocks.insert({b->size, b});
+}
+
+void erase_free(Arena* a, Block* b) {
+  a->free_blocks.erase({b->size, b});
+}
+
+}  // namespace
+
+extern "C" {
+
+void* host_arena_create(size_t initial_bytes) {
+  return new (std::nothrow) Arena(initial_bytes);
+}
+
+void* host_arena_alloc(void* handle, size_t nbytes) {
+  auto* a = static_cast<Arena*>(handle);
+  if (!a || nbytes == 0) return nullptr;
+  size_t need = align_up(nbytes);
+  std::lock_guard<std::mutex> g(a->mu);
+
+  auto it = a->free_blocks.lower_bound({need, nullptr});
+  Block* blk;
+  if (it != a->free_blocks.end()) {
+    blk = it->second;
+    a->free_blocks.erase(it);
+  } else {
+    // grow: new chunk at least `need`, geometric otherwise (reference
+    // auto_growth doubles up to a cap)
+    size_t chunk = a->chunk_next;
+    if (chunk < need) chunk = need;
+    char* mem = static_cast<char*>(std::malloc(chunk));
+    if (!mem) return nullptr;
+    a->chunks.emplace_back(mem, chunk);
+    a->reserved += chunk;
+    a->chunk_next = chunk * 2;
+    blk = new Block{mem, chunk, false};
+  }
+  // split if worthwhile
+  if (blk->size >= need + kAlign * 2) {
+    auto* rest = new Block{blk->ptr + need, blk->size - need, true,
+                           blk, blk->next};
+    if (blk->next) blk->next->prev = rest;
+    blk->next = rest;
+    blk->size = need;
+    insert_free(a, rest);
+  }
+  blk->free = false;
+  a->by_ptr[blk->ptr] = blk;
+  a->allocated += blk->size;
+  if (a->allocated > a->peak_allocated) a->peak_allocated = a->allocated;
+  return blk->ptr;
+}
+
+int host_arena_free(void* handle, void* ptr) {
+  auto* a = static_cast<Arena*>(handle);
+  if (!a || !ptr) return -1;
+  std::lock_guard<std::mutex> g(a->mu);
+  auto it = a->by_ptr.find(static_cast<char*>(ptr));
+  if (it == a->by_ptr.end()) return -1;
+  Block* blk = it->second;
+  a->by_ptr.erase(it);
+  a->allocated -= blk->size;
+  // coalesce with free neighbors (reference: FreeIdleChunks-style merge)
+  if (blk->next && blk->next->free) {
+    Block* n = blk->next;
+    erase_free(a, n);
+    blk->size += n->size;
+    blk->next = n->next;
+    if (n->next) n->next->prev = blk;
+    delete n;
+  }
+  if (blk->prev && blk->prev->free) {
+    Block* p = blk->prev;
+    erase_free(a, p);
+    p->size += blk->size;
+    p->next = blk->next;
+    if (blk->next) blk->next->prev = p;
+    delete blk;
+    blk = p;
+  }
+  insert_free(a, blk);
+  return 0;
+}
+
+// stats[0]=allocated stats[1]=reserved stats[2]=peak_allocated stats[3]=chunks
+void host_arena_stats(void* handle, uint64_t* stats) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> g(a->mu);
+  stats[0] = a->allocated;
+  stats[1] = a->reserved;
+  stats[2] = a->peak_allocated;
+  stats[3] = a->chunks.size();
+}
+
+void host_arena_destroy(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  if (!a) return;
+  for (auto& c : a->chunks) std::free(c.first);
+  // blocks leak-checked by process teardown; arena lifetime = process in practice
+  delete a;
+}
+
+}  // extern "C"

@@ -1,0 +1,273 @@
+// TCPStore: native bootstrap KV store with blocking wait semantics.
+//
+// Reference analog: paddle/phi/core/distributed/store/tcp_store.cc — the
+// rank-0-hosted socket KV every collective job bootstraps through. Same role
+// here: a C++ server (thread-per-connection, mutex+condvar wait) + client,
+// exposed to Python over a minimal C ABI (ctypes; no pybind11 in this image).
+//
+// Protocol (all integers little-endian u32):
+//   request : [u8 cmd][u32 klen][key bytes][u32 vlen][value bytes]
+//   response: [u8 status][u32 vlen][value bytes]
+// cmds: 0=SET 1=GET 2=ADD(value=i64 ascii delta) 3=WAIT(vlen=timeout_ms)
+//       4=DELETE 5=NUMKEYS(key ignored)
+// status: 0=ok 1=not_found 2=timeout
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+  Store store;
+  int port = 0;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, uint8_t status, const std::string& value) {
+  uint32_t vlen = static_cast<uint32_t>(value.size());
+  if (!write_full(fd, &status, 1)) return false;
+  if (!write_full(fd, &vlen, 4)) return false;
+  if (vlen && !write_full(fd, value.data(), vlen)) return false;
+  return true;
+}
+
+void serve_conn(Server* srv, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (!srv->stop.load()) {
+    uint8_t cmd;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &cmd, 1)) break;
+    if (!read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    std::string value(vlen, '\0');
+    if (vlen && cmd != 3 && !read_full(fd, &value[0], vlen)) break;
+    if (cmd == 3 && vlen) {  // WAIT carries timeout_ms as payload bytes
+      if (!read_full(fd, &value[0], vlen)) break;
+    }
+
+    Store& st = srv->store;
+    bool ok = true;
+    switch (cmd) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          st.data[key] = value;
+        }
+        st.cv.notify_all();
+        ok = send_resp(fd, 0, "");
+        break;
+      }
+      case 1: {  // GET
+        std::lock_guard<std::mutex> g(st.mu);
+        auto it = st.data.find(key);
+        ok = (it == st.data.end()) ? send_resp(fd, 1, "")
+                                   : send_resp(fd, 0, it->second);
+        break;
+      }
+      case 2: {  // ADD
+        long long delta = std::strtoll(value.c_str(), nullptr, 10);
+        long long result;
+        {
+          std::lock_guard<std::mutex> g(st.mu);
+          long long cur = 0;
+          auto it = st.data.find(key);
+          if (it != st.data.end())
+            cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          result = cur + delta;
+          st.data[key] = std::to_string(result);
+        }
+        st.cv.notify_all();
+        ok = send_resp(fd, 0, std::to_string(result));
+        break;
+      }
+      case 3: {  // WAIT (value = ascii timeout ms; 0 = forever)
+        long long timeout_ms = std::strtoll(value.c_str(), nullptr, 10);
+        std::unique_lock<std::mutex> lk(st.mu);
+        auto pred = [&] {
+          return srv->stop.load() || st.data.count(key) > 0;
+        };
+        bool found;
+        if (timeout_ms > 0) {
+          found = st.cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                 pred) && st.data.count(key) > 0;
+        } else {
+          st.cv.wait(lk, pred);
+          found = st.data.count(key) > 0;
+        }
+        std::string v = found ? st.data[key] : "";
+        lk.unlock();
+        ok = send_resp(fd, found ? 0 : 2, v);
+        break;
+      }
+      case 4: {  // DELETE
+        std::lock_guard<std::mutex> g(st.mu);
+        size_t n = st.data.erase(key);
+        ok = send_resp(fd, n ? 0 : 1, "");
+        break;
+      }
+      case 5: {  // NUMKEYS
+        std::lock_guard<std::mutex> g(st.mu);
+        ok = send_resp(fd, 0, std::to_string(st.data.size()));
+        break;
+      }
+      default:
+        ok = false;
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns server handle or nullptr; port 0 picks an ephemeral port
+void* tcpstore_server_start(int port, int* out_port) {
+  auto* srv = new Server();
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, 128) != 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  srv->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = srv->port;
+
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (srv->stop.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(srv->conns_mu);
+      srv->conns.emplace_back(serve_conn, srv, fd);
+    }
+  });
+  return srv;
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  if (!srv) return;
+  srv->stop.store(true);
+  srv->store.cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    for (auto& t : srv->conns)
+      if (t.joinable()) t.detach();  // blocked conns die with the process
+  }
+  delete srv;
+}
+
+int tcpstore_client_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcpstore_client_close(int fd) { ::close(fd); }
+
+// generic request; returns status (0 ok, 1 not_found, 2 timeout, -1 io error).
+// out_value must hold out_cap bytes; *out_len receives the value size.
+int tcpstore_request(int fd, int cmd, const char* key, int klen,
+                     const char* value, int vlen, char* out_value, int out_cap,
+                     int* out_len) {
+  uint8_t c = static_cast<uint8_t>(cmd);
+  uint32_t kl = static_cast<uint32_t>(klen), vl = static_cast<uint32_t>(vlen);
+  if (!write_full(fd, &c, 1) || !write_full(fd, &kl, 4) ||
+      (kl && !write_full(fd, key, kl)) || !write_full(fd, &vl, 4) ||
+      (vl && !write_full(fd, value, vl)))
+    return -1;
+  uint8_t status;
+  uint32_t rlen;
+  if (!read_full(fd, &status, 1) || !read_full(fd, &rlen, 4)) return -1;
+  std::string resp(rlen, '\0');
+  if (rlen && !read_full(fd, &resp[0], rlen)) return -1;
+  int n = static_cast<int>(rlen) < out_cap ? static_cast<int>(rlen) : out_cap;
+  if (n > 0 && out_value) std::memcpy(out_value, resp.data(), n);
+  if (out_len) *out_len = static_cast<int>(rlen);
+  return status;
+}
+
+}  // extern "C"

@@ -330,14 +330,23 @@ class Parameter(Tensor):
 
 def wrap_outputs(outs_t, single, node):
     """Wrap raw arrays from dispatch into Tensors, wiring autograd edges."""
+    import weakref
     tensors = []
+    refs = []
     for i, o in enumerate(outs_t):
         diff = node is not None and jnp.issubdtype(o.dtype, jnp.inexact)
         t = Tensor(o, stop_gradient=not diff)
         if diff:
             t._grad_node = node
             t._out_index = i
+            refs.append(weakref.ref(t))
+        else:
+            refs.append(None)
         tensors.append(t)
+    if node is not None:
+        # backward needs the output tensors to apply their hooks / retain-grad
+        # on the FULLY ACCUMULATED cotangent (weakrefs: no cycle)
+        node._out_refs = refs
     return tensors[0] if single else tuple(tensors)
 
 

@@ -108,3 +108,10 @@ class _CudaNamespace:
 
 
 cuda = _CudaNamespace()
+
+
+def host_memory_stats() -> dict:
+    """Host staging-arena counters (native best-fit allocator; reference
+    memory/stats.cc surface)."""
+    from ..core.memory import host_memory_stats as _hms
+    return _hms()

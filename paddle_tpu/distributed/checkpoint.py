@@ -84,15 +84,17 @@ def save_checkpoint(directory: str, step: int, model=None, optimizer=None,
     if extra:
         from .. import framework
         framework.io.save(extra, os.path.join(base, "extra.pkl"))
-    # prune old snapshots (keep newest `keep`)
+    # prune old snapshots: keep the `keep` most RECENTLY WRITTEN (mtime, not
+    # step number — a post-rollback save with a lower step must survive)
     if keep and os.path.isdir(directory):
         import shutil
-        steps = sorted((int(m.group(1)) for m in
-                        (_STEP_RE.match(d) for d in os.listdir(directory))
-                        if m), reverse=True)
-        for s in steps[keep:]:
-            shutil.rmtree(os.path.join(directory, f"step_{s}"),
-                          ignore_errors=True)
+        entries = []
+        for d in os.listdir(directory):
+            if _STEP_RE.match(d):
+                p = os.path.join(directory, d)
+                entries.append((os.path.getmtime(p), p))
+        for _, p in sorted(entries, reverse=True)[keep:]:
+            shutil.rmtree(p, ignore_errors=True)
 
 
 def latest_checkpoint(directory: str) -> Optional[int]:

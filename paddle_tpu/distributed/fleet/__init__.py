@@ -87,6 +87,24 @@ def distributed_model(model):
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     hcg = get_hcg()
     strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+    # strategy toggles compose innermost-first (reference meta-optimizer
+    # ordering: dgc/localsgd/lars transform the inner optimizer, then
+    # gradient_merge batches it, then sharding/hybrid places it)
+    from .meta_optimizer_wrappers import (DGCOptimizer, GradientMergeOptimizer,
+                                          LarsMomentumOptimizer,
+                                          LocalSGDOptimizer)
+    if getattr(strategy, "lars", False):
+        optimizer = LarsMomentumOptimizer(optimizer,
+                                          **(strategy.lars_configs or {}))
+    if getattr(strategy, "dgc", False):
+        optimizer = DGCOptimizer(optimizer)
+    if getattr(strategy, "localsgd", False):
+        optimizer = LocalSGDOptimizer(optimizer)
+    if getattr(strategy, "gradient_merge", False):
+        cfg = strategy.gradient_merge_configs or {}
+        optimizer = GradientMergeOptimizer(optimizer,
+                                           k_steps=cfg.get("k_steps", 1),
+                                           avg=cfg.get("avg", True))
     if strategy.sharding or (hcg is not None
                              and hcg.get_sharding_parallel_world_size() > 1):
         return DygraphShardingOptimizer(optimizer, hcg, strategy)

@@ -64,6 +64,11 @@ class ElasticManager:
         self.status = (ElasticStatus.COMPLETED if completed
                        else ElasticStatus.EXIT)
         self._stop.set()
+        # join the heartbeat first: a beat in flight would overwrite the
+        # tombstone and make peers see a phantom live node for a full ttl
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=self._interval * 4)
         self._kv.put(self._prefix + self._me, "")  # tombstone
 
     # ----------------------------------------------------------------- loops

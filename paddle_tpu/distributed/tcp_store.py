@@ -1,0 +1,131 @@
+"""paddle.distributed.TCPStore — native socket KV bootstrap store.
+
+Reference analog: phi/core/distributed/store/tcp_store.cc + the pybind surface
+paddle.distributed.TCPStore(host, port, is_master, world_size). The server and
+wire protocol are C++ (core/native/tcp_store.cpp) — thread-per-connection,
+condvar-blocking WAIT — bound via ctypes.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Union
+
+from ..core.native import load_library
+
+__all__ = ["TCPStore"]
+
+_CMD_SET, _CMD_GET, _CMD_ADD, _CMD_WAIT, _CMD_DEL, _CMD_NUMKEYS = range(6)
+
+
+def _lib():
+    import ctypes
+    lib = load_library("tcp_store")
+    lib.tcpstore_server_start.restype = ctypes.c_void_p
+    lib.tcpstore_server_start.argtypes = [ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_int)]
+    lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_client_connect.restype = ctypes.c_int
+    lib.tcpstore_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_client_close.argtypes = [ctypes.c_int]
+    lib.tcpstore_request.restype = ctypes.c_int
+    lib.tcpstore_request.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
+    return lib
+
+
+class TCPStore:
+    """KV store over the native server (is_master hosts it in-process)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 900.0):
+        import ctypes
+        self._lib = _lib()
+        self._server = None
+        self._timeout = timeout
+        self.host = host
+        if is_master:
+            out_port = ctypes.c_int(0)
+            self._server = self._lib.tcpstore_server_start(
+                port, ctypes.byref(out_port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind {host}:{port}")
+            self.port = int(out_port.value)
+        else:
+            self.port = port
+        self._fd = -1
+        deadline = time.time() + timeout
+        while True:
+            self._fd = self._lib.tcpstore_client_connect(
+                host.encode(), self.port)
+            if self._fd >= 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore: cannot reach {host}:{self.port}")
+            time.sleep(0.2)
+
+    # ---------------------------------------------------------------- calls
+
+    def _request(self, cmd: int, key: str, value: bytes = b"",
+                 cap: int = 1 << 20):
+        import ctypes
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int(0)
+        k = key.encode()
+        rc = self._lib.tcpstore_request(self._fd, cmd, k, len(k), value,
+                                        len(value), out, cap,
+                                        ctypes.byref(out_len))
+        if rc < 0:
+            raise ConnectionError("TCPStore: connection lost")
+        return rc, out.raw[:min(out_len.value, cap)]
+
+    def set(self, key: str, value: Union[str, bytes]):
+        v = value.encode() if isinstance(value, str) else bytes(value)
+        rc, _ = self._request(_CMD_SET, key, v)
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed rc={rc}")
+
+    def get(self, key: str) -> bytes:
+        """Blocking get (waits for the key like the reference's get)."""
+        self.wait([key])
+        rc, v = self._request(_CMD_GET, key)
+        if rc != 0:
+            raise KeyError(key)
+        return v
+
+    def add(self, key: str, amount: int = 1) -> int:
+        rc, v = self._request(_CMD_ADD, key, str(int(amount)).encode())
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed rc={rc}")
+        return int(v)
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None):
+        tmo = self._timeout if timeout is None else timeout
+        ms = str(int(tmo * 1000)).encode()
+        for key in keys:
+            rc, _ = self._request(_CMD_WAIT, key, ms)
+            if rc == 2:
+                raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.wait({key!r}) failed rc={rc}")
+
+    def delete_key(self, key: str) -> bool:
+        rc, _ = self._request(_CMD_DEL, key)
+        return rc == 0
+
+    def num_keys(self) -> int:
+        rc, v = self._request(_CMD_NUMKEYS, "")
+        return int(v) if rc == 0 else 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def __del__(self):
+        try:
+            if self._fd >= 0:
+                self._lib.tcpstore_client_close(self._fd)
+            if self._server:
+                self._lib.tcpstore_server_stop(self._server)
+        except Exception:
+            pass

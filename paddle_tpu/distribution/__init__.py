@@ -1,0 +1,283 @@
+"""paddle.distribution — probability distributions.
+
+Reference analog: python/paddle/distribution (Distribution base with
+sample/log_prob/entropy/kl_divergence and the registered-KL dispatch).
+Sampling draws from the framework's threaded RNG chain (core.random), so
+to_static replay and recompute see deterministic streams.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as rng
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Exponential", "Laplace", "Gumbel", "LogNormal", "Multinomial",
+           "kl_divergence", "register_kl"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x.value()
+    return jnp.asarray(x, jnp.float32)
+
+
+def _key():
+    return rng.split_key()
+
+
+class Distribution:
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = ()) -> Tensor:
+        return self.sample(shape)
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        return Tensor(jnp.exp(self.log_prob(value).value()))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(self.scale ** 2)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(_key(), shape)
+        return Tensor(self.loc + eps * self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale) + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other: "Normal"):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_key(), shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _val(probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.probs.shape
+        return Tensor(jax.random.bernoulli(_key(), self.probs, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+
+    @property
+    def probs_normalized(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(_key(), self.logits,
+                                             shape=tuple(shape)
+                                             + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _val(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value()))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate.shape
+        return Tensor(jax.random.exponential(_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return Tensor(self.loc + self.scale * jax.random.laplace(_key(), shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1.0 + jnp.log(2 * self.scale) + jnp.zeros_like(self.loc))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return Tensor(self.loc + self.scale * jax.random.gumbel(_key(), shape))
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        euler = 0.5772156649015329
+        return Tensor(jnp.log(self.scale) + 1 + euler
+                      + jnp.zeros_like(self.loc))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._normal.sample(shape).value()))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(self._normal.log_prob(Tensor(jnp.log(v))).value()
+                      - jnp.log(v))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _val(probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs, 1e-9, None))
+        draws = jax.random.categorical(
+            _key(), logits, shape=tuple(shape) + (self.total_count,)
+            + self.probs.shape[:-1])
+        counts = jax.nn.one_hot(draws, self.probs.shape[-1]).sum(
+            axis=len(tuple(shape)))
+        return Tensor(counts)
+
+
+# --------------------------------------------------------------- KL registry
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    """reference paddle.distribution.register_kl decorator."""
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"KL({type(p).__name__} || {type(q).__name__}) is not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, axis=-1)
+    logq = jax.nn.log_softmax(q.logits, axis=-1)
+    return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))

@@ -1,12 +1,12 @@
 """DataLoader (reference: python/paddle/fluid/reader.py:311 + dataloader/worker.py).
 
-num_workers > 0 runs __getitem__ in forked WORKER PROCESSES (the reference's
-multiprocess outstanding-queue design): workers inherit the dataset via fork —
-no dataset pickling — fetch samples for a batch, and ship them back through the
-pool; the parent collates and owns the device transfer. A thread then prefetches
-collated batches into a bounded queue so host input work overlaps device steps.
-Set use_process_workers=False to fall back to thread workers (e.g. if the
-dataset touches fork-unsafe state such as the TPU runtime itself).
+num_workers > 0 prefetches batches on worker THREADS by default (numpy decode/
+augment releases the GIL). use_process_workers=True opts into forked WORKER
+PROCESSES (the reference's multiprocess outstanding-queue design): workers
+inherit the dataset via fork — no dataset pickling — fetch samples for a batch
+and ship them back; the parent collates and owns the device transfer. Forking
+after the TPU runtime initialized is unsafe if the dataset itself touches jax,
+so process workers are opt-in and meant for numpy-only datasets.
 """
 from __future__ import annotations
 
@@ -30,11 +30,14 @@ _FORK_STATE = {}
 _FORK_LOCK = threading.Lock()
 
 
-def _worker_init(counter, init_fn):
+def _worker_init(counter, init_fn, token):
     with counter.get_lock():
         wid = counter.value
         counter.value += 1
     _FORK_STATE["worker_id"] = wid
+    # re-key the fork-captured dataset so the parent can drop its entry while
+    # respawned workers (after a child crash) still find it
+    _FORK_STATE["dataset"] = _FORK_STATE[token]
     if init_fn is not None:
         init_fn(wid)
 
@@ -125,7 +128,7 @@ class DataLoader:
                  use_buffer_reader: bool = True, prefetch_factor: int = 2,
                  use_shared_memory: bool = True, timeout: int = 0,
                  worker_init_fn=None, persistent_workers: bool = False,
-                 use_process_workers: bool = True):
+                 use_process_workers: bool = False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -186,15 +189,17 @@ class DataLoader:
         """Process workers: one batch of __getitem__ calls per task, results
         streamed back in order (reference _DataLoaderIterMultiProcess)."""
         ctx = mp.get_context("fork")
+        token = f"dataset_{id(self)}"
         with _FORK_LOCK:
-            _FORK_STATE["dataset"] = self.dataset
+            _FORK_STATE[token] = self.dataset
             counter = ctx.Value("i", 0)
             try:
                 pool = ctx.Pool(self.num_workers, initializer=_worker_init,
-                                initargs=(counter, self._worker_init_fn))
-            finally:
-                # workers captured the dataset at fork; drop the global ref
-                _FORK_STATE.pop("dataset", None)
+                                initargs=(counter, self._worker_init_fn,
+                                          token))
+            except BaseException:
+                _FORK_STATE.pop(token, None)
+                raise
         try:
             batches = pool.imap(_worker_fetch, list(self.batch_sampler),
                                 chunksize=1)
@@ -203,6 +208,7 @@ class DataLoader:
         finally:
             pool.terminate()
             pool.join()
+            _FORK_STATE.pop(token, None)
 
     def __iter__(self):
         if self.num_workers > 0:

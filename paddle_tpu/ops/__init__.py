@@ -268,3 +268,7 @@ def _normal_(self, mean=0.0, std=1.0):
 
 
 _install_tensor_methods()
+
+# L3 codegen layer: declarative schema -> generated bindings (ops/schema.py)
+from . import schema as _schema  # noqa: E402
+_GENERATED_OPS = _schema.generate_bindings(globals())

@@ -1,0 +1,151 @@
+"""Declarative op schema → generated bindings (the L3 codegen layer).
+
+Reference analog: phi/api/yaml/ops.yaml + the generator scripts
+(phi/api/yaml/generator/api_base.py:1187, eager_gen.py, python_c_gen.py):
+there, a YAML schema generates the C++ API, autograd nodes and Python-C
+bindings at build time. Here the schema is a Python table and "generation"
+happens at import: each OpSpec produces a registered dispatch op, a module
+function with a real signature + docstring, and (optionally) a Tensor method —
+one declaration, every binding, exactly the codegen contract, minus the
+build-time C++ because the kernels are jnp lowerings.
+
+`emit_stubs()` writes the generated surface as a .pyi for tooling — the
+artifact the reference emits as generated source files.
+"""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ._helpers import _op
+
+__all__ = ["OpSpec", "OP_SCHEMA", "generate_bindings", "emit_stubs"]
+
+
+@dataclass
+class OpSpec:
+    name: str
+    fwd: Callable                       # jnp-level kernel
+    args: Sequence[str] = ("x",)        # tensor arguments, in order
+    attrs: Dict[str, object] = field(default_factory=dict)  # name -> default
+    doc: str = ""
+    tensor_method: bool = False         # also patch onto Tensor
+    nondiff_inputs: Sequence[int] = ()
+
+
+# ---------------------------------------------------------------- the schema
+# (ops.yaml rows; kernels are jnp lowerings instead of PD_REGISTER_KERNELs)
+
+OP_SCHEMA: List[OpSpec] = [
+    OpSpec("nextafter", jnp.nextafter, args=("x", "y"),
+           doc="Next representable value after x towards y.",
+           tensor_method=True),
+    OpSpec("i0", lambda x: jnp.i0(x),
+           doc="Modified Bessel function of the first kind, order 0.",
+           tensor_method=True),
+    OpSpec("sinc", jnp.sinc, doc="Normalized sinc.", tensor_method=True),
+    OpSpec("xlogy", lambda x, y: jnp.where(
+        x == 0, jnp.zeros_like(jnp.asarray(y, dtype=jnp.result_type(x, y))),
+        x * jnp.log(y)), args=("x", "y"),
+        doc="x * log(y), zero where x == 0.", tensor_method=True),
+    OpSpec("signbit", jnp.signbit, doc="True where the sign bit is set.",
+           tensor_method=True),
+    OpSpec("trapezoid",
+           lambda y, x=None, *, dx=1.0, axis=-1: jnp.trapezoid(
+               y, x=x, dx=dx, axis=axis) if x is not None
+           else jnp.trapezoid(y, dx=dx, axis=axis),
+           args=("y", "x"), attrs={"dx": 1.0, "axis": -1},
+           doc="Trapezoidal-rule integral along an axis."),
+    OpSpec("vander",
+           lambda x, *, n=None, increasing=False: jnp.vander(
+               x, N=n, increasing=increasing),
+           attrs={"n": None, "increasing": False},
+           doc="Vandermonde matrix."),
+    OpSpec("polar", lambda abs, angle: abs * jnp.exp(1j * angle),
+           args=("abs", "angle"),
+           doc="Complex tensor from magnitude and phase."),
+    OpSpec("ldexp", lambda x, y: x * (2.0 ** y), args=("x", "y"),
+           doc="x * 2**y.", tensor_method=True),
+    OpSpec("hypot_generated", jnp.hypot, args=("x", "y"),
+           doc="sqrt(x^2 + y^2) (generated-schema variant)."),
+]
+
+
+def _build_api(spec: OpSpec) -> Callable:
+    register_op(spec.name, spec.fwd, nondiff_inputs=spec.nondiff_inputs)
+    n_tensors = len(spec.args)
+    attr_names = list(spec.attrs)
+
+    def api(*call_args, **kwargs):
+        tensors = list(call_args[:n_tensors])
+        # positional args beyond the tensor slots map onto attrs in order
+        # (paddle-style positional attr calls must not be silently dropped)
+        extras = call_args[n_tensors:]
+        if len(extras) > len(attr_names):
+            raise TypeError(f"{spec.name}() takes at most "
+                            f"{n_tensors + len(attr_names)} positional "
+                            f"arguments ({len(call_args)} given)")
+        attrs = dict(spec.attrs)
+        for k, v in zip(attr_names, extras):
+            attrs[k] = v
+        # fill tensor args passed by keyword; drop trailing optional Nones
+        for i, a in enumerate(spec.args):
+            if i >= len(tensors):
+                tensors.append(kwargs.pop(a, None))
+        while tensors and tensors[-1] is None:
+            tensors.pop()
+        for k in attr_names:
+            if k in kwargs:
+                attrs[k] = kwargs.pop(k)
+        kwargs.pop("name", None)
+        if kwargs:
+            raise TypeError(f"{spec.name}() got unexpected kwargs "
+                            f"{sorted(kwargs)}")
+        return _op(spec.name, *tensors, **attrs)
+
+    params = [inspect.Parameter(a, inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                                default=None if i > 0 else
+                                inspect.Parameter.empty)
+              for i, a in enumerate(spec.args)]
+    params += [inspect.Parameter(k, inspect.Parameter.KEYWORD_ONLY, default=v)
+               for k, v in spec.attrs.items()]
+    params.append(inspect.Parameter("name", inspect.Parameter.KEYWORD_ONLY,
+                                    default=None))
+    api.__signature__ = inspect.Signature(params)
+    api.__name__ = spec.name
+    api.__qualname__ = spec.name
+    api.__doc__ = (spec.doc or spec.name) + \
+        "\n\n(Generated from paddle_tpu.ops.schema — declarative op registry.)"
+    return api
+
+
+def generate_bindings(namespace: dict):
+    """Generate every schema op into `namespace` (+ Tensor methods)."""
+    generated = []
+    for spec in OP_SCHEMA:
+        api = _build_api(spec)
+        namespace[spec.name] = api
+        if spec.tensor_method and not hasattr(Tensor, spec.name):
+            setattr(Tensor, spec.name, api)
+        generated.append(spec.name)
+    return generated
+
+
+def emit_stubs(path: Optional[str] = None) -> str:
+    """Write the generated API surface as a .pyi stub (the build artifact)."""
+    lines = ["# AUTO-GENERATED from paddle_tpu.ops.schema — do not edit.",
+             "from typing import Any", ""]
+    for spec in OP_SCHEMA:
+        sig_args = list(spec.args) + \
+            [f"{k}={v!r}" for k, v in spec.attrs.items()] + ["name=None"]
+        lines.append(f"def {spec.name}({', '.join(sig_args)}) -> Any: ...")
+    text = "\n".join(lines) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

@@ -58,8 +58,15 @@ class AbsmaxObserver:
         self.scale: Optional[float] = None
 
     def observe(self, x) -> float:
-        val = float(np.abs(np.asarray(
-            x.numpy() if isinstance(x, Tensor) else x)).max())
+        import jax
+        inner = x.value() if isinstance(x, Tensor) else x
+        if isinstance(inner, jax.core.Tracer):
+            # under jit/to_static tracing the observer cannot materialize a
+            # host value — reuse the calibrated scale (observers calibrate in
+            # eager; compiled QAT runs with frozen scales, like the reference's
+            # static fake_quant with persisted scales)
+            return self.scale if self.scale is not None else 1.0
+        val = float(np.abs(np.asarray(inner)).max())
         if self.scale is None:
             self.scale = val
         else:
@@ -134,12 +141,14 @@ class ConvertedLinear(Layer):
         self.a_scale = float(quanted._observer.scale or 1.0)
         self.bias = quanted._inner.bias
         self.bits = cfg.w_bits
+        # dequantize ONCE onto the device; per-call host->device upload would
+        # dominate serving latency
+        self._w = Tensor(jnp.asarray(self.qweight, jnp.float32)
+                         * jnp.asarray(self.w_scale))
 
     def forward(self, x):
         from ..nn import functional as F
-        w = Tensor(jnp.asarray(self.qweight, jnp.float32)
-                   * jnp.asarray(self.w_scale))
-        return F.linear(x, w, self.bias)
+        return F.linear(x, self._w, self.bias)
 
 
 def _swap_layers(model: Layer, fn):

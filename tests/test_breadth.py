@@ -1,0 +1,144 @@
+"""Schema-generated ops, distributions, strategy-toggle optimizers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_generated_schema_ops():
+    t = paddle.to_tensor(np.array([0.0, 0.5], "float32"))
+    np.testing.assert_allclose(paddle.sinc(t).numpy(), np.sinc([0.0, 0.5]),
+                               rtol=1e-6)
+    x = paddle.to_tensor(np.array([0.0, 2.0], "float32"))
+    y = paddle.to_tensor(np.array([5.0, 3.0], "float32"))
+    np.testing.assert_allclose(paddle.xlogy(x, y).numpy(),
+                               [0.0, 2 * np.log(3.0)], rtol=1e-6)
+    # tensor-method binding from the same declaration
+    np.testing.assert_allclose(x.xlogy(y).numpy(), [0.0, 2 * np.log(3.0)],
+                               rtol=1e-6)
+    ys = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    np.testing.assert_allclose(paddle.trapezoid(ys, dx=0.5).numpy(), 2.0)
+    v = paddle.vander(paddle.to_tensor(np.array([1.0, 2.0], "float32")), n=3)
+    assert tuple(v.shape) == (2, 3)
+    assert bool(paddle.signbit(paddle.to_tensor(
+        np.array([-1.0], "float32"))).numpy()[0])
+    # grads flow through generated ops (schema registers them on dispatch)
+    g = paddle.to_tensor(np.array([2.0], "float32"))
+    g.stop_gradient = False
+    paddle.xlogy(g, y[:1]).sum().backward()
+    np.testing.assert_allclose(g.grad.numpy(), [np.log(5.0)], rtol=1e-6)
+    # stub emission (the generated-artifact surface)
+    text = paddle.ops.schema.emit_stubs()
+    assert "def xlogy(x, y, name=None)" in text
+
+
+def test_distributions():
+    paddle.seed(0)
+    n = paddle.distribution.Normal(0.0, 1.0)
+    s = n.sample([2000])
+    assert abs(float(s.numpy().mean())) < 0.1
+    np.testing.assert_allclose(
+        n.log_prob(paddle.to_tensor(np.array(0.0, "float32"))).numpy(),
+        -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    n2 = paddle.distribution.Normal(1.0, 2.0)
+    kl = paddle.distribution.kl_divergence(n, n2)
+    want = 0.5 * ((1 / 2) ** 2 + (1 / 2) ** 2 - 1 - np.log(0.25))
+    np.testing.assert_allclose(kl.numpy(), want, rtol=1e-5)
+
+    u = paddle.distribution.Uniform(0.0, 2.0)
+    assert float(u.entropy().numpy()) == pytest.approx(np.log(2.0))
+    assert np.isneginf(u.log_prob(paddle.to_tensor(
+        np.array(3.0, "float32"))).numpy())
+
+    c = paddle.distribution.Categorical(
+        paddle.to_tensor(np.log(np.array([0.2, 0.8], "float32"))))
+    samples = c.sample([4000]).numpy()
+    assert 0.7 < (samples == 1).mean() < 0.9
+    b = paddle.distribution.Bernoulli(0.3)
+    assert float(b.entropy().numpy()) == pytest.approx(
+        -(0.3 * np.log(0.3) + 0.7 * np.log(0.7)), rel=1e-4)
+    e = paddle.distribution.Exponential(2.0)
+    assert abs(float(e.sample([4000]).numpy().mean()) - 0.5) < 0.05
+    g = paddle.distribution.Gumbel(0.0, 1.0)
+    assert np.isfinite(g.log_prob(paddle.to_tensor(
+        np.array(0.1, "float32"))).numpy())
+
+
+def _toy():
+    paddle.seed(0)
+    m = paddle.nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype("float32"))
+    return m, x
+
+
+def test_gradient_merge_optimizer():
+    from paddle_tpu.distributed.fleet.meta_optimizer_wrappers import (
+        GradientMergeOptimizer)
+
+    m, x = _toy()
+    w0 = m.weight.numpy().copy()
+    opt = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+        k_steps=4, avg=True)
+    for i in range(3):
+        (m(x) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+        np.testing.assert_allclose(m.weight.numpy(), w0)  # merged, not applied
+    (m(x) ** 2).mean().backward()
+    opt.step()
+    assert not np.allclose(m.weight.numpy(), w0)  # k-th step applies
+
+
+def test_dgc_optimizer_sparsifies_with_error_feedback():
+    from paddle_tpu.distributed.fleet.meta_optimizer_wrappers import DGCOptimizer
+
+    m, x = _toy()
+    opt = DGCOptimizer(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=m.parameters()),
+                       sparsity=0.75)
+    losses = []
+    for _ in range(20):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]          # training works despite 75% drop
+    assert opt._residual                   # error feedback is being carried
+
+
+def test_lars_optimizer_trains():
+    from paddle_tpu.distributed.fleet.meta_optimizer_wrappers import (
+        LarsMomentumOptimizer)
+
+    m, x = _toy()
+    opt = LarsMomentumOptimizer(paddle.optimizer.Momentum(
+        learning_rate=0.5, momentum=0.9, parameters=m.parameters()))
+    losses = []
+    for _ in range(10):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_strategy_wires_wrappers():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.meta_optimizer_wrappers import (
+        GradientMergeOptimizer)
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strategy)
+    m, _ = _toy()
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+    assert isinstance(opt._inner_opt, GradientMergeOptimizer)

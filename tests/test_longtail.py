@@ -398,3 +398,28 @@ def test_dataloader_abandoned_iterator_no_leak():
             break
         time.sleep(0.2)
     assert len(mp.active_children()) == 0, mp.active_children()
+
+
+def test_register_hook_fires_once_on_accumulated_grad():
+    """Fan-out: a non-linear hook (clip) must see the ACCUMULATED grad once,
+    not each consumer's partial (review finding)."""
+    calls = []
+    x = paddle.to_tensor(np.ones(2, "float32"))
+    x.stop_gradient = False
+    y = x * 1.0
+    y.register_hook(lambda g: (calls.append(1), g.clip(-1.0, 1.0))[1])
+    # two consumers each contribute grad 1 -> accumulated 2 -> clipped to 1
+    z = (y * 1.0).sum() + (y * 1.0).sum()
+    z.backward()
+    assert len(calls) == 1, f"hook ran {len(calls)} times"
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_checkpoint_rollback_save_survives_prune(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    net = paddle.nn.Linear(2, 2)
+    for step in (100, 101, 102):
+        ckpt.save_checkpoint(str(tmp_path), step, model=net, keep=3)
+    # rollback: a LOWER step saved later must survive pruning
+    ckpt.save_checkpoint(str(tmp_path), 50, model=net, keep=3)
+    assert os.path.isdir(tmp_path / "step_50")

@@ -1,0 +1,110 @@
+"""Native C++ component tests (TCPStore, host event recorder, allocator)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def test_tcp_store_set_get_add_wait():
+    from paddle_tpu.distributed.tcp_store import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=10)
+    client = TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=2, timeout=10)
+
+    master.set("alpha", b"42")
+    assert client.get("alpha") == b"42"
+    assert client.add("counter", 3) == 3
+    assert master.add("counter", 4) == 7
+    assert client.num_keys() >= 2
+    assert client.delete_key("alpha")
+    assert not client.delete_key("alpha")
+
+    # blocking wait: another thread sets the key after a delay
+    def setter():
+        time.sleep(0.3)
+        master.set("late", b"now")
+
+    t = threading.Thread(target=setter)
+    t.start()
+    t0 = time.time()
+    client.wait(["late"], timeout=10)
+    assert time.time() - t0 >= 0.2
+    assert client.get("late") == b"now"
+    t.join()
+
+    with pytest.raises(TimeoutError):
+        client.wait(["never"], timeout=0.3)
+
+
+def test_tcp_store_rendezvous_pattern():
+    """The reference bootstrap pattern: N ranks register, rank0 publishes."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=4, timeout=10)
+    results = []
+
+    def rank(i):
+        st = TCPStore("127.0.0.1", master.port, timeout=10)
+        n = st.add("arrived", 1)
+        if n == 4:
+            st.set("peers_ready", b"1")
+        st.wait(["peers_ready"], timeout=10)
+        results.append(i)
+
+    threads = [threading.Thread(target=rank, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert sorted(results) == [0, 1, 2, 3]
+
+
+def test_host_arena_alloc_free_stats():
+    from paddle_tpu.core.memory import HostArena
+
+    arena = HostArena(1 << 16)
+    a = arena.buffer((128, 4), "float32")
+    a[:] = 7.0
+    b = arena.buffer((64,), "int64")
+    b[:] = np.arange(64)
+    st = arena.stats()
+    assert st["allocated"] >= 128 * 4 * 4 + 64 * 8
+    assert st["reserved"] >= st["allocated"]
+    assert st["peak_allocated"] >= st["allocated"]
+    np.testing.assert_allclose(a, 7.0)
+    np.testing.assert_array_equal(b, np.arange(64))
+
+    arena.release(a)
+    st2 = arena.stats()
+    assert st2["allocated"] < st["allocated"]
+    # best-fit reuse: same-size realloc comes from the freed block (no growth)
+    c = arena.buffer((128, 4), "float32")
+    assert arena.stats()["reserved"] == st2["reserved"]
+    arena.release(c)
+    arena.release(b)
+    assert arena.stats()["allocated"] == 0
+    with pytest.raises(ValueError):
+        arena.release(np.zeros(3))
+
+
+def test_host_arena_coalescing_growth():
+    from paddle_tpu.core.memory import HostArena
+
+    arena = HostArena(1 << 12)
+    bufs = [arena.buffer((256,), "float32") for _ in range(32)]
+    grown = arena.stats()["chunks"]
+    assert grown >= 1
+    for x in bufs:
+        arena.release(x)
+    assert arena.stats()["allocated"] == 0
+    # after full free + coalesce, a big allocation fits without growing
+    big = arena.buffer((2048,), "float32")
+    arena.release(big)
+
+
+def test_device_host_memory_stats_surface():
+    import paddle_tpu as paddle
+    st = paddle.device.host_memory_stats()
+    assert set(st) == {"allocated", "reserved", "peak_allocated", "chunks"}
