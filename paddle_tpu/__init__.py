@@ -60,6 +60,8 @@ from . import hapi  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402

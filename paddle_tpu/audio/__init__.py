@@ -1,0 +1,153 @@
+"""paddle.audio — feature extraction (reference python/paddle/audio/features:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC over the fft kernels)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["features", "functional"]
+
+
+def _hz_to_mel(f):
+    return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+
+def _mel_to_hz(m):
+    return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None) -> np.ndarray:
+    """Triangular mel filterbank [n_mels, n_fft//2+1] (reference
+    audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2
+    mel_pts = np.linspace(_hz_to_mel(f_min), _hz_to_mel(f_max), n_mels + 2)
+    hz_pts = _mel_to_hz(mel_pts)
+    bins = np.floor((n_fft + 1) * hz_pts / sr).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+    for m in range(1, n_mels + 1):
+        lo, ctr, hi = bins[m - 1], bins[m], bins[m + 1]
+        for k in range(lo, ctr):
+            if ctr > lo:
+                fb[m - 1, k] = (k - lo) / (ctr - lo)
+        for k in range(ctr, hi):
+            if hi > ctr:
+                fb[m - 1, k] = (hi - k) / (hi - ctr)
+    return fb
+
+
+class functional:
+    compute_fbank_matrix = staticmethod(compute_fbank_matrix)
+    hz_to_mel = staticmethod(_hz_to_mel)
+    mel_to_hz = staticmethod(_mel_to_hz)
+
+
+def _frame(x, n_fft, hop):
+    # x: [..., T] -> [..., frames, n_fft]
+    T = x.shape[-1]
+    n_frames = 1 + max(0, (T - n_fft)) // hop
+    idx = (np.arange(n_frames)[:, None] * hop + np.arange(n_fft)[None, :])
+    return x[..., idx]
+
+
+class Spectrogram(Layer):
+    """STFT magnitude^power spectrogram (reference features/layers.py)."""
+
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        wl = win_length or n_fft
+        if wl > n_fft:
+            raise ValueError(f"win_length {wl} > n_fft {n_fft}")
+        if window == "hann":
+            w = np.hanning(wl)
+        elif window in ("hamming",):
+            w = np.hamming(wl)
+        elif window in ("rect", "boxcar", "ones"):
+            w = np.ones(wl)
+        else:
+            raise ValueError(f"unsupported window {window!r} "
+                             f"(hann | hamming | rect)")
+        # centered zero-pad to n_fft (reference win_length semantics)
+        pad = n_fft - wl
+        win = np.pad(w, (pad // 2, pad - pad // 2)).astype(np.float32)
+        self.register_buffer("window", Tensor(win))
+
+    def forward(self, x):
+        arr = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.center:
+            pad = self.n_fft // 2
+            arr = jnp.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(pad, pad)],
+                          mode="reflect" if self.pad_mode == "reflect"
+                          else "constant")
+        frames = _frame(arr, self.n_fft, self.hop)
+        spec = jnp.fft.rfft(frames * self.window.value(), axis=-1)
+        mag = jnp.abs(spec) ** self.power
+        return Tensor(jnp.swapaxes(mag, -1, -2))   # [..., freq, frames]
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 power: float = 2.0, **kw):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft=n_fft, hop_length=hop_length,
+                                       power=power)
+        fb = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+        self.register_buffer("fbank", Tensor(fb))
+
+    def forward(self, x):
+        spec = self.spectrogram(x).value()          # [..., freq, frames]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank.value(), spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db=None, **kw):
+        super().__init__(*args, **kw)
+        self.amin = amin
+        self.ref = ref_value
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x).value()
+        log_mel = 10.0 * jnp.log10(jnp.maximum(mel, self.amin) / self.ref)
+        if self.top_db is not None:
+            log_mel = jnp.maximum(log_mel, log_mel.max() - self.top_db)
+        return Tensor(log_mel)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 13, n_fft: int = 512,
+                 n_mels: int = 64, **kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels)
+        # type-II DCT matrix (orthonormal)
+        n = np.arange(n_mels)
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(math.pi / n_mels * (n + 0.5) * k) * math.sqrt(2 / n_mels)
+        dct[0] *= 1 / math.sqrt(2)
+        self.register_buffer("dct", Tensor(dct.astype(np.float32)))
+
+    def forward(self, x):
+        lm = self.log_mel(x).value()                # [..., mel, frames]
+        return Tensor(jnp.einsum("km,...mt->...kt", self.dct.value(), lm))
+
+
+class features:
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC

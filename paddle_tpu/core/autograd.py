@@ -201,6 +201,12 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
     leaf_acc: Dict[int, list] = {}
 
     def _leaf_add(t, g):
+        sh = getattr(t, "_grad_sharding", None)
+        if sh is not None and not isinstance(g, Tensor):
+            # ZeRO stage-2 invariant: grads shard the moment they're produced,
+            # even while buffered here — never a full replicated copy per param
+            import jax
+            g = jax.device_put(g, sh)
         ent = leaf_acc.get(id(t))
         if ent is None:
             leaf_acc[id(t)] = [t, g]

@@ -52,7 +52,9 @@ class HostArena:
         buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
         arr = np.frombuffer(buf, dtype=dt, count=int(np.prod(shape))) \
             .reshape(shape)
-        self._live[arr.__array_interface__["data"][0]] = ptr
+        base = arr.__array_interface__["data"][0]
+        self._live[base] = ptr
+        _BUFFER_PINS[base] = self   # keep the arena alive while arrays live
         return arr
 
     def release(self, arr: np.ndarray):
@@ -60,6 +62,7 @@ class HostArena:
         ptr = self._live.pop(base, None)
         if ptr is None:
             raise ValueError("array was not allocated from this arena")
+        _BUFFER_PINS.pop(base, None)
         self._lib.host_arena_free(self._h, ptr)
 
     def stats(self) -> dict:
@@ -76,6 +79,10 @@ class HostArena:
         except Exception:
             pass
 
+
+# arrays handed out by buffer() pin their arena here (keyed by base address)
+# so an otherwise-unreferenced arena cannot free memory under a live array
+_BUFFER_PINS: dict = {}
 
 _global: Optional[HostArena] = None
 _global_lock = threading.Lock()

@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -42,6 +43,7 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::vector<std::thread> conns;
+  std::set<int> conn_fds;   // open sockets, so stop() can unblock recv()
   std::mutex conns_mu;
   Store store;
   int port = 0;
@@ -164,6 +166,10 @@ void serve_conn(Server* srv, int fd) {
     }
     if (!ok) break;
   }
+  {
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    srv->conn_fds.erase(fd);
+  }
   ::close(fd);
 }
 
@@ -205,6 +211,7 @@ void* tcpstore_server_start(int port, int* out_port) {
         continue;
       }
       std::lock_guard<std::mutex> g(srv->conns_mu);
+      srv->conn_fds.insert(fd);
       srv->conns.emplace_back(serve_conn, srv, fd);
     }
   });
@@ -219,11 +226,14 @@ void tcpstore_server_stop(void* handle) {
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  // unblock every connection's recv, then JOIN (never detach: a detached
+  // thread touching the deleted Server would be a use-after-free)
   {
     std::lock_guard<std::mutex> g(srv->conns_mu);
-    for (auto& t : srv->conns)
-      if (t.joinable()) t.detach();  // blocked conns die with the process
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
+  for (auto& t : srv->conns)
+    if (t.joinable()) t.join();
   delete srv;
 }
 

@@ -75,7 +75,10 @@ class ElasticManager:
 
     def _heartbeat_loop(self):
         while not self._stop.is_set():
-            self._kv.put(self._prefix + self._me, str(time.time()))
+            try:
+                self._kv.put(self._prefix + self._me, str(time.time()))
+            except Exception:
+                pass  # transient master hiccup; next beat retries
             self._stop.wait(self._interval)
 
     def _live_peers(self) -> List[str]:
@@ -93,7 +96,11 @@ class ElasticManager:
 
     def _watch_loop(self):
         while not self._stop.is_set():
-            peers = self._live_peers()
+            try:
+                peers = self._live_peers()
+            except Exception:
+                self._stop.wait(self._interval)
+                continue  # never let a transient error kill the watcher
             if self._last_peers is None:
                 self._last_peers = peers
             elif peers != self._last_peers:

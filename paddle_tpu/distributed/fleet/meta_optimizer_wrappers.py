@@ -133,8 +133,15 @@ class LocalSGDOptimizer(_Wrapper):
             return  # replicated single-controller params are already equal
         from jax.experimental import multihost_utils
         for p in self._inner_opt._parameter_list:
-            mean = multihost_utils.process_allgather(p.value()).mean(axis=0)
-            p._data = jnp.asarray(mean)
+            orig_sharding = getattr(p.value(), "sharding", None)
+            mean = jnp.asarray(
+                multihost_utils.process_allgather(p.value()).mean(axis=0))
+            if orig_sharding is not None:
+                # keep the original placement: a default-device array here
+                # would silently recompile every downstream executable
+                mean = jax.device_put(mean, orig_sharding)
+            p._data = mean
+            p._version += 1  # in-place semantics for autograd version guards
 
 
 class LarsMomentumOptimizer(_Wrapper):

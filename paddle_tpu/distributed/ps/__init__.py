@@ -1,0 +1,177 @@
+"""Parameter-server runtime (recsys sparse embeddings).
+
+Reference analog: paddle/fluid/distributed/ps/** — brpc PS services with
+memory_sparse_table / memory_dense_table, async pull/push communicators, and
+the fleet PS mode (SURVEY.md §2.4 L7).
+
+TPU-native shape: the dense model trains on TPU as usual; the PS serves the
+HUGE sparse embedding tables that don't fit HBM. Tables live on host
+(hash-bucketed numpy rows with lazy init + SGD/adagrad apply), and transport
+rides the native TCPStore (core/native/tcp_store.cpp) instead of brpc — pull
+packs row ids, push packs gradients, both as binary blobs. One server process
+per PS rank; clients are trainer processes.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..tcp_store import TCPStore
+
+__all__ = ["SparseTable", "PSServer", "PSClient"]
+
+
+class SparseTable:
+    """Host sparse embedding table: rows materialize on first touch
+    (reference memory_sparse_table lazy init) and update with adagrad/sgd."""
+
+    def __init__(self, dim: int, initializer_std: float = 0.01,
+                 optimizer: str = "adagrad", lr: float = 0.05, seed: int = 0):
+        self.dim = dim
+        self.std = initializer_std
+        self.opt = optimizer
+        self.lr = lr
+        self._rows: Dict[int, np.ndarray] = {}
+        self._g2: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._mu = threading.Lock()
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._mu:
+            for i, rid in enumerate(ids):
+                row = self._rows.get(int(rid))
+                if row is None:
+                    row = self._rng.normal(
+                        0, self.std, self.dim).astype(np.float32)
+                    self._rows[int(rid)] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: Sequence[int], grads: np.ndarray):
+        with self._mu:
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    continue
+                if self.opt == "adagrad":
+                    acc = self._g2.setdefault(
+                        rid, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                else:  # sgd
+                    row -= self.lr * g
+
+    def size(self) -> int:
+        with self._mu:
+            return len(self._rows)
+
+    def state_dict(self) -> dict:
+        with self._mu:
+            return {"dim": self.dim, "rows": dict(self._rows),
+                    "g2": dict(self._g2)}
+
+    def load_state_dict(self, state: dict):
+        with self._mu:
+            self._rows = dict(state["rows"])
+            self._g2 = dict(state.get("g2", {}))
+
+
+class PSServer:
+    """Serves tables over the TCPStore transport.
+
+    Message protocol (store keys, request/response pairs):
+      req :  ps/req/<client>/<seq>   = pickle (op, table, payload)
+      resp:  ps/resp/<client>/<seq>  = pickle result
+    A server thread polls a shared request counter — simple, ordered, and
+    entirely on the native store's blocking WAIT (no Python busy loop)."""
+
+    def __init__(self, tables: Dict[str, SparseTable], port: int = 0):
+        self._tables = tables
+        self._store = TCPStore("127.0.0.1", port, is_master=True)
+        self.port = self._store.port
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        # publish order: a client writes its request under its OWN key FIRST,
+        # then enqueues that key at ps/queue/<n> — so every queue slot the
+        # server sees is guaranteed to have its payload (a crashed client can
+        # never wedge the sequence)
+        seq = 0
+        while not self._stop.is_set():
+            slot = f"ps/queue/{seq}"
+            try:
+                self._store.wait([slot], timeout=0.5)
+            except TimeoutError:
+                continue
+            except Exception:
+                return
+            req_key = self._store.get(slot).decode()
+            blob = self._store.get(req_key)
+            op, table, payload = pickle.loads(blob)
+            t = self._tables[table]
+            if op == "pull":
+                result = t.pull(payload)
+            elif op == "push":
+                ids, grads = payload
+                t.push(ids, grads)
+                result = True
+            elif op == "size":
+                result = t.size()
+            elif op == "save":
+                result = t.state_dict()
+            else:
+                result = None
+            self._store.set(req_key + "/resp", pickle.dumps(result))
+            self._store.delete_key(req_key)
+            self._store.delete_key(slot)
+            seq += 1
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class PSClient:
+    """Trainer-side handle: pull embeddings before forward, push grads after
+    backward (reference fleet PS async pull/push)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import uuid
+        self._store = TCPStore(host, port)
+        self._lock = threading.Lock()
+        self._cid = uuid.uuid4().hex[:12]
+        self._n = 0
+
+    def _call(self, op: str, table: str, payload):
+        with self._lock:
+            req_key = f"ps/req/{self._cid}/{self._n}"
+            self._n += 1
+            # payload FIRST, then publish — see PSServer._serve
+            self._store.set(req_key, pickle.dumps((op, table, payload)))
+            slot = self._store.add("ps/seq", 1) - 1
+            self._store.set(f"ps/queue/{slot}", req_key)
+            self._store.wait([req_key + "/resp"], timeout=60)
+            blob = self._store.get(req_key + "/resp")
+            self._store.delete_key(req_key + "/resp")
+        return pickle.loads(blob)
+
+    def pull_sparse(self, table: str, ids: Sequence[int]) -> np.ndarray:
+        return self._call("pull", table, [int(i) for i in ids])
+
+    def push_sparse(self, table: str, ids: Sequence[int], grads: np.ndarray):
+        return self._call("push", table,
+                          ([int(i) for i in ids], np.asarray(grads,
+                                                             np.float32)))
+
+    def table_size(self, table: str) -> int:
+        return self._call("size", table, None)
+
+    def save_table(self, table: str) -> dict:
+        return self._call("save", table, None)

@@ -1,0 +1,89 @@
+"""paddle.geometric — graph message passing.
+
+Reference analog: python/paddle/geometric (send_u_recv / send_ue_recv /
+segment_* over the graph_send_recv kernels). TPU-native lowering:
+jax.ops.segment_sum/max/min — XLA turns these into sorted-segment reductions,
+the same dataflow the reference's CUDA kernels implement by atomics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+
+def _val(x):
+    return x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _seg(values, ids, num, how):
+    ids = _val(ids).astype(jnp.int32)
+    v = _val(values)
+    if how == "sum" or how == "mean":
+        out = jax.ops.segment_sum(v, ids, num_segments=num)
+        if how == "mean":
+            cnt = jax.ops.segment_sum(jnp.ones_like(ids, v.dtype), ids,
+                                      num_segments=num)
+            shape = (num,) + (1,) * (v.ndim - 1)
+            out = out / jnp.maximum(cnt, 1).reshape(shape)
+        return out
+    if how == "max":
+        return jax.ops.segment_max(v, ids, num_segments=num)
+    if how == "min":
+        return jax.ops.segment_min(v, ids, num_segments=num)
+    raise ValueError(how)
+
+
+def segment_sum(data, segment_ids, name=None):
+    num = int(_val(segment_ids).max()) + 1
+    return Tensor(_seg(data, segment_ids, num, "sum"))
+
+
+def segment_mean(data, segment_ids, name=None):
+    num = int(_val(segment_ids).max()) + 1
+    return Tensor(_seg(data, segment_ids, num, "mean"))
+
+
+def segment_max(data, segment_ids, name=None):
+    num = int(_val(segment_ids).max()) + 1
+    return Tensor(_seg(data, segment_ids, num, "max"))
+
+
+def segment_min(data, segment_ids, name=None):
+    num = int(_val(segment_ids).max()) + 1
+    return Tensor(_seg(data, segment_ids, num, "min"))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather features at src, reduce onto dst (reference send_u_recv)."""
+    xv = _val(x)
+    src = _val(src_index).astype(jnp.int32)
+    dst = _val(dst_index).astype(jnp.int32)
+    num = int(out_size) if out_size is not None else xv.shape[0]
+    return Tensor(_seg(xv[src], dst, num, reduce_op))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Node⊕edge messages reduced onto dst (reference send_ue_recv)."""
+    xv, yv = _val(x), _val(y)
+    src = _val(src_index).astype(jnp.int32)
+    dst = _val(dst_index).astype(jnp.int32)
+    msg = xv[src]
+    if message_op == "add":
+        msg = msg + yv
+    elif message_op == "mul":
+        msg = msg * yv
+    elif message_op == "sub":
+        msg = msg - yv
+    elif message_op == "div":
+        msg = msg / yv
+    else:
+        raise ValueError(message_op)
+    num = int(out_size) if out_size is not None else xv.shape[0]
+    return Tensor(_seg(msg, dst, num, reduce_op))

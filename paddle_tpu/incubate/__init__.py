@@ -1,1 +1,2 @@
 """incubate namespace (reference: python/paddle/incubate)."""
+from . import nn  # noqa: F401

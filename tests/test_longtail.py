@@ -341,7 +341,11 @@ def test_elastic_manager_detects_scale_change(tmp_path):
         while (not changes or changes[-1] != ["hostA:1"]) \
                 and __import__("time").time() < deadline:
             __import__("time").sleep(0.1)
-        assert changes and changes[-1] == ["hostA:1"], changes
+        raw = KVClient(ep).get_prefix("/jobE/elastic/")
+        assert changes and changes[-1] == ["hostA:1"], (
+            changes, m1.peers(), raw,
+            [t.is_alive() for t in m1._threads],
+            [t.is_alive() for t in m2._threads])
         from paddle_tpu.distributed.fleet.elastic import ElasticStatus
         assert m1.status == ElasticStatus.RESTART
         m1.exit()
