@@ -64,6 +64,7 @@ from . import audio  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -27,14 +27,17 @@ from .flags import flag
 
 
 class OpDef:
-    __slots__ = ("name", "fwd", "bwd", "nondiff_inputs")
+    __slots__ = ("name", "fwd", "bwd", "nondiff_inputs", "no_jit")
 
     def __init__(self, name: str, fwd: Callable, bwd: Optional[Callable] = None,
-                 nondiff_inputs: Sequence[int] = ()):
+                 nondiff_inputs: Sequence[int] = (), no_jit: bool = False):
         self.name = name
         self.fwd = fwd
         self.bwd = bwd  # explicit backward: bwd(primals, outs, cotangents, **attrs) -> grads tuple
         self.nondiff_inputs = frozenset(nondiff_inputs)
+        # no_jit: execute fwd directly in eager (host ops that cannot live
+        # inside an XLA executable, e.g. cpp_extension custom kernels)
+        self.no_jit = no_jit
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -54,8 +57,8 @@ _VJP_NAMES: Dict[Tuple, str] = {}
 
 
 def register_op(name: str, fwd: Callable, bwd: Optional[Callable] = None,
-                nondiff_inputs: Sequence[int] = ()) -> OpDef:
-    op = OpDef(name, fwd, bwd, nondiff_inputs)
+                nondiff_inputs: Sequence[int] = (), no_jit: bool = False) -> OpDef:
+    op = OpDef(name, fwd, bwd, nondiff_inputs, no_jit)
     _REGISTRY[name] = op
     return op
 
@@ -257,9 +260,10 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
 
     hook = _PROFILER_HOOK
     t0 = _time.perf_counter() if hook is not None else 0.0
-    if in_trace():
+    if in_trace() or op.no_jit:
         # Inside a to_static trace: call the raw function so everything inlines into the
         # enclosing jit; no per-op executables, no autograd tape (grad via whole-graph vjp).
+        # no_jit ops (host kernels) also run raw: they cannot live in an executable.
         outs = op.fwd(*arrays, **attrs)
     else:
         outs = _fwd_exec(name, key)(*arrays)

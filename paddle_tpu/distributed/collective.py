@@ -67,11 +67,20 @@ def _unwrap(x):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted(op_key, mesh, axes, op=ReduceOp.SUM):
+def _jitted(op_key, mesh, axes, op=ReduceOp.SUM, nranks=None):
     spec_in = lambda nd: NamedSharding(mesh, P(axes[0] if len(axes) == 1
                                                else tuple(axes),
                                                *([None] * (nd - 1))))
-    if op_key == "all_reduce":
+    if op_key == "shard_reduce":
+        # global array sharded over the group axes on dim 0: reduce shards
+        def fn(x):
+            y = x.reshape((nranks, x.shape[0] // nranks) + x.shape[1:])
+            red = _REDUCERS.get(op, jnp.sum)(y, axis=0)
+            if op == ReduceOp.AVG:
+                red = jnp.sum(y, axis=0) / nranks
+            return jax.lax.with_sharding_constraint(
+                red, NamedSharding(mesh, P(*([None] * (x.ndim)))))
+    elif op_key == "all_reduce":
         def fn(x):
             red = _REDUCERS.get(op, jnp.sum)
             y = red(x, axis=0, keepdims=True)
@@ -108,12 +117,38 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
     if g.nranks <= 1:
         return tensor
     if x.shape[0] != g.nranks:
-        raise ValueError(
-            f"all_reduce expects the rank-stack layout [nranks={g.nranks}, ...]; "
-            f"got shape {tuple(x.shape)}. For sharded-model gradients use the "
-            f"compiled path (shardings on the train step).")
-    x = _place_on_group(x, g)
-    out = _jitted("all_reduce", g.mesh, g.axis_names, op)(x)
+        # second accepted form: a GLOBAL array whose dim 0 is sharded EXACTLY
+        # by the group's axes (group-axis order) — each rank's shard is its
+        # "local tensor", and all_reduce reduces the shards elementwise (what
+        # a ported per-process script means). Any other/mixed dim-0 sharding
+        # would reshape into the wrong rank blocks, so it is rejected.
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        d0 = None
+        if spec is not None and len(tuple(spec)) >= 1:
+            d0 = tuple(spec)[0]
+        d0_t = tuple(d0) if isinstance(d0, tuple) else (d0,)
+        # compare only non-singleton axes (size-1 axes don't partition), in
+        # group-major order — a mismatch would reshape wrong rank blocks
+        def nontrivial(axes):
+            return tuple(a for a in axes
+                         if a is not None and g.mesh.shape.get(a, 1) > 1)
+        group_t = nontrivial(g.axis_names)
+        ok = (nontrivial(d0_t) == group_t
+              and all(a in g.axis_names for a in d0_t if a is not None))
+        if ok and x.shape[0] % g.nranks == 0:
+            out = _jitted("shard_reduce", g.mesh, g.axis_names, op,
+                          nranks=g.nranks)(x)
+        else:
+            raise ValueError(
+                f"all_reduce expects the rank-stack layout "
+                f"[nranks={g.nranks}, ...] or a global array whose dim 0 is "
+                f"sharded exactly by the group axes {group_t}; got shape "
+                f"{tuple(x.shape)} with sharding {spec}. For sharded-model "
+                f"gradients use the compiled path (shardings on the train "
+                f"step).")
+    else:
+        x = _place_on_group(x, g)
+        out = _jitted("all_reduce", g.mesh, g.axis_names, op)(x)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor

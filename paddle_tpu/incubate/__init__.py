@@ -1,2 +1,3 @@
 """incubate namespace (reference: python/paddle/incubate)."""
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401

@@ -1,0 +1,113 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference analog: python/paddle/incubate/asp (prune_model computes 2:4 masks
+per supported weight, decorate(optimizer) re-applies masks after each step so
+pruned slots stay zero through training; sparse tensor cores consume the
+pattern on GPU). On TPU the pattern is consumed by XLA as plain zeros (density
+reduction is real; the 2:4 hardware path is N/A), and the mask-maintenance
+semantics are identical.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["prune_model", "decorate", "calculate_density", "ASPHelper"]
+
+# id -> (weakref to the param, mask). The weakref guards against id recycling:
+# a dead or different referent means the entry is stale, never applied.
+_MASKS: Dict[int, tuple] = {}
+
+
+def _mask_for(p) -> Optional[np.ndarray]:
+    ent = _MASKS.get(id(p))
+    if ent is None:
+        return None
+    ref, mask = ent
+    if ref() is not p:
+        _MASKS.pop(id(p), None)   # recycled id: purge the stale entry
+        return None
+    return mask
+
+
+def _mask_2_4(w: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-magnitude entries of every 4 along the last dim."""
+    orig = w.shape
+    pad = (-orig[-1]) % 4
+    flat = np.abs(w).reshape(-1, orig[-1])
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, 4)
+    order = np.argsort(groups, axis=-1)            # ascending
+    mask = np.ones_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[..., :2], False, axis=-1)  # drop 2 smallest
+    mask = mask.reshape(flat.shape[0], -1)
+    if pad:
+        mask = mask[:, :orig[-1]]
+    return mask.reshape(orig)
+
+
+def _supported(name: str, p) -> bool:
+    return p.ndim == 2 and p.shape[-1] >= 4 and "bias" not in name
+
+
+@no_grad()
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> Dict[str, np.ndarray]:
+    """Compute + apply 2:4 masks to every supported weight (reference
+    prune_model); returns {param_name: mask}."""
+    assert (n, m) == (2, 4), "only 2:4 structured sparsity is supported"
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _supported(name, p):
+            continue
+        w = p.numpy()
+        mask = _mask_2_4(w)
+        p.set_value((w * mask).astype(w.dtype))
+        _MASKS[id(p)] = (weakref.ref(p), mask)
+        masks[name] = mask
+    return masks
+
+
+def calculate_density(t) -> float:
+    arr = t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+    return float((arr != 0).mean())
+
+
+class _ASPOptimizer:
+    """Re-applies masks after every step (reference OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        r = self._inner_opt.step()
+        with no_grad():
+            for p in self._inner_opt._parameter_list:
+                mask = _mask_for(p)
+                if mask is not None:
+                    w = p.numpy()
+                    p.set_value((w * mask).astype(w.dtype))
+        return r
+
+    def clear_grad(self, set_to_zero=False):
+        return self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+
+def decorate(optimizer) -> _ASPOptimizer:
+    return _ASPOptimizer(optimizer)
+
+
+class ASPHelper:
+    prune_model = staticmethod(prune_model)
+    decorate = staticmethod(decorate)
+    calculate_density = staticmethod(calculate_density)

@@ -1,0 +1,229 @@
+"""LLaMA decoder family — RoPE + RMSNorm + SwiGLU + grouped-query attention.
+
+SURVEY.md §6 stretch target (LLaMA-7B TP+PP). Built on the same substrate as
+GPT: paddle_tpu.nn layers for eager/tape, the Pallas flash kernel where
+eligible, the fused lm_head_ce loss, and TP via NamedSharding re-placement of
+the q/k/v/o and gate/up/down projections (shard_llama_tp below).
+
+Reference analogs for the building blocks: nn.RMSNorm surface
+(python/paddle/nn — added post-snapshot upstream; here a first-class layer),
+fused rotary embedding (incubate fused ops family).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from .. import ops
+from ..ops._helpers import _op
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
+           "llama_7b", "shard_llama_tp"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 0          # 0 -> = num_heads (MHA); < heads -> GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if self.num_kv_heads == 0:
+            self.num_kv_heads = self.num_heads
+
+
+def llama_7b(**overrides) -> LlamaConfig:
+    cfg = dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+               num_layers=32, num_heads=32)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+def llama_tiny(**overrides) -> LlamaConfig:
+    cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+               num_layers=2, num_heads=4, num_kv_heads=2,
+               max_position_embeddings=128)
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+def _rope_fwd(q, k, *, theta=10000.0):
+    """Rotary embedding applied to q,k [B,S,H,D] (interleaved-pair form)."""
+    B, S, H, D = q.shape
+    pos = jnp.arange(S, dtype=jnp.float32)
+    inv = theta ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
+    ang = pos[:, None] * inv[None, :]                      # [S, D/2]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x1 * sin + x2 * cos
+        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+
+    return rot(q.astype(jnp.float32)).astype(q.dtype), \
+        rot(k.astype(jnp.float32)).astype(k.dtype)
+
+
+from ..core.dispatch import register_op  # noqa: E402
+
+register_op("rope", _rope_fwd)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        H = config.hidden_size
+        self.num_heads = config.num_heads
+        self.num_kv = config.num_kv_heads
+        self.head_dim = H // config.num_heads
+        self.theta = config.rope_theta
+        self.use_flash = config.use_flash_attention
+        self.q_proj = nn.Linear(H, H, bias_attr=False)
+        self.k_proj = nn.Linear(H, self.num_kv * self.head_dim,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(H, self.num_kv * self.head_dim,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(H, H, bias_attr=False)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv, self.head_dim])
+        q, k = _op("rope", q, k, theta=self.theta)
+        if self.num_kv != self.num_heads:
+            rep = self.num_heads // self.num_kv
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        from ..nn.functional.attention import flash_path_available
+        if self.use_flash and flash_path_available(s, self.head_dim, x):
+            out = F.flash_attention(q, k, v, causal=True,
+                                    training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 training=self.training)
+        return self.o_proj(out.reshape([b, s, h]))
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        H, I = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(H, I, bias_attr=False)
+        self.up_proj = nn.Linear(H, I, bias_attr=False)
+        self.down_proj = nn.Linear(I, H, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+        self._init_weights(config)
+
+    def _init_weights(self, config):
+        std = config.initializer_range
+        normal = nn.initializer.Normal(mean=0.0, std=std)
+        resid = nn.initializer.Normal(
+            mean=0.0, std=std / math.sqrt(2.0 * config.num_layers))
+        for name, p in self.named_parameters():
+            if p.ndim >= 2:
+                init = (resid if name.endswith(("o_proj.weight",
+                                                "down_proj.weight"))
+                        else normal)
+                p.set_value(init(tuple(p.shape), p.dtype))
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for block in self.layers:
+            x = block(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.model(input_ids)
+        if labels is not None:
+            tied = self.lm_head is None
+            w = self.model.embed_tokens.weight if tied else self.lm_head.weight
+            loss = _op("lm_head_ce", hidden[:, :-1, :], w, labels[:, 1:],
+                       transpose_w=tied)
+            return None, loss
+        if self.lm_head is None:
+            return ops.matmul(hidden, self.model.embed_tokens.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
+
+def shard_llama_tp(model: LlamaForCausalLM, mesh=None, axis: str = "model"):
+    """Tensor-parallel placement: column-shard q/k/v/gate/up, row-shard
+    o/down, vocab-shard the embedding (the Fleet mp_layers recipe as
+    NamedShardings — XLA inserts the TP collectives)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..distributed.env import get_mesh
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return model
+    col = NamedSharding(mesh, P(None, axis))
+    row = NamedSharding(mesh, P(axis, None))
+    for name, p in model.named_parameters():
+        if name.endswith(("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                          "gate_proj.weight", "up_proj.weight")):
+            p._data = jax.device_put(p.value(), col)
+        elif name.endswith(("o_proj.weight", "down_proj.weight")):
+            p._data = jax.device_put(p.value(), row)
+        elif name.endswith("embed_tokens.weight"):
+            p._data = jax.device_put(p.value(), row)
+        elif name.endswith("lm_head.weight"):
+            p._data = jax.device_put(p.value(), col)
+    return model

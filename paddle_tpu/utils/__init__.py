@@ -1,0 +1,2 @@
+"""paddle.utils (reference python/paddle/utils)."""
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,130 @@
+"""Custom C++ op extension.
+
+Reference analog: python/paddle/utils/cpp_extension (CppExtension / load —
+JIT-compile user C++ against the custom-op registry,
+framework/custom_operator.cc). There, user kernels register into PHI and run
+on device; here the TPU compute path is XLA, so custom C++ runs as a HOST
+op: the user writes a C function over raw buffers, `load()` compiles it with
+the native build harness, and the op enters the dispatcher via
+jax.pure_callback — tape autograd, jit embedding and vmap come for free (a
+host round-trip per call; custom DEVICE kernels belong in Pallas instead).
+
+User C ABI (one function per op — unary elementwise over float32):
+    extern "C" void <name>(const float* in, float* out, int64_t n);
+(multi-input/attr-carrying signatures are future work; for device-side custom
+kernels write Pallas instead.)
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..ops._helpers import _op
+
+__all__ = ["load", "CppExtension"]
+
+_BUILD_DIR = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+def _compile(name: str, sources: Sequence[str],
+             extra_cxx_flags: Sequence[str] = ()) -> ctypes.CDLL:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    blobs = []
+    for src in sources:
+        if os.path.exists(src):
+            with open(src) as f:
+                blobs.append(f.read())
+        else:
+            blobs.append(src)  # inline source string
+    digest = hashlib.sha256("\n".join(blobs).encode()).hexdigest()[:16]
+    out = os.path.join(_BUILD_DIR, f"{name}_{digest}.so")
+    if not os.path.exists(out):
+        src_path = os.path.join(_BUILD_DIR, f"{name}_{digest}.cpp")
+        with open(src_path, "w") as f:
+            f.write("\n".join(blobs))
+        tmp = f"{out}.tmp.{os.getpid()}"   # unique: fleet workers build in parallel
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               *extra_cxx_flags, src_path, "-o", tmp]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"cpp_extension build of {name} failed:\n"
+                               f"{proc.stderr[-2000:]}")
+        os.replace(tmp, out)
+    return ctypes.CDLL(out)
+
+
+def load(name: str, sources: Sequence[str], functions: Sequence[str] = None,
+         extra_cxx_flags: Sequence[str] = (), verbose: bool = False):
+    """Compile + register custom ops; returns a module-like namespace whose
+    attributes are the op entry points (reference cpp_extension.load)."""
+    lib = _compile(name, sources, extra_cxx_flags)
+    functions = list(functions or [name])
+    ns = type(f"{name}_ops", (), {})()
+    for fn_name in functions:
+        setattr(ns, fn_name, _bind_unary(lib, fn_name))
+    return ns
+
+
+def _bind_unary(lib: ctypes.CDLL, fn_name: str) -> Callable:
+    cfn = getattr(lib, fn_name)
+    cfn.restype = None
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host_kernel(x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        out = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(x.size))
+        return out
+
+    op_name = f"custom::{fn_name}"
+
+    def fwd(x):
+        if not isinstance(x, jax.core.Tracer):
+            # eager: run the C kernel directly on host memory (concrete array
+            # round-trips through numpy; works on every backend including
+            # PJRT plugins without host-callback support)
+            return jnp.asarray(host_kernel(np.asarray(x)))
+        # traced (jit/to_static): embed as a host computation. Backends
+        # without send/recv callbacks (e.g. the axon tunnel) reject this —
+        # custom host ops are eager-only there; device kernels belong in
+        # Pallas.
+        return jax.pure_callback(
+            host_kernel, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+            x.astype(jnp.float32), vmap_method="sequential")
+
+    register_op(op_name, fwd, no_jit=True)
+
+    def api(x, name=None):
+        return _op(op_name, x)
+
+    api.__name__ = fn_name
+    api.__doc__ = f"Custom C++ op '{fn_name}' (host kernel via cpp_extension)."
+    return api
+
+
+class CppExtension:
+    """Build-spec holder for setuptools-style usage (reference CppExtension)."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args: Sequence[str] = ()):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args)
+
+    def load(self, name: Optional[str] = None, functions=None):
+        return load(name or self.name or "custom", self.sources,
+                    functions=functions,
+                    extra_cxx_flags=self.extra_compile_args)

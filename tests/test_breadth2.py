@@ -109,3 +109,34 @@ def test_vision_resize_bilinear_quality():
     u8 = (np.random.RandomState(0).rand(10, 10, 3) * 255).astype("uint8")
     out = Resize((4, 4))(u8)
     assert out.dtype == np.uint8 and out.max() <= 255
+
+
+def test_asp_2_4_sparsity_maintained_through_training():
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    masks = asp.prune_model(net)
+    assert masks, "no weights pruned"
+    w = net[0].weight.numpy()
+    # exactly 2 of every 4 along the last dim are zero
+    groups = w.reshape(-1, w.shape[-1] // 4, 4)
+    nz = (groups != 0).sum(-1)
+    assert (nz == 2).all()
+    assert abs(asp.calculate_density(net[0].weight) - 0.5) < 1e-6
+
+    opt = asp.decorate(paddle.optimizer.Adam(learning_rate=1e-2,
+                                             parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16)
+                         .astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # the 2:4 pattern survived the optimizer updates
+    assert abs(asp.calculate_density(net[0].weight) - 0.5) < 1e-6

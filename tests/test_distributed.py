@@ -581,3 +581,23 @@ def test_ring_attention_composes_with_tp():
     want = _plain_causal(q, k, v, 1.0 / np.sqrt(D))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_all_reduce_accepts_sharded_global_array():
+    """Beyond the rank-stack form: a global array sharded over the group axis
+    reduces its per-rank shards (ported per-process semantics)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.env import get_mesh
+    mesh = get_mesh()
+
+    x_np = np.arange(16, dtype="float32").reshape(16, 1)
+    x = paddle.to_tensor(x_np)
+    x._data = jax.device_put(x.value(), NamedSharding(mesh, PS("data", None)))
+    out = dist.all_reduce(x)
+    want = x_np.reshape(8, 2, 1).sum(axis=0)
+    np.testing.assert_allclose(out.numpy(), want)

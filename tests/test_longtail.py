@@ -334,6 +334,13 @@ def test_elastic_manager_detects_scale_change(tmp_path):
         m2.register()
         assert m1.wait_for_world(timeout=10)
         assert sorted(m1.peers()) == ["hostA:1", "hostB:1"]
+        # the WATCHER must have observed both peers before the departure —
+        # a depart of a never-seen peer is (correctly) not a change
+        deadline = __import__("time").time() + 10
+        while m1._last_peers != ["hostA:1", "hostB:1"] \
+                and __import__("time").time() < deadline:
+            __import__("time").sleep(0.05)
+        assert m1._last_peers == ["hostA:1", "hostB:1"], m1._last_peers
 
         # scale-in: hostB exits -> m1 sees the change
         m2.exit()

@@ -309,3 +309,79 @@ def test_gpt_scan_remat_policies_run():
         _, loss = model(ids, labels=paddle.to_tensor(ids.numpy().astype("int64")))
         loss.backward()
         assert np.isfinite(float(loss))
+
+
+def test_llama_trains_and_gqa():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(0)
+    cfg = llama_tiny()
+    assert cfg.num_kv_heads == 2 and cfg.num_heads == 4  # GQA config
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
+                                 parameters=model.parameters(),
+                                 grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    ids = paddle.to_tensor(np.random.RandomState(0)
+                           .randint(0, cfg.vocab_size, (2, 32)).astype("int32"))
+    labels = paddle.to_tensor(ids.numpy().astype("int64"))
+    step = paddle.jit.TrainStep(model, opt)
+    losses = [float(step(ids, labels)) for _ in range(6)]
+    assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
+
+    model.eval()
+    logits = model(ids)
+    assert tuple(logits.shape) == (2, 32, cfg.vocab_size)
+
+
+def test_llama_rope_properties():
+    """RoPE must preserve norms and make attention depend on relative
+    positions (shift equivariance of q·k)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import _rope_fwd
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 8, 2, 16), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 8, 2, 16), jnp.float32)
+    qr, kr = _rope_fwd(q, k)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(qr), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1),
+                               rtol=1e-5)
+    # relative-position property: <rope(q)_i, rope(k)_j> depends on i-j only
+    def score(qv, kv, i, j):
+        qq = jnp.tile(qv[None], (8, 1))[None, :, None, :]
+        kk = jnp.tile(kv[None], (8, 1))[None, :, None, :]
+        qr2, kr2 = _rope_fwd(qq, kk)
+        return float(jnp.dot(qr2[0, i, 0], kr2[0, j, 0]))
+
+    qv, kv = q[0, 0, 0], k[0, 0, 0]
+    np.testing.assert_allclose(score(qv, kv, 2, 5), score(qv, kv, 1, 4),
+                               rtol=1e-4)
+    np.testing.assert_allclose(score(qv, kv, 5, 2), score(qv, kv, 4, 1),
+                               rtol=1e-4)
+
+
+def test_llama_tp_sharding():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny, shard_llama_tp
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    dense_logits = None
+    ids = paddle.to_tensor(np.random.RandomState(1)
+                           .randint(0, 256, (2, 16)).astype("int32"))
+    model.eval()
+    dense_logits = model(ids).numpy()
+
+    shard_llama_tp(model)
+    assert "model" in str(model.model.layers[0].self_attn.q_proj.weight
+                          .value().sharding.spec)
+    tp_logits = model(ids).numpy()
+    np.testing.assert_allclose(dense_logits, tp_logits, rtol=2e-4, atol=2e-4)

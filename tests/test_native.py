@@ -108,3 +108,36 @@ def test_device_host_memory_stats_surface():
     import paddle_tpu as paddle
     st = paddle.device.host_memory_stats()
     assert set(st) == {"allocated", "reserved", "peak_allocated", "chunks"}
+
+
+def test_cpp_extension_custom_op():
+    """User C++ op: compiled by the extension harness, runs under the
+    dispatcher with autograd (generic vjp over the host callback is not
+    differentiable — custom ops are forward-only unless a bwd is given,
+    same as reference custom ops without a grad kernel)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import cpp_extension
+
+    src = r"""
+    #include <cstdint>
+    extern "C" void leaky_step(const float* in, float* out, int64_t n) {
+      for (int64_t i = 0; i < n; ++i)
+        out[i] = in[i] > 0.f ? in[i] : 0.1f * in[i];
+    }
+    """
+    ops = cpp_extension.load("demo_ext", [src], functions=["leaky_step"])
+    x = paddle.to_tensor(np.array([-2.0, 3.0, -0.5], "float32"))
+    y = ops.leaky_step(x)
+    np.testing.assert_allclose(y.numpy(), [-0.2, 3.0, -0.05], rtol=1e-6)
+
+    # rebuild cache: loading again reuses the compiled artifact
+    ops2 = cpp_extension.load("demo_ext", [src], functions=["leaky_step"])
+    np.testing.assert_allclose(ops2.leaky_step(x).numpy(), y.numpy())
+
+    # works under jit/to_static too (host computation embedded in the program)
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import get_op
+    fwd = get_op("custom::leaky_step").fwd
+    out = jax.jit(fwd)(jnp.asarray([-1.0, 2.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [-0.1, 2.0], rtol=1e-6)
