@@ -19,9 +19,10 @@ from typing import Optional
 from ....core.tensor import Tensor
 from ....nn.layer import Layer
 from .pp_layers import PipelineLayer
+from .wrappers import InnerLayerDelegate
 
 
-class PipelineParallel(Layer):
+class PipelineParallel(InnerLayerDelegate, Layer):
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
         if not isinstance(layers, PipelineLayer):
@@ -106,14 +107,3 @@ class PipelineParallel(Layer):
         return out
 
     # parity surface
-    def state_dict(self, *a, **k):
-        return self._layers.state_dict(*a, **k)
-
-    def set_state_dict(self, sd, *a, **k):
-        return self._layers.set_state_dict(sd, *a, **k)
-
-    def parameters(self, include_sublayers=True):
-        return self._layers.parameters(include_sublayers)
-
-    def named_parameters(self, prefix="", include_sublayers=True):
-        return self._layers.named_parameters(prefix, include_sublayers)

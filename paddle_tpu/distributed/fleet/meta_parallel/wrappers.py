@@ -14,7 +14,25 @@ from ....nn.layer import Layer
 from ...env import get_mesh
 
 
-class _MetaParallelBase(Layer):
+class InnerLayerDelegate:
+    """Mixin: forward the state/parameter surface to self._layers (shared by
+    every distributed wrapper — DataParallel-style facades, pipeline,
+    group-sharded; previously duplicated 4x)."""
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class _MetaParallelBase(InnerLayerDelegate, Layer):
     def __init__(self, layers: Layer, hcg, strategy=None):
         super().__init__()
         self._layers = layers
@@ -38,18 +56,6 @@ class _MetaParallelBase(Layer):
         spec = P("data", *([None] * (t.ndim - 1)))
         t._data = jax.device_put(t.value(), NamedSharding(mesh, spec))
         return t
-
-    def state_dict(self, *args, **kwargs):
-        return self._layers.state_dict(*args, **kwargs)
-
-    def set_state_dict(self, state_dict, *args, **kwargs):
-        return self._layers.set_state_dict(state_dict, *args, **kwargs)
-
-    def parameters(self, include_sublayers=True):
-        return self._layers.parameters(include_sublayers)
-
-    def named_parameters(self, prefix="", include_sublayers=True):
-        return self._layers.named_parameters(prefix, include_sublayers)
 
 
 class TensorParallel(_MetaParallelBase):

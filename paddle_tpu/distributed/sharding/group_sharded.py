@@ -25,9 +25,10 @@ from ...nn.layer import Layer
 from ..env import get_mesh
 from ..fleet.meta_optimizers import (DygraphShardingOptimizer, _existing_spec,
                                      _shard_spec_for)
+from ..fleet.meta_parallel.wrappers import InnerLayerDelegate
 
 
-class _GroupShardedModel(Layer):
+class _GroupShardedModel(InnerLayerDelegate, Layer):
     def __init__(self, layer: Layer, level: str, group=None, offload=False):
         super().__init__()
         self._layers = layer
@@ -60,17 +61,6 @@ class _GroupShardedModel(Layer):
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
-    def state_dict(self, *a, **k):
-        return self._layers.state_dict(*a, **k)
-
-    def set_state_dict(self, sd, *a, **k):
-        return self._layers.set_state_dict(sd, *a, **k)
-
-    def parameters(self, include_sublayers=True):
-        return self._layers.parameters(include_sublayers)
-
-    def named_parameters(self, prefix="", include_sublayers=True):
-        return self._layers.named_parameters(prefix, include_sublayers)
 
 
 class _ShardingStage2Optimizer(DygraphShardingOptimizer):

@@ -53,8 +53,19 @@ def _mask_2_4(w: np.ndarray) -> np.ndarray:
     return mask.reshape(orig)
 
 
-def _supported(name: str, p) -> bool:
-    return p.ndim == 2 and p.shape[-1] >= 4 and "bias" not in name
+def _prunable_params(model: Layer):
+    """Weights of Linear/Conv layers only (reference ASP's supported-layer
+    set) — embedding tables and norms must never be 2:4-pruned."""
+    from ..nn import Conv1D, Conv2D, Conv3D, Linear
+    seen = set()
+    for lname, layer in [("", model)] + list(model.named_sublayers()):
+        if not isinstance(layer, (Linear, Conv1D, Conv2D, Conv3D)):
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or id(w) in seen or w.ndim < 2 or w.shape[-1] < 4:
+            continue
+        seen.add(id(w))
+        yield (f"{lname}.weight" if lname else "weight"), w
 
 
 @no_grad()
@@ -64,13 +75,15 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
     prune_model); returns {param_name: mask}."""
     assert (n, m) == (2, 4), "only 2:4 structured sparsity is supported"
     masks = {}
-    for name, p in model.named_parameters():
-        if not _supported(name, p):
-            continue
+    for name, p in _prunable_params(model):
         w = p.numpy()
         mask = _mask_2_4(w)
         p.set_value((w * mask).astype(w.dtype))
-        _MASKS[id(p)] = (weakref.ref(p), mask)
+        import jax.numpy as jnp
+        key = id(p)
+        # weakref death callback purges the entry (no leak across models)
+        ref = weakref.ref(p, lambda _r, _k=key: _MASKS.pop(_k, None))
+        _MASKS[key] = (ref, jnp.asarray(mask))   # device mask: no host sync
         masks[name] = mask
     return masks
 
@@ -95,8 +108,9 @@ class _ASPOptimizer:
             for p in self._inner_opt._parameter_list:
                 mask = _mask_for(p)
                 if mask is not None:
-                    w = p.numpy()
-                    p.set_value((w * mask).astype(w.dtype))
+                    # one fused device multiply — no host round-trip per step
+                    p._data = p.value() * mask.astype(p.value().dtype)
+                    p._version += 1
         return r
 
     def clear_grad(self, set_to_zero=False):

@@ -190,6 +190,13 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+            # the head lives outside LlamaModel._init_weights' walk — apply
+            # the same Normal(initializer_range) scheme here
+            normal = nn.initializer.Normal(mean=0.0,
+                                           std=config.initializer_range)
+            self.lm_head.weight.set_value(
+                normal(tuple(self.lm_head.weight.shape),
+                       self.lm_head.weight.dtype))
 
     def forward(self, input_ids, labels=None):
         hidden = self.model(input_ids)

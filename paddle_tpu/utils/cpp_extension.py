@@ -46,12 +46,17 @@ def _compile(name: str, sources: Sequence[str],
                 blobs.append(f.read())
         else:
             blobs.append(src)  # inline source string
-    digest = hashlib.sha256("\n".join(blobs).encode()).hexdigest()[:16]
+    digest = hashlib.sha256(("\x00".join(blobs) + "\x01"
+                             + " ".join(extra_cxx_flags)).encode()
+                            ).hexdigest()[:16]
     out = os.path.join(_BUILD_DIR, f"{name}_{digest}.so")
     if not os.path.exists(out):
         src_path = os.path.join(_BUILD_DIR, f"{name}_{digest}.cpp")
-        with open(src_path, "w") as f:
+        src_tmp = f"{src_path}.tmp.{os.getpid()}"
+        with open(src_tmp, "w") as f:
             f.write("\n".join(blobs))
+        os.replace(src_tmp, src_path)   # atomic: parallel workers never read a
+        # truncated translation unit
         tmp = f"{out}.tmp.{os.getpid()}"   # unique: fleet workers build in parallel
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                *extra_cxx_flags, src_path, "-o", tmp]
@@ -71,11 +76,11 @@ def load(name: str, sources: Sequence[str], functions: Sequence[str] = None,
     functions = list(functions or [name])
     ns = type(f"{name}_ops", (), {})()
     for fn_name in functions:
-        setattr(ns, fn_name, _bind_unary(lib, fn_name))
+        setattr(ns, fn_name, _bind_unary(lib, fn_name, name))
     return ns
 
 
-def _bind_unary(lib: ctypes.CDLL, fn_name: str) -> Callable:
+def _bind_unary(lib: ctypes.CDLL, fn_name: str, ext_name: str) -> Callable:
     cfn = getattr(lib, fn_name)
     cfn.restype = None
     cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
@@ -89,7 +94,8 @@ def _bind_unary(lib: ctypes.CDLL, fn_name: str) -> Callable:
             ctypes.c_int64(x.size))
         return out
 
-    op_name = f"custom::{fn_name}"
+    # namespaced per extension: two extensions may export the same C symbol
+    op_name = f"custom::{ext_name}::{fn_name}"
 
     def fwd(x):
         if not isinstance(x, jax.core.Tracer):

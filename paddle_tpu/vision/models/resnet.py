@@ -27,7 +27,9 @@ class BasicBlock(nn.Layer):
         if norm_layer is None:
             norm_layer = nn.BatchNorm2D
         if dilation > 1:
-            raise NotImplementedError("Dilation > 1 not supported in BasicBlock")
+            raise NotImplementedError(
+                "BasicBlock is defined for dilation=1; use BottleneckBlock "
+                "for dilated variants")
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
                                bias_attr=False)
         self.bn1 = norm_layer(planes)

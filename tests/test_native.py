@@ -138,6 +138,6 @@ def test_cpp_extension_custom_op():
     import jax
     import jax.numpy as jnp
     from paddle_tpu.core.dispatch import get_op
-    fwd = get_op("custom::leaky_step").fwd
+    fwd = get_op("custom::demo_ext::leaky_step").fwd
     out = jax.jit(fwd)(jnp.asarray([-1.0, 2.0], jnp.float32))
     np.testing.assert_allclose(np.asarray(out), [-0.1, 2.0], rtol=1e-6)
