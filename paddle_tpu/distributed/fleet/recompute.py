@@ -31,12 +31,65 @@ def _flatten_tensors(obj, out):
             _flatten_tensors(o, out)
 
 
+def _recompute_traced(function, args, kwargs):
+    """jax.checkpoint over the region inside an active trace.
+
+    The function's INPUT tensors become the checkpoint arguments (their
+    residuals are what remat drops); parameters captured by closure are traced
+    as usual and recomputation re-reads them."""
+    import jax
+
+    in_tensors: list = []
+    _flatten_tensors((args, kwargs), in_tensors)
+    arrays = tuple(t.value() for t in in_tensors)
+
+    out_struct = {}
+
+    def pure(arrs):
+        saved = [t._data for t in in_tensors]
+        for t, a in zip(in_tensors, arrs):
+            t._data = a
+        try:
+            out = function(*args, **kwargs)
+            outs: list = []
+            _flatten_tensors(out, outs)
+            out_struct["single"] = isinstance(out, Tensor)
+            out_struct["template"] = out
+            return tuple(o.value() for o in outs)
+        finally:
+            for t, d in zip(in_tensors, saved):
+                t._data = d
+
+    out_arrays = jax.checkpoint(pure)(arrays)
+    if out_struct["single"]:
+        return Tensor(out_arrays[0])
+    # rebuild: replace each Tensor leaf of the template in order
+    it = iter(out_arrays)
+
+    def rebuild(obj):
+        if isinstance(obj, Tensor):
+            return Tensor(next(it))
+        if isinstance(obj, (list, tuple)):
+            built = [rebuild(o) for o in obj]
+            return type(obj)(built) if isinstance(obj, tuple) else built
+        if isinstance(obj, dict):
+            return {k: rebuild(v) for k, v in obj.items()}
+        return obj
+
+    return rebuild(out_struct["template"])
+
+
 def recompute(function, *args, preserve_rng_state: bool = True,
               use_reentrant: bool = True, **kwargs) -> Any:
     """paddle.distributed.fleet.utils.recompute parity."""
-    if dispatch.in_trace() or not dispatch.is_grad_enabled():
-        # traced: XLA remat handles it; no-grad: nothing to save anyway
-        return function(*args, **kwargs)
+    if dispatch.in_trace():
+        # under jit/TrainStep tracing, apply jax.checkpoint so the compiled
+        # program actually drops this region's residuals and recomputes them
+        # in backward (a pass-through here would silently lose the memory
+        # saving the user asked for)
+        return _recompute_traced(function, args, kwargs)
+    if not dispatch.is_grad_enabled():
+        return function(*args, **kwargs)  # nothing to save anyway
 
     in_tensors: list = []
     _flatten_tensors((args, kwargs), in_tensors)

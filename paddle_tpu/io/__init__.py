@@ -1,6 +1,6 @@
 from .dataset import (  # noqa: F401
     Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
-    ConcatDataset, Subset, random_split,
+    ConcatDataset, Subset, random_split, get_worker_info, WorkerInfo,
 )
 from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler, BatchSampler,

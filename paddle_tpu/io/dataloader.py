@@ -22,6 +22,11 @@ from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
+def counter_total(counter):
+    # the pool size isn't in the counter; workers read it from the fork state
+    return _FORK_STATE.get("num_workers", 0)
+
+
 # fork-inherited worker state (reference worker.py passes it over pipes; fork
 # makes the dataset visible for free and start cost O(1) in dataset size).
 # _FORK_LOCK serializes the assign→fork window so two concurrently-starting
@@ -35,6 +40,9 @@ def _worker_init(counter, init_fn, token):
         wid = counter.value
         counter.value += 1
     _FORK_STATE["worker_id"] = wid
+    from .dataset import WorkerInfo, _set_worker_info
+    _set_worker_info(WorkerInfo(wid, counter_total(counter),
+                                _FORK_STATE.get(token)))
     # re-key the fork-captured dataset so the parent can drop its entry while
     # respawned workers (after a child crash) still find it
     _FORK_STATE["dataset"] = _FORK_STATE[token]
@@ -192,6 +200,7 @@ class DataLoader:
         token = f"dataset_{id(self)}"
         with _FORK_LOCK:
             _FORK_STATE[token] = self.dataset
+            _FORK_STATE["num_workers"] = self.num_workers
             counter = ctx.Value("i", 0)
             try:
                 pool = ctx.Pool(self.num_workers, initializer=_worker_init,

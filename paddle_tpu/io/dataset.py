@@ -106,3 +106,27 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[offset:offset + n].tolist()))
         offset += n
     return out
+
+
+class WorkerInfo:
+    """Worker context for IterableDataset sharding (reference
+    fluid/dataloader/worker.py get_worker_info)."""
+
+    def __init__(self, id: int, num_workers: int, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: (id, num_workers) so an IterableDataset can
+    split its stream; None in the main process (reference get_worker_info)."""
+    return _worker_info
+
+
+def _set_worker_info(info):
+    global _worker_info
+    _worker_info = info

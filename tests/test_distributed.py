@@ -601,3 +601,34 @@ def test_all_reduce_accepts_sharded_global_array():
     out = dist.all_reduce(x)
     want = x_np.reshape(8, 2, 1).sum(axis=0)
     np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_recompute_under_trace_applies_remat():
+    """Under to_static/TrainStep tracing, recompute must lower to
+    jax.checkpoint (the compiled HLO recomputes the region in backward)
+    and keep numerics identical to the un-recomputed model."""
+    class Net(paddle.nn.Layer):
+        def __init__(self, use_rc):
+            super().__init__()
+            self.l1 = paddle.nn.Linear(8, 32)
+            self.l2 = paddle.nn.Linear(32, 8)
+            self.use_rc = use_rc
+
+        def forward(self, x):
+            def block(t):
+                return paddle.nn.functional.gelu(self.l1(t))
+            h = dist.recompute(block, x) if self.use_rc else block(x)
+            return (self.l2(h) ** 2).mean()
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+
+    def run(use_rc):
+        paddle.seed(0)
+        net = Net(use_rc)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, opt)
+        return [float(step(x)) for _ in range(3)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)

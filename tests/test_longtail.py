@@ -434,3 +434,27 @@ def test_checkpoint_rollback_save_survives_prune(tmp_path):
     # rollback: a LOWER step saved later must survive pruning
     ckpt.save_checkpoint(str(tmp_path), 50, model=net, keep=3)
     assert os.path.isdir(tmp_path / "step_50")
+
+
+def test_iterable_dataset_worker_info():
+    """get_worker_info lets an IterableDataset shard its stream per worker
+    (reference fluid/dataloader get_worker_info)."""
+    from paddle_tpu.io import IterableDataset, get_worker_info
+
+    assert get_worker_info() is None  # main process
+
+    class Stream(IterableDataset):
+        def __iter__(self):
+            info = get_worker_info()
+            lo, hi = 0, 16
+            if info is not None:   # split the range across workers
+                per = (hi - lo) // info.num_workers
+                lo = info.id * per
+                hi = lo + per
+            for i in range(lo, hi):
+                yield np.float32(i)
+
+    # single-process iterable loader sees the whole stream
+    loader = paddle.io.DataLoader(Stream(), batch_size=4)
+    got = np.concatenate([b.numpy() for b in loader])
+    np.testing.assert_array_equal(np.sort(got), np.arange(16, dtype="float32"))
