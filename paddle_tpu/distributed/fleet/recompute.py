@@ -36,7 +36,12 @@ def _recompute_traced(function, args, kwargs):
 
     The function's INPUT tensors become the checkpoint arguments (their
     residuals are what remat drops); parameters captured by closure are traced
-    as usual and recomputation re-reads them."""
+    as usual and recomputation re-reads them.
+
+    Stateful side effects inside the region (dropout RNG chain advances, BN
+    running-stat writes) are captured in a NESTED TraceContext and threaded
+    OUT of the checkpoint as extra outputs — otherwise a remat-scope tracer
+    would escape into the outer trace's buffer state (UnexpectedTracerError)."""
     import jax
 
     in_tensors: list = []
@@ -47,6 +52,8 @@ def _recompute_traced(function, args, kwargs):
 
     def pure(arrs):
         saved = [t._data for t in in_tensors]
+        inner_ctx = dispatch.TraceContext()
+        dispatch.push_trace(inner_ctx)
         for t, a in zip(in_tensors, arrs):
             t._data = a
         try:
@@ -55,16 +62,30 @@ def _recompute_traced(function, args, kwargs):
             _flatten_tensors(out, outs)
             out_struct["single"] = isinstance(out, Tensor)
             out_struct["template"] = out
-            return tuple(o.value() for o in outs)
+            out_struct["n_out"] = len(outs)
+            out_struct["side_tensors"] = [t for t, _ in
+                                          inner_ctx.buffer_updates]
+            side_arrays = tuple(a for _, a in inner_ctx.buffer_updates)
+            return tuple(o.value() for o in outs) + side_arrays
         finally:
+            dispatch.pop_trace()
+            inner_ctx.restore()
             for t, d in zip(in_tensors, saved):
                 t._data = d
 
     out_arrays = jax.checkpoint(pure)(arrays)
+    n_out = out_struct["n_out"]
+    # re-emit the region's buffer updates into the OUTER trace so TrainStep /
+    # to_static thread them as program state (post-checkpoint values)
+    outer_ctx = dispatch.trace_ctx()
+    for t, arr in zip(out_struct["side_tensors"], out_arrays[n_out:]):
+        t._data = arr
+        if outer_ctx is not None:
+            outer_ctx.record_buffer_update(t, arr)
     if out_struct["single"]:
         return Tensor(out_arrays[0])
     # rebuild: replace each Tensor leaf of the template in order
-    it = iter(out_arrays)
+    it = iter(out_arrays[:n_out])
 
     def rebuild(obj):
         if isinstance(obj, Tensor):

@@ -22,11 +22,6 @@ from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
-def counter_total(counter):
-    # the pool size isn't in the counter; workers read it from the fork state
-    return _FORK_STATE.get("num_workers", 0)
-
-
 # fork-inherited worker state (reference worker.py passes it over pipes; fork
 # makes the dataset visible for free and start cost O(1) in dataset size).
 # _FORK_LOCK serializes the assign→fork window so two concurrently-starting
@@ -35,14 +30,13 @@ _FORK_STATE = {}
 _FORK_LOCK = threading.Lock()
 
 
-def _worker_init(counter, init_fn, token):
+def _worker_init(counter, init_fn, token, num_workers):
     with counter.get_lock():
         wid = counter.value
         counter.value += 1
     _FORK_STATE["worker_id"] = wid
     from .dataset import WorkerInfo, _set_worker_info
-    _set_worker_info(WorkerInfo(wid, counter_total(counter),
-                                _FORK_STATE.get(token)))
+    _set_worker_info(WorkerInfo(wid, num_workers, _FORK_STATE.get(token)))
     # re-key the fork-captured dataset so the parent can drop its entry while
     # respawned workers (after a child crash) still find it
     _FORK_STATE["dataset"] = _FORK_STATE[token]
@@ -167,6 +161,10 @@ class DataLoader:
 
     def _produce_batches(self):
         if self._iterable_mode:
+            if self.num_workers > 1 and self._use_process_workers \
+                    and "fork" in mp.get_all_start_methods():
+                yield from self._produce_iterable_multiprocess()
+                return
             it = iter(self.dataset)
             while True:
                 batch = list(itertools.islice(it, self.batch_size))
@@ -193,6 +191,48 @@ class DataLoader:
                 for indices in self.batch_sampler:
                     yield self.collate_fn([self.dataset[i] for i in indices])
 
+    def _produce_iterable_multiprocess(self):
+        """IterableDataset process workers: each forked worker gets
+        WorkerInfo(id, num_workers) — the dataset's __iter__ shards its own
+        stream (reference _DataLoaderIterMultiProcess iterable mode) — and
+        ships raw samples back; the parent collates."""
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        END = None
+
+        def worker(wid):
+            from .dataset import WorkerInfo, _set_worker_info
+            _set_worker_info(WorkerInfo(wid, self.num_workers, self.dataset))
+            if self._worker_init_fn is not None:
+                self._worker_init_fn(wid)
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    break
+                if len(batch) < self.batch_size and self.drop_last:
+                    break
+                q.put(batch)
+            q.put(END)
+
+        procs = [ctx.Process(target=worker, args=(w,), daemon=True)
+                 for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        try:
+            done = 0
+            while done < self.num_workers:
+                item = q.get()
+                if item is END:
+                    done += 1
+                    continue
+                yield self.collate_fn(item)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join()
+
     def _produce_multiprocess(self):
         """Process workers: one batch of __getitem__ calls per task, results
         streamed back in order (reference _DataLoaderIterMultiProcess)."""
@@ -200,12 +240,11 @@ class DataLoader:
         token = f"dataset_{id(self)}"
         with _FORK_LOCK:
             _FORK_STATE[token] = self.dataset
-            _FORK_STATE["num_workers"] = self.num_workers
             counter = ctx.Value("i", 0)
             try:
                 pool = ctx.Pool(self.num_workers, initializer=_worker_init,
                                 initargs=(counter, self._worker_init_fn,
-                                          token))
+                                          token, self.num_workers))
             except BaseException:
                 _FORK_STATE.pop(token, None)
                 raise

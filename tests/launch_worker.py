@@ -59,10 +59,79 @@ def main(outdir):
                    "losses": losses}, f)
 
 
+
+
+def train_hybrid_and_losses(steps: int = 3):
+    """Hybrid dp×mp training (TP weights sharded across PROCESSES) — the
+    multi-host version of the fleet hybrid mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet, get_mesh
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = get_mesh()
+
+    paddle.seed(0)
+
+    class WithLoss(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = paddle.nn.Linear(16, 32)
+            self.l2 = paddle.nn.Linear(32, 4)
+
+        def forward(self, x, y):
+            h = paddle.nn.functional.relu(self.l1(x))
+            return paddle.nn.functional.mse_loss(self.l2(h), y)
+
+    model = WithLoss()
+    # TP: column-shard l1, row-shard l2 over the model axis (spans processes)
+    model.l1.weight._data = jax.device_put(
+        model.l1.weight.value(), NamedSharding(mesh, P(None, "model")))
+    model.l2.weight._data = jax.device_put(
+        model.l2.weight.value(), NamedSharding(mesh, P("model", None)))
+    tp_weights = {id(model.l1.weight), id(model.l2.weight)}
+    for p in model.parameters():
+        if id(p) not in tp_weights:
+            p._data = jax.device_put(
+                p.value(), NamedSharding(mesh, P(*([None] * p.ndim))))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt)
+    xs = np.random.RandomState(1).randn(8, 16).astype("float32")
+    ys = np.random.RandomState(2).randn(8, 4).astype("float32")
+    x_t = paddle.to_tensor(xs)
+    x_t._data = jax.device_put(x_t.value(),
+                               NamedSharding(mesh, P("data", None)))
+    return [float(step(x_t, paddle.to_tensor(ys))) for _ in range(steps)]
+
+
+def main_hybrid(outdir):
+    import jax
+
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    assert jax.device_count() == 8
+    losses = train_hybrid_and_losses()
+    rank = jax.process_index()
+    with open(os.path.join(outdir, f"hloss_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "losses": losses}, f)
+
+
 if __name__ == "__main__":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 4)
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    main(sys.argv[1])
+    if len(sys.argv) > 2 and sys.argv[2] == "hybrid":
+        main_hybrid(sys.argv[1])
+    else:
+        main(sys.argv[1])

@@ -632,3 +632,58 @@ def test_recompute_under_trace_applies_remat():
         return [float(step(x)) for _ in range(3)]
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+    # the remat must really be IN the traced program (loss equality alone
+    # would also pass for a silent pass-through)
+    import jax
+    from paddle_tpu.core import dispatch as dsp
+    from paddle_tpu.core.tensor import Tensor as _T
+    paddle.seed(0)
+    net = Net(True)
+
+    def traced(arr):
+        ctx = dsp.TraceContext()
+        dsp.push_trace(ctx)
+        try:
+            return net(_T(arr)).value()
+        finally:
+            dsp.pop_trace()
+            ctx.restore()
+
+    jaxpr = str(jax.make_jaxpr(traced)(x.value()))
+    assert "remat" in jaxpr or "checkpoint" in jaxpr, \
+        "recompute region not lowered to jax.checkpoint"
+
+
+def test_recompute_traced_with_dropout_rng_threading():
+    """Remat region containing DROPOUT under TrainStep: the RNG-chain advance
+    inside jax.checkpoint must thread out as program state, not leak a
+    remat tracer into the outer trace (review finding)."""
+    paddle.seed(0)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = paddle.nn.Linear(8, 32)
+            self.l2 = paddle.nn.Linear(32, 8)
+
+        def forward(self, x):
+            def block(t):
+                return paddle.nn.functional.dropout(
+                    paddle.nn.functional.gelu(self.l1(t)), p=0.5,
+                    training=True)
+            h = dist.recompute(block, x)
+            return (self.l2(h) ** 2).mean()
+
+    net = Net()
+    net.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    losses = [float(step(x)) for _ in range(4)]
+    assert all(np.isfinite(losses)), losses
+    # RNG state threads: different steps draw different dropout masks, so
+    # consecutive losses differ even with identical inputs pre-update
+    assert len(set(round(l, 7) for l in losses)) > 1, losses

@@ -93,3 +93,25 @@ def _dump_logs(log_dir):
             with open(os.path.join(log_dir, name), errors="replace") as f:
                 chunks.append(f"----- {name} -----\n" + f.read()[-4000:])
     return "\n".join(chunks) or "(no logs)"
+
+
+def test_launch_hybrid_tp_across_processes(tmp_path):
+    """dp=4 x mp=2 hybrid: tensor-parallel weights sharded over a mesh that
+    SPANS the two worker processes; per-rank losses must match the
+    single-process hybrid run."""
+    out = str(tmp_path)
+    rc = subprocess.call(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--job_id", "t3",
+         "--log_dir", os.path.join(out, "logs"), WORKER, out, "hybrid"],
+        cwd=REPO, timeout=300)
+    assert rc == 0, _dump_logs(os.path.join(out, "logs"))
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from launch_worker import train_hybrid_and_losses
+    ref = train_hybrid_and_losses()
+    for rank in range(2):
+        with open(os.path.join(out, f"hloss_{rank}.json")) as f:
+            got = json.load(f)
+        np.testing.assert_allclose(got["losses"], ref, rtol=1e-5,
+                                   err_msg=f"rank {rank} hybrid mismatch")

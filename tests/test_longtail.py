@@ -458,3 +458,10 @@ def test_iterable_dataset_worker_info():
     loader = paddle.io.DataLoader(Stream(), batch_size=4)
     got = np.concatenate([b.numpy() for b in loader])
     np.testing.assert_array_equal(np.sort(got), np.arange(16, dtype="float32"))
+
+    # process workers: each worker streams ITS shard (worker info non-None)
+    loader2 = paddle.io.DataLoader(Stream(), batch_size=4, num_workers=2,
+                                   use_process_workers=True)
+    got2 = np.concatenate([b.numpy() for b in loader2])
+    np.testing.assert_array_equal(np.sort(got2),
+                                  np.arange(16, dtype="float32"))
