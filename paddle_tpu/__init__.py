@@ -113,3 +113,4 @@ def summary(net, input_size=None, dtypes=None):
     print(f"Total params: {total}")
     print(f"Trainable params: {trainable}")
     return {"total_params": total, "trainable_params": trainable}
+from ._api_completion import *  # noqa: F401,F403,E402

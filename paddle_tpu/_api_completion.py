@@ -1,0 +1,339 @@
+"""Top-level API completion: the reference `paddle.__all__` names that are
+implemented in submodules (re-exported here), are thin jnp wrappers, or are
+aliases/deprecated shims. Imported at the end of paddle_tpu/__init__."""
+from __future__ import annotations
+
+import math as _math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dtype as _dtypes
+from .core.dispatch import register_op
+from .core.random import get_rng_state, set_rng_state
+from .core.tensor import Parameter, Tensor, to_tensor
+from .ops import linalg as _linalg
+from .ops import manipulation as _manip
+from .ops._helpers import _op
+
+__all__ = [
+    "iinfo", "finfo", "dtype", "get_cuda_rng_state", "set_cuda_rng_state",
+    "rank", "LazyGuard", "is_complex", "is_integer", "is_floating_point",
+    "cross", "mv", "mm", "bmm", "bincount", "histogram", "dist", "einsum",
+    "unsqueeze_", "squeeze_", "reshape_", "tanh_", "scatter_", "index_add_",
+    "floor_mod", "vsplit", "reverse", "add_n", "complex", "broadcast_shape",
+    "nanmedian", "quantile", "nanquantile", "create_parameter", "shape",
+    "set_printoptions", "disable_signal_handler", "CUDAPinnedPlace", "batch",
+    "check_shape", "diagonal", "tril_indices", "triu_indices", "frexp",
+    "cumulative_trapezoid", "flops",
+]
+
+# ----------------------------------------------------- re-exports (submodules)
+cross = _linalg.cross
+mv = _linalg.mv
+bmm = _linalg.bmm
+bincount = _linalg.bincount
+histogram = _linalg.histogram
+dist = _linalg.dist
+einsum = _linalg.einsum
+
+
+def mm(input, mat2, name=None):
+    from .ops import matmul
+    return matmul(input, mat2)
+
+
+# ------------------------------------------------------------- dtype utilities
+dtype = _dtypes.DType if hasattr(_dtypes, "DType") else type(_dtypes.float32)
+
+
+class _FloatInfo:
+    def __init__(self, info):
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class _IntInfo:
+    def __init__(self, info):
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+def finfo(dt):
+    return _FloatInfo(jnp.finfo(_dtypes.convert_dtype(dt)))
+
+
+def iinfo(dt):
+    return _IntInfo(jnp.iinfo(_dtypes.convert_dtype(dt)))
+
+
+def _dt_of(x):
+    return jnp.asarray(x.value() if isinstance(x, Tensor) else x).dtype
+
+
+def is_complex(x):
+    return jnp.issubdtype(_dt_of(x), jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_dt_of(x), jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_dt_of(x), jnp.floating)
+
+
+# ----------------------------------------------------------------- rng aliases
+def get_cuda_rng_state():
+    """Accelerator RNG state (maps to the TPU rng chain)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+# ------------------------------------------------------------------- small ops
+def rank(input):
+    return to_tensor(np.asarray(int(jnp.asarray(
+        input.value() if isinstance(input, Tensor) else input).ndim)))
+
+
+def shape(input):
+    """Returns the shape as an int32 Tensor (reference paddle.shape)."""
+    arr = input.value() if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(arr.shape, jnp.int32))
+
+
+def _cplx_fwd(real, imag):
+    return real + 1j * imag.astype(jnp.result_type(real, imag, jnp.complex64))
+
+
+register_op("complex", _cplx_fwd)
+
+
+def complex(real, imag, name=None):  # noqa: A001 (reference name)
+    return _op("complex", real, imag)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    tensors = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out = tensors[0]
+    for t in tensors[1:]:
+        out = out + t
+    return out
+
+
+def floor_mod(x, y, name=None):
+    from .ops import mod
+    return mod(x, y)
+
+
+def vsplit(x, num_or_indices, name=None):
+    from .ops import split as _split
+    return _split(x, num_or_indices, axis=0)
+
+
+def reverse(x, axis, name=None):
+    from .ops import flip
+    return flip(x, axis)
+
+
+register_op("diagonal", lambda x, *, offset=0, axis1=0, axis2=1:
+            jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _op("diagonal", x, offset=int(offset), axis1=int(axis1),
+               axis2=int(axis2))
+
+
+register_op("quantile_op", lambda x, *, q=0.5, axis=None, keepdim=False,
+            nan_aware=False:
+            (jnp.nanquantile if nan_aware else jnp.quantile)(
+                x, q, axis=axis, keepdims=keepdim))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _op("quantile_op", x, q=float(q) if np.isscalar(q) else tuple(q),
+               axis=ax, keepdim=keepdim, nan_aware=False)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _op("quantile_op", x, q=float(q) if np.isscalar(q) else tuple(q),
+               axis=ax, keepdim=keepdim, nan_aware=True)
+
+
+register_op("nanmedian_op", lambda x, *, axis=None, keepdim=False:
+            jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return _op("nanmedian_op", x, axis=ax, keepdim=keepdim)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]),
+                              _dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]),
+                              _dtypes.convert_dtype(dtype)))
+
+
+def frexp(x, name=None):
+    arr = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    m, e = jnp.frexp(arr)
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    yv = y.value() if isinstance(y, Tensor) else jnp.asarray(y)
+    y0 = jax.lax.slice_in_dim(yv, 0, yv.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(yv, 1, yv.shape[axis], axis=axis)
+    if x is not None:
+        xv = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+        d = jnp.diff(xv, axis=axis)
+    else:
+        d = dx
+    return Tensor(jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis))
+
+
+# -------------------------------------------------------------- inplace forms
+def _inplace(out_fn):
+    def method(t, *a, **k):
+        out = out_fn(t, *a, **k)
+        arr = out.value()
+        if tuple(arr.shape) != tuple(t.shape):
+            # reshape-class inplace ops legally change the view shape
+            t._data = arr
+            t._version += 1
+            return t
+        t._set_value_inplace(arr)
+        return t
+    return method
+
+
+def _install_inplace_methods():
+    from .ops import (index_add, reshape, scatter, squeeze, tanh, unsqueeze)
+    T = Tensor
+    T.unsqueeze_ = _inplace(unsqueeze)
+    T.squeeze_ = _inplace(squeeze)
+    T.reshape_ = _inplace(reshape)
+    T.tanh_ = _inplace(tanh)
+    T.scatter_ = _inplace(scatter)
+    T.index_add_ = _inplace(index_add)
+    return {n: getattr(T, n) for n in
+            ("unsqueeze_", "squeeze_", "reshape_", "tanh_", "scatter_",
+             "index_add_")}
+
+
+_ip = _install_inplace_methods()
+unsqueeze_ = _ip["unsqueeze_"]
+squeeze_ = _ip["squeeze_"]
+reshape_ = _ip["reshape_"]
+tanh_ = _ip["tanh_"]
+scatter_ = _ip["scatter_"]
+index_add_ = _ip["index_add_"]
+
+
+# ---------------------------------------------------------------- misc parity
+class LazyGuard:
+    """reference LazyGuard defers parameter init for huge models; here
+    parameter arrays are created lazily by jax anyway — scope is a no-op."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class CUDAPinnedPlace:
+    """Pinned-host place alias (host staging memory on TPU)."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None) -> Parameter:
+    from .nn.layer import Layer
+    holder = Layer()
+    p = holder.create_parameter(shape, attr=attr, dtype=dtype, is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """reference disables paddle's C++ signal handlers; none installed here."""
+
+
+def check_shape(x):
+    return True
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reader-composition helper (reference paddle.batch)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
+    """Rough FLOPs count over Linear/Conv2D (reference paddle.flops)."""
+    from .nn import Conv2D, Linear
+    total = 0
+    for _, layer in [("", net)] + list(net.named_sublayers()):
+        if isinstance(layer, Linear):
+            total += 2 * int(np.prod(layer.weight.shape))
+        elif isinstance(layer, Conv2D):
+            w = layer.weight
+            total += 2 * int(np.prod(w.shape))
+    batch_elems = int(np.prod(input_size[:1])) if input_size else 1
+    return total * max(batch_elems, 1)

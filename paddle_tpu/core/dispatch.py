@@ -117,14 +117,23 @@ def in_trace() -> bool:
 
 
 def push_trace(ctx=None):
-    _tls.trace_depth = getattr(_tls, "trace_depth", 0) + 1
+    stack = getattr(_tls, "trace_stack", None)
+    if stack is None:
+        stack = _tls.trace_stack = []
+    stack.append(ctx)
+    _tls.trace_depth = len(stack)
     _tls.trace_ctx = ctx
 
 
 def pop_trace():
-    _tls.trace_depth = getattr(_tls, "trace_depth", 0) - 1
-    if _tls.trace_depth == 0:
-        _tls.trace_ctx = None
+    # restore the ENCLOSING context (nested traces: e.g. jax.checkpoint
+    # capture inside a TrainStep trace) — clearing only at depth 0 would
+    # leave trace_ctx() pointing at the popped context
+    stack = getattr(_tls, "trace_stack", [])
+    if stack:
+        stack.pop()
+    _tls.trace_depth = len(stack)
+    _tls.trace_ctx = stack[-1] if stack else None
 
 
 def trace_ctx():

@@ -159,19 +159,23 @@ class DataLoader:
             return len(self.dataset)
         return len(self.batch_sampler)
 
+    def _iter_batches(self, it):
+        """Shared iterable batching (single- and multi-process paths)."""
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield batch
+
     def _produce_batches(self):
         if self._iterable_mode:
             if self.num_workers > 1 and self._use_process_workers \
                     and "fork" in mp.get_all_start_methods():
                 yield from self._produce_iterable_multiprocess()
                 return
-            it = iter(self.dataset)
-            while True:
-                batch = list(itertools.islice(it, self.batch_size))
-                if not batch:
-                    return
-                if len(batch) < self.batch_size and self.drop_last:
-                    return
+            for batch in self._iter_batches(iter(self.dataset)):
                 yield self.collate_fn(batch)
         elif self.batch_sampler is None:
             for i in range(len(self.dataset)):
@@ -201,19 +205,20 @@ class DataLoader:
         END = None
 
         def worker(wid):
-            from .dataset import WorkerInfo, _set_worker_info
-            _set_worker_info(WorkerInfo(wid, self.num_workers, self.dataset))
-            if self._worker_init_fn is not None:
-                self._worker_init_fn(wid)
-            it = iter(self.dataset)
-            while True:
-                batch = list(itertools.islice(it, self.batch_size))
-                if not batch:
-                    break
-                if len(batch) < self.batch_size and self.drop_last:
-                    break
-                q.put(batch)
-            q.put(END)
+            try:
+                from .dataset import WorkerInfo, _set_worker_info
+                _set_worker_info(WorkerInfo(wid, self.num_workers,
+                                            self.dataset))
+                if self._worker_init_fn is not None:
+                    self._worker_init_fn(wid)
+                for batch in self._iter_batches(iter(self.dataset)):
+                    q.put(batch)
+            except BaseException as e:   # propagate instead of hanging parent
+                import traceback
+                q.put(("__worker_error__",
+                       f"{e!r}\n{traceback.format_exc()[-2000:]}"))
+            finally:
+                q.put(END)
 
         procs = [ctx.Process(target=worker, args=(w,), daemon=True)
                  for w in range(self.num_workers)]
@@ -226,6 +231,9 @@ class DataLoader:
                 if item is END:
                     done += 1
                     continue
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == "__worker_error__":
+                    raise RuntimeError(f"DataLoader worker failed: {item[1]}")
                 yield self.collate_fn(item)
         finally:
             for p in procs:

@@ -1,0 +1,119 @@
+"""OpTest harness — the reference's per-op test strategy (SURVEY.md §4).
+
+Reference analog: test/legacy_test/eager_op_test.py OpTest:
+`check_output_with_place` runs an op in both execution modes and compares to a
+NumPy reference; `check_grad_with_place` compares analytic gradients against
+central-difference numeric gradients (get_numeric_gradient).
+
+Here the two execution modes are eager dispatch (per-op executables + tape)
+and whole-graph jit (the to_static trace path); gradients come from the tape
+and are checked against finite differences.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.tensor import Tensor
+
+
+def run_eager(fn: Callable, arrays: Sequence[np.ndarray]):
+    ts = [paddle.to_tensor(a) for a in arrays]
+    out = fn(*ts)
+    return out.numpy()
+
+
+def run_traced(fn: Callable, arrays: Sequence[np.ndarray]):
+    """Whole-graph execution: the op inlines into one jitted program."""
+    import jax
+
+    def pure(*arrs):
+        ctx = dispatch.TraceContext()
+        dispatch.push_trace(ctx)
+        try:
+            return fn(*[Tensor(a) for a in arrs]).value()
+        finally:
+            dispatch.pop_trace()
+            ctx.restore()
+
+    return np.asarray(jax.jit(pure)(*[np.asarray(a) for a in arrays]))
+
+
+def numeric_grad(fn: Callable, arrays: Sequence[np.ndarray], wrt: int,
+                 delta: float = 5e-3) -> np.ndarray:
+    """Central-difference gradient of sum(fn(...)) w.r.t. arrays[wrt]
+    (reference get_numeric_gradient, eager_op_test.py:131)."""
+    base = [np.array(a, dtype=np.float32) for a in arrays]
+    grad = np.zeros_like(base[wrt], dtype=np.float64)
+    flat = base[wrt].reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def scalar(arrs):
+        ts = [paddle.to_tensor(a) for a in arrs]
+        return float(fn(*ts).sum().numpy())
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        up = scalar(base)
+        flat[i] = orig - delta
+        down = scalar(base)
+        flat[i] = orig
+        gflat[i] = (up - down) / (2 * delta)
+    return grad
+
+
+def analytic_grad(fn: Callable, arrays: Sequence[np.ndarray], wrt: int
+                  ) -> np.ndarray:
+    ts = [paddle.to_tensor(a) for a in arrays]
+    for t in ts:
+        t.stop_gradient = False
+    out = fn(*ts).sum()
+    out.backward()
+    g = ts[wrt].grad
+    assert g is not None, f"no gradient flowed to input {wrt}"
+    return np.asarray(g.numpy(), dtype=np.float64)
+
+
+class OpTest:
+    """Subclass with `fn`, `inputs()` and optional `np_ref`."""
+
+    fn: Callable = None
+    rtol = 1e-4
+    atol = 1e-5
+    grad_rtol = 5e-2    # reference max_relative_error default ballpark
+    grad_atol = 1e-2
+    diff_inputs: Sequence[int] = (0,)
+
+    def inputs(self) -> Sequence[np.ndarray]:
+        raise NotImplementedError
+
+    def np_ref(self, *arrays):
+        return None
+
+    # ------------------------------------------------------------- checks
+
+    def test_output_eager_vs_traced_vs_numpy(self):
+        arrays = self.inputs()
+        eager = run_eager(type(self).fn, arrays)
+        traced = run_traced(type(self).fn, arrays)
+        np.testing.assert_allclose(eager, traced, rtol=self.rtol,
+                                   atol=self.atol,
+                                   err_msg="eager vs whole-graph mismatch")
+        ref = self.np_ref(*arrays)
+        if ref is not None:
+            np.testing.assert_allclose(eager, ref, rtol=self.rtol,
+                                       atol=self.atol,
+                                       err_msg="vs NumPy reference mismatch")
+
+    def test_grad_vs_numeric(self):
+        arrays = self.inputs()
+        for wrt in self.diff_inputs:
+            ana = analytic_grad(type(self).fn, arrays, wrt)
+            num = numeric_grad(type(self).fn, arrays, wrt)
+            np.testing.assert_allclose(
+                ana, num, rtol=self.grad_rtol, atol=self.grad_atol,
+                err_msg=f"analytic vs finite-difference grad (input {wrt})")

@@ -465,3 +465,20 @@ def test_iterable_dataset_worker_info():
     got2 = np.concatenate([b.numpy() for b in loader2])
     np.testing.assert_array_equal(np.sort(got2),
                                   np.arange(16, dtype="float32"))
+
+
+def test_iterable_process_worker_error_propagates():
+    """A crashing worker must surface as RuntimeError, not a hang (review
+    finding: missing END sentinel blocked q.get forever)."""
+    from paddle_tpu.io import IterableDataset
+
+    class Bad(IterableDataset):
+        def __iter__(self):
+            yield np.float32(1)
+            raise ValueError("boom in worker")
+
+    loader = paddle.io.DataLoader(Bad(), batch_size=1, num_workers=2,
+                                  use_process_workers=True)
+    with pytest.raises(RuntimeError, match="worker failed"):
+        for _ in loader:
+            pass

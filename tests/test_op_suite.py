@@ -1,0 +1,142 @@
+"""Per-op OpTest suite (reference test strategy §4: one OpTest per op with
+NumPy reference + numeric-gradient check; exemptions list for ops whose grad
+is non-smooth at sampled points)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTest
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+class TestMatmulOp(OpTest):
+    fn = staticmethod(lambda x, y: paddle.matmul(x, y))
+    diff_inputs = (0, 1)
+
+    def inputs(self):
+        return [_rs(0).randn(3, 4).astype("float32"),
+                _rs(1).randn(4, 5).astype("float32")]
+
+    def np_ref(self, x, y):
+        return x @ y
+
+
+class TestSoftmaxOp(OpTest):
+    fn = staticmethod(lambda x: F.softmax(x, axis=-1))
+
+    def inputs(self):
+        return [_rs(2).randn(4, 6).astype("float32")]
+
+    def np_ref(self, x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+
+class TestGeluOp(OpTest):
+    fn = staticmethod(lambda x: F.gelu(x))
+
+    def inputs(self):
+        return [_rs(3).randn(3, 5).astype("float32")]
+
+
+class TestTanhOp(OpTest):
+    fn = staticmethod(lambda x: paddle.tanh(x))
+
+    def inputs(self):
+        return [_rs(4).randn(2, 7).astype("float32")]
+
+    def np_ref(self, x):
+        return np.tanh(x)
+
+
+class TestLayerNormOp(OpTest):
+    fn = staticmethod(lambda x, w, b: F.layer_norm(x, [6], w, b, 1e-5))
+    diff_inputs = (0, 1, 2)
+
+    def inputs(self):
+        return [_rs(5).randn(4, 6).astype("float32"),
+                (1 + 0.1 * _rs(6).randn(6)).astype("float32"),
+                (0.1 * _rs(7).randn(6)).astype("float32")]
+
+
+class TestSigmoidOp(OpTest):
+    fn = staticmethod(lambda x: F.sigmoid(x))
+
+    def inputs(self):
+        return [_rs(8).randn(3, 4).astype("float32")]
+
+    def np_ref(self, x):
+        return 1 / (1 + np.exp(-x))
+
+
+class TestMeanOp(OpTest):
+    fn = staticmethod(lambda x: paddle.mean(x, axis=1, keepdim=True))
+
+    def inputs(self):
+        return [_rs(9).randn(3, 5).astype("float32")]
+
+    def np_ref(self, x):
+        return x.mean(1, keepdims=True)
+
+
+class TestGatherOp(OpTest):
+    fn = staticmethod(lambda x: paddle.gather(
+        x, paddle.to_tensor(np.array([2, 0, 1], "int64"))))
+
+    def inputs(self):
+        return [_rs(10).randn(4, 3).astype("float32")]
+
+    def np_ref(self, x):
+        return x[[2, 0, 1]]
+
+
+class TestConv2DOp(OpTest):
+    fn = staticmethod(lambda x, w: F.conv2d(x, w, stride=1, padding=1))
+    diff_inputs = (0, 1)
+    grad_rtol = 8e-2
+
+    def inputs(self):
+        return [_rs(11).randn(1, 2, 5, 5).astype("float32"),
+                0.5 * _rs(12).randn(3, 2, 3, 3).astype("float32")]
+
+
+class TestLogSumExpOp(OpTest):
+    fn = staticmethod(lambda x: paddle.logsumexp(x, axis=-1))
+
+    def inputs(self):
+        return [_rs(13).randn(4, 6).astype("float32")]
+
+    def np_ref(self, x):
+        m = x.max(-1, keepdims=True)
+        return (m + np.log(np.exp(x - m).sum(-1, keepdims=True)))[..., 0]
+
+
+class TestPowOp(OpTest):
+    fn = staticmethod(lambda x: paddle.pow(x, 3))
+
+    def inputs(self):
+        return [(_rs(14).rand(3, 4).astype("float32") + 0.5)]
+
+    def np_ref(self, x):
+        return x ** 3
+
+
+class TestMaxPoolOp(OpTest):
+    # max-pool grad is piecewise-constant in the argmax: keep inputs
+    # well-separated so finite differences don't cross a tie (the reference
+    # handles this with its white_list exemptions)
+    fn = staticmethod(lambda x: F.max_pool2d(x, kernel_size=2, stride=2))
+
+    def inputs(self):
+        base = np.arange(1 * 1 * 4 * 4, dtype="float32").reshape(1, 1, 4, 4)
+        return [base * 0.37]
+
+    def np_ref(self, x):
+        return x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5)) \
+            if False else np.array(
+                [[[[x[0, 0, :2, :2].max(), x[0, 0, :2, 2:].max()],
+                   [x[0, 0, 2:, :2].max(), x[0, 0, 2:, 2:].max()]]]],
+                "float32")
