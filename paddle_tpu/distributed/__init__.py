@@ -28,3 +28,6 @@ from . import auto_parallel  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from .auto_parallel import ProcessMesh  # noqa: F401,E402
 from . import launch  # noqa: F401,E402
+from .api_completion import *  # noqa: F401,F403,E402
+from . import io  # noqa: F401,E402
+from .api_completion import ParallelMode  # noqa: F401,E402

@@ -1,0 +1,302 @@
+"""Remaining paddle.distributed surface: spawn, object collectives, gloo
+shims, PS dataset configs, async p2p handles.
+
+Reference analogs: python/paddle/distributed/{spawn.py,communication/*,
+fleet/dataset/*}. Single-controller semantics where the reference is
+per-process; process-world behavior where jax.distributed is live.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+from enum import IntEnum
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as _coll
+from .collective import barrier, recv, send
+from .env import init_parallel_env
+
+__all__ = ["spawn", "gather", "scatter_object_list", "broadcast_object_list",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+           "alltoall_single", "ParallelMode", "destroy_process_group",
+           "isend", "irecv", "is_available", "get_backend", "QueueDataset",
+           "InMemoryDataset", "CountFilterEntry", "ShowClickEntry",
+           "ProbabilityEntry"]
+
+
+class ParallelMode(IntEnum):
+    """reference fleet.base.topology.ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+def is_available() -> bool:
+    return True
+
+
+def get_backend(group=None) -> str:
+    """The collective transport (reference returns NCCL/GLOO; here XLA's
+    compiled collectives over ICI/DCN)."""
+    return "XLA"
+
+
+def destroy_process_group(group=None):
+    from . import group as _group
+    if group is None:
+        _group._group_registry.clear()
+    else:
+        _group._group_registry.pop(getattr(group, "id", None), None)
+
+
+# ------------------------------------------------------------------- spawn
+
+def spawn(func, args=(), nprocs: int = -1, join: bool = True, daemon=False,
+          **options):
+    """Launch func in worker processes (reference paddle.distributed.spawn).
+
+    Single-host: forks nprocs processes with the PADDLE_* env contract so each
+    worker's init_parallel_env federates through jax.distributed."""
+    from .launch.controller import free_port
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if nprocs <= 1:
+            nprocs = 2
+    ctx = mp.get_context("fork")
+    port = free_port()
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_MASTER": f"127.0.0.1:{port}",
+               "PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs),
+               "PADDLE_LOCAL_RANK": str(rank)}
+
+        def run(rank=rank, env=env):
+            os.environ.update(env)
+            func(*args)
+
+        p = ctx.Process(target=run, daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Context:
+        processes = procs
+
+        def join(self):
+            for p in procs:
+                p.join()
+            codes = [p.exitcode for p in procs]
+            if any(c != 0 for c in codes):
+                raise RuntimeError(f"spawned workers failed: {codes}")
+
+    c = Context()
+    if join:
+        c.join()
+    return c
+
+
+# ------------------------------------------------------- object collectives
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
+    """Rank-stack gather: dst receives every rank's slice (reference gather)."""
+    from .collective import all_gather
+    stacked = all_gather(tensor=tensor, group=group)
+    if gather_list is not None:
+        arr = stacked.value()
+        for i in range(arr.shape[0]):
+            gather_list.append(Tensor(arr[i]))
+    return stacked
+
+
+def broadcast_object_list(object_list: List[Any], src: int = 0, group=None):
+    """Every position takes src's object (single-controller: py objects are
+    already shared; multihost: pickled through the process-0 broadcast)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        blob = np.frombuffer(pickle.dumps(object_list[src]), np.uint8)
+        # fixed-size header exchange keeps shapes static across processes
+        size = multihost_utils.broadcast_one_to_all(
+            np.asarray(blob.size, np.int64))
+        buf = np.zeros(int(size), np.uint8)
+        buf[:blob.size] = blob if jax.process_index() == 0 else 0
+        out = multihost_utils.broadcast_one_to_all(buf)
+        obj = pickle.loads(bytes(out.tobytes()[:int(size)]))
+    else:
+        obj = object_list[src]
+    for i in range(len(object_list)):
+        object_list[i] = obj
+    return object_list
+
+
+def scatter_object_list(out_object_list: List[Any],
+                        in_object_list: Optional[List[Any]] = None,
+                        src: int = 0, group=None):
+    """Each rank receives its slice of src's list (reference
+    scatter_object_list; single-controller keeps the whole list visible)."""
+    if in_object_list is None:
+        raise ValueError("in_object_list required on src")
+    out_object_list.clear()
+    out_object_list.extend(in_object_list)
+    return out_object_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor alltoall (reference alltoall_single): dim 0 blocks are
+    exchanged between ranks — the rank-stack view is a transpose of blocks."""
+    from .collective import _group_or_default, alltoall
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single supports equal dim-0 splits only "
+            "(in/out_split_sizes unsupported)")
+    g = _group_or_default(group)
+    x = in_tensor.value() if isinstance(in_tensor, Tensor) else in_tensor
+    n = g.nranks
+    if x.shape[0] % n != 0:
+        raise ValueError(f"alltoall_single: dim 0 ({x.shape[0]}) must divide "
+                         f"evenly by nranks ({n})")
+    blocks = x.reshape((n, x.shape[0] // n) + tuple(x.shape[1:]))
+    out = alltoall(Tensor(blocks), group=group)
+    res = out.value().reshape(x.shape)
+    if out_tensor is not None:
+        out_tensor._data = res
+        return out_tensor
+    return Tensor(res)
+
+
+# --------------------------------------------------------------- gloo shims
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint: str):
+    """CPU-group bootstrap (reference gloo path). jax's coordination service
+    subsumes gloo's rendezvous; collectives compile to XLA either way."""
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    pass  # no gloo contexts to free; XLA owns the collectives
+
+
+# ----------------------------------------------------------- async p2p tasks
+
+class _CompletedTask:
+    """p2p task handle: single-controller sends complete at issue time
+    (reference returns an async task with wait())."""
+
+    def is_completed(self):
+        return True
+
+    def wait(self):
+        return True
+
+
+def isend(tensor, dst: int = 0, group=None):
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _CompletedTask()
+
+
+def irecv(tensor, src: int = 0, group=None):
+    recv(tensor, src=src, group=group, sync_op=False)
+    return _CompletedTask()
+
+
+# --------------------------------------------------------- PS dataset configs
+
+class _Entry:
+    def __init__(self, **kw):
+        self.config = dict(kw)
+
+
+class CountFilterEntry(_Entry):
+    """Sparse-table admission by show count (reference accessor config)."""
+
+    def __init__(self, count_filter: int = 0):
+        super().__init__(count_filter=count_filter)
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name: str = "show", click_name: str = "click"):
+        super().__init__(show=show_name, click=click_name)
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability: float = 1.0):
+        super().__init__(probability=probability)
+
+
+class InMemoryDataset:
+    """Minimal in-memory PS dataset: load text files, global shuffle, iterate
+    (reference fleet/dataset/dataset.py InMemoryDataset over data_set.cc)."""
+
+    def __init__(self):
+        self._records: List[str] = []
+        self._batch = 1
+        self._parse = None
+
+    def init(self, batch_size: int = 1, use_var=None, pipe_command=None,
+             parse_fn=None, **kw):
+        self._batch = batch_size
+        self._parse = parse_fn
+
+    set_batch_size = init
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._files = list(filelist)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in getattr(self, "_files", []):
+            with open(path) as f:
+                self._records.extend(line.rstrip("\n") for line in f)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 1, seed: int = 0):
+        rs = np.random.RandomState(seed)
+        rs.shuffle(self._records)
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        buf = []
+        for rec in self._records:
+            buf.append(self._parse(rec) if self._parse else rec)
+            if len(buf) == self._batch:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): iterates files directly."""
+
+    def load_into_memory(self):
+        pass  # streaming: records read at iteration time
+
+    def __iter__(self):
+        buf = []
+        for path in getattr(self, "_files", []):
+            with open(path) as f:
+                for line in f:
+                    rec = line.rstrip("\n")
+                    buf.append(self._parse(rec) if self._parse else rec)
+                    if len(buf) == self._batch:
+                        yield buf
+                        buf = []
+        if buf:
+            yield buf

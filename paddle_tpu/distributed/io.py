@@ -1,0 +1,33 @@
+"""paddle.distributed.io (reference distributed/io.py: save/load helpers for
+distributed programs)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    return getattr(var, "persistable", True)
+
+
+def save_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, model=None):
+    """Persist a model's parameters (reference fleet save_persistables)."""
+    from .. import framework
+    target = model if model is not None else main_program
+    if target is None or not hasattr(target, "state_dict"):
+        raise ValueError("pass model= (a Layer) to save_persistables")
+    os.makedirs(dirname, exist_ok=True)
+    framework.io.save(target.state_dict(),
+                      os.path.join(dirname, filename or "params.pdparams"))
+
+
+def load_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename=None, model=None):
+    from .. import framework
+    target = model if model is not None else main_program
+    state = framework.io.load(os.path.join(dirname,
+                                           filename or "params.pdparams"))
+    target.set_state_dict(state)
+    return target

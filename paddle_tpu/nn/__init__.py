@@ -11,3 +11,4 @@ from .layers_transformer import *  # noqa: F401,F403
 from .layers_rnn import *  # noqa: F401,F403
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from ..core.tensor import Parameter  # noqa: F401
+from .layers_extended import *  # noqa: F401,F403,E402

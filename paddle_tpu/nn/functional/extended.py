@@ -1,0 +1,555 @@
+"""Extended functionals closing the reference nn.functional surface:
+distance/margin losses, CTC/RNNT (log-space DP as lax.scan), spatial sampling
+(affine_grid/grid_sample), unpooling, beam-search utilities.
+
+Reference analogs: python/paddle/nn/functional/{loss,distance,vision,common}.py
+over the corresponding phi kernels (e.g. phi/kernels/*ctc*, warpctc vendored
+lib — here the DP runs as compiled XLA scans instead of a dlopen'd library).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import register_op
+from ...core.tensor import Tensor
+from ...ops._helpers import _op
+
+__all__ = [
+    "pairwise_distance", "diag_embed", "sequence_mask", "zeropad2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "dice_loss",
+    "hsigmoid_loss", "npair_loss", "margin_cross_entropy", "ctc_loss",
+    "rnnt_loss", "affine_grid", "grid_sample", "gather_tree",
+    "temporal_shift", "sparse_attention", "triplet_margin_with_distance_loss",
+    "multi_margin_loss", "elu_", "softmax_", "tanh_",
+]
+
+
+# ------------------------------------------------------------------ distances
+
+def _pairwise_fwd(x, y, *, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.linalg.norm(jnp.abs(d), ord=p, axis=-1, keepdims=keepdim)
+
+
+register_op("pairwise_distance", _pairwise_fwd)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return _op("pairwise_distance", x, y, p=float(p), epsilon=epsilon,
+               keepdim=keepdim)
+
+
+# ---------------------------------------------------------------- embeddings
+
+register_op("diag_embed", lambda x, *, offset=0, dim1=-2, dim2=-1:
+            _diag_embed_impl(x, offset, dim1, dim2))
+
+
+def _diag_embed_impl(x, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    # move the two new dims into place
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = sorted([(d1, nd - 2), (d2, nd - 1)])
+    for pos, src in order:
+        perm.insert(pos, src)
+    return jnp.transpose(out, perm)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    return _op("diag_embed", input, offset=int(offset), dim1=int(dim1),
+               dim2=int(dim2))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    arr = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    m = int(maxlen) if maxlen is not None else int(arr.max())
+    from ...core.dtype import convert_dtype
+    mask = (jnp.arange(m)[None, :] < arr[..., None]).astype(
+        convert_dtype(dtype))
+    return Tensor(mask)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = [int(p) for p in padding]
+    pads = ([(0, 0), (0, 0), (t, b), (l, r)] if data_format == "NCHW"
+            else [(0, 0), (t, b), (l, r), (0, 0)])
+    return _op("zeropad2d_op", x, pads=tuple(map(tuple, pads)))
+
+
+register_op("zeropad2d_op", lambda x, *, pads: jnp.pad(x, pads))
+
+
+# ---------------------------------------------------------------- unpooling
+
+def _unpool_fwd(x, indices, *, out_spatial):
+    # x, indices: [N, C, *spatial_in]; indices index the FLATTENED output
+    n, c = x.shape[:2]
+    flat = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    out_len = int(np.prod(out_spatial))
+    out = jnp.zeros((n, c, out_len), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].set(v)))(out, idx, flat)
+    return out.reshape((n, c) + tuple(out_spatial))
+
+
+register_op("max_unpool", _unpool_fwd, nondiff_inputs=(1,))
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size, ndim):
+    ks = (kernel_size,) * ndim if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = tuple(ks) if stride is None else (
+        (stride,) * ndim if isinstance(stride, int) else tuple(stride))
+    spatial_in = tuple(int(s) for s in x.shape[2:])
+    pd = (padding,) * ndim if isinstance(padding, int) else tuple(padding)
+    if output_size is None:
+        out_spatial = tuple((si - 1) * s - 2 * p + k for si, s, k, p in
+                            zip(spatial_in, st, ks, pd))
+    else:
+        out_spatial = tuple(int(s) for s in output_size[-ndim:])
+    return _op("max_unpool", x, indices, out_spatial=out_spatial)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 3)
+
+
+# -------------------------------------------------------------------- losses
+
+def _dice_fwd(iv, lv, *, epsilon=1e-5):
+    num_classes = iv.shape[-1]
+    lab1h = jax.nn.one_hot(lv[..., 0].astype(jnp.int32), num_classes,
+                           dtype=iv.dtype)
+    reduce_dims = tuple(range(1, iv.ndim))
+    inter = jnp.sum(iv * lab1h, axis=reduce_dims)
+    union = jnp.sum(iv, axis=reduce_dims) + jnp.sum(lab1h, axis=reduce_dims)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+register_op("dice_loss", _dice_fwd, nondiff_inputs=(1,))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return _op("dice_loss", input, label, epsilon=float(epsilon))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid with the DEFAULT complete binary tree (the
+    reference's non-custom-tree mode)."""
+    iv = input.value() if isinstance(input, Tensor) else jnp.asarray(input)
+    lv = (label.value() if isinstance(label, Tensor)
+          else jnp.asarray(label)).reshape(-1).astype(jnp.int32)
+    wv = weight.value() if isinstance(weight, Tensor) else jnp.asarray(weight)
+    bv = bias.value() if (bias is not None and isinstance(bias, Tensor)) \
+        else (jnp.asarray(bias) if bias is not None else None)
+    # complete binary heap: leaves live at [num_classes, 2*num_classes);
+    # internal nodes 1..num_classes-1 map to weight rows 0..num_classes-2
+    code_len = int(math.ceil(math.log2(max(num_classes, 2))))
+    node = lv + num_classes
+    losses = []
+    for _ in range(code_len):
+        parent = node // 2                 # internal node visited at this hop
+        bit = (node & 1).astype(iv.dtype)  # which child we descended to
+        row = jnp.clip(parent - 1, 0, wv.shape[0] - 1)
+        valid = (parent >= 1).astype(iv.dtype)
+        logits = jnp.einsum("bh,bh->b", iv, wv[row])
+        if bv is not None:
+            logits = logits + bv.reshape(-1)[row]
+        # sigmoid CE against the branch bit, masked once above the root
+        losses.append(valid * (jnp.maximum(logits, 0) - logits * bit
+                               + jnp.log1p(jnp.exp(-jnp.abs(logits)))))
+        node = parent
+    return Tensor(jnp.sum(jnp.stack(losses), axis=0).mean())
+
+
+def _npair_fwd(a, p, lv, *, l2_reg=0.002):
+    sim = a @ p.T                                        # [B, B]
+    same = (lv.reshape(-1)[:, None] == lv.reshape(-1)[None, :]).astype(a.dtype)
+    same = same / jnp.maximum(same.sum(-1, keepdims=True), 1)
+    xent = -jnp.sum(same * jax.nn.log_softmax(sim, axis=-1), axis=-1).mean()
+    reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / (2 * a.shape[0])
+    return xent + reg
+
+
+register_op("npair_loss", _npair_fwd, nondiff_inputs=(2,))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return _op("npair_loss", anchor, positive, labels, l2_reg=float(l2_reg))
+
+
+def _margin_ce_fwd(lv, yv, *, margin1=1.0, margin2=0.5, margin3=0.0,
+                   scale=64.0, reduction="mean"):
+    yv = yv.reshape(-1).astype(jnp.int32)
+    cos = jnp.clip(lv, -1.0 + 1e-6, 1.0 - 1e-6)
+    theta = jnp.arccos(cos)
+    tgt = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(yv, lv.shape[-1], dtype=lv.dtype)
+    adj = scale * (onehot * tgt + (1 - onehot) * cos)
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    per = -jnp.take_along_axis(logp, yv[:, None], axis=-1)[:, 0]
+    loss = per.mean() if reduction == "mean" else (
+        per.sum() if reduction == "sum" else per)
+    return loss, jnp.exp(logp)
+
+
+register_op("margin_cross_entropy", _margin_ce_fwd, nondiff_inputs=(1,))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference margin_cross_entropy)."""
+    loss, probs = _op("margin_cross_entropy", logits, label,
+                      margin1=float(margin1), margin2=float(margin2),
+                      margin3=float(margin3), scale=float(scale),
+                      reduction=reduction)
+    if return_softmax:
+        return loss, probs
+    return loss
+
+
+def _ctc_fwd(logits, labels, input_lengths, label_lengths, *, blank=0):
+    """CTC forward (alpha recursion in log space, lax.scan over time).
+
+    logits: [T, B, V] raw scores (log-softmax applied IN the op so the tape
+    differentiates through it); labels: [B, L] padded."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    T, B, V = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    labels = labels.astype(jnp.int32)
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.float32(-1e30)
+
+    emit = jnp.take_along_axis(
+        jnp.transpose(log_probs, (1, 0, 2)),          # [B, T, V]
+        ext[:, None, :].repeat(T, axis=1), axis=2)    # [B, T, S]
+
+    # allowed skip: ext[s] != ext[s-2]
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(L > 0, emit[:, 0, 1], neg_inf))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+        prev2 = jnp.where(skip_ok, prev2, neg_inf)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(prev1, prev2))
+        new = merged + emit[:, t, :]
+        # positions beyond this sample's input length keep the old alpha
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # final: logaddexp of the last two valid extended positions
+    last = 2 * label_lengths.astype(jnp.int32)        # index of final blank
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(a_last, jnp.where(label_lengths > 0, a_prev, -1e30))
+    return -ll
+
+
+register_op("ctc_loss", _ctc_fwd, nondiff_inputs=(1, 2, 3))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference warpctc kernel; here a compiled log-space DP)."""
+    lp = log_probs if isinstance(log_probs, Tensor) \
+        else Tensor(jnp.asarray(log_probs))
+    per = _op("ctc_loss", lp, labels, input_lengths, label_lengths,
+              blank=int(blank))
+    if reduction == "mean":
+        ll = jnp.maximum((label_lengths.value()
+                          if isinstance(label_lengths, Tensor)
+                          else jnp.asarray(label_lengths))
+                         .astype(jnp.float32), 1.0)
+        return (per / Tensor(ll)).mean()     # Tensor ops: stays on the tape
+    if reduction == "sum":
+        return per.sum()
+    return per
+
+
+def _rnnt_fwd(raw_logits, labels, input_lengths, label_lengths, *, blank=0):
+    """Transducer loss: DP over the (T, U) lattice, scanned over T.
+
+    raw_logits: [B, T, U+1, V]; log-softmax applied IN the op (tape-friendly)."""
+    logits = jax.nn.log_softmax(raw_logits, axis=-1)
+    B, T, U1, V = logits.shape
+    U = U1 - 1
+    labels = labels.astype(jnp.int32)
+    neg_inf = jnp.float32(-1e30)
+    blank_lp = logits[..., blank]                          # [B, T, U+1]
+    # label emission scores exist only at u < U: gather on the sliced lattice
+    lab_lp = jnp.take_along_axis(
+        logits[:, :, :U, :], labels[:, None, :, None].repeat(T, 1),
+        axis=3)[..., 0]
+    lab_lp = jnp.concatenate(
+        [lab_lp, jnp.full((B, T, 1), neg_inf)], axis=2)    # [B, T, U+1]
+
+    def t_step(alpha_t, t):
+        # alpha_t: [B, U+1] at time t (before consuming frame t)
+        # vertical (label) moves within the same frame: prefix recursion
+        def vertical(alpha_row):
+            def body(c, u):
+                prev = c
+                cur = jnp.logaddexp(
+                    alpha_row[:, u],
+                    jnp.where(u > 0, prev + lab_lp[:, t, u - 1], neg_inf))
+                return cur, cur
+            init = jnp.full((B,), neg_inf)
+            _, cols = jax.lax.scan(body, init, jnp.arange(U1))
+            return jnp.transpose(cols)                     # [B, U+1]
+
+        new_row = vertical(alpha_t)
+        active = (t < input_lengths)[:, None]
+        advanced = new_row + blank_lp[:, t, :]             # consume frame t
+        return jnp.where(active, advanced, alpha_t), None
+
+    alpha0 = jnp.full((B, U1), neg_inf).at[:, 0].set(0.0)
+    alpha_T, _ = jax.lax.scan(t_step, alpha0, jnp.arange(T))
+    final = jnp.take_along_axis(alpha_T,
+                                label_lengths.astype(jnp.int32)[:, None],
+                                axis=1)[:, 0]
+    return -final
+
+
+register_op("rnnt_loss_op", _rnnt_fwd, nondiff_inputs=(1, 2, 3))
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    lv = input if isinstance(input, Tensor) else Tensor(jnp.asarray(input))
+    per = _op("rnnt_loss_op", lv, label, input_lengths, label_lengths,
+              blank=int(blank))
+    if reduction == "mean":
+        return per.mean()
+    if reduction == "sum":
+        return per.sum()
+    return per
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    from ... import ops
+    dfn = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dfn(input, positive)
+    d_neg = dfn(input, negative)
+    if swap:
+        d_neg = ops.minimum(d_neg, dfn(positive, negative))
+    per = ops.maximum(d_pos - d_neg + margin, 0.0)
+    if reduction == "mean":
+        return per.mean()
+    if reduction == "sum":
+        return per.sum()
+    return per
+
+
+def _multi_margin_fwd(iv, yv, *rest, p=1, margin=1.0, reduction="mean"):
+    yv = yv.reshape(-1).astype(jnp.int32)
+    gold = jnp.take_along_axis(iv, yv[:, None], axis=1)
+    m = jnp.maximum(margin - gold + iv, 0) ** p
+    m = m.at[jnp.arange(iv.shape[0]), yv].set(0)
+    if rest:
+        m = m * rest[0][yv][:, None]
+    per = m.sum(-1) / iv.shape[1]
+    if reduction == "mean":
+        return per.mean()
+    if reduction == "sum":
+        return per.sum()
+    return per
+
+
+register_op("multi_margin_loss", _multi_margin_fwd, nondiff_inputs=(1,))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _op("multi_margin_loss", *args, p=int(p), margin=float(margin),
+               reduction=reduction)
+
+
+# --------------------------------------------------------- spatial sampling
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    tv = theta.value() if isinstance(theta, Tensor) else jnp.asarray(theta)
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)   # [H*W, 3]
+    grid = jnp.einsum("nij,pj->npi", tv, base)                 # [N, H*W, 2]
+    return Tensor(grid.reshape(n, h, w, 2))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    xv = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    gv = grid.value() if isinstance(grid, Tensor) else jnp.asarray(grid)
+    n, c, h, w = xv.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1) * (size - 1) / 2
+        return ((coord + 1) * size - 1) / 2
+
+    px = unnormalize(gv[..., 0], w)          # [N, Hg, Wg]
+    py = unnormalize(gv[..., 1], h)
+    if padding_mode == "reflection":
+        # triangular-wave reflection about the [0, size-1] range
+        px = (w - 1) - jnp.abs((w - 1) - jnp.abs(px) % (2 * max(w - 1, 1)))
+        py = (h - 1) - jnp.abs((h - 1) - jnp.abs(py) % (2 * max(h - 1, 1)))
+
+    def sample_one(img, sx, sy):
+        # img [C, H, W]; sx/sy [Hg, Wg]
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        fx = sx - x0
+        fy = sy - y0
+
+        def fetch(yy, xx):
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            v = img[:, yc, xc]               # [C, Hg, Wg]
+            if padding_mode == "zeros":
+                inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                return jnp.where(inside[None], v, 0.0)
+            return v                          # border/reflection: clamped tap
+
+        if mode == "nearest":
+            return fetch(jnp.round(sy).astype(jnp.int32),
+                         jnp.round(sx).astype(jnp.int32))
+        return (fetch(y0, x0) * ((1 - fx) * (1 - fy))[None]
+                + fetch(y0, x0 + 1) * (fx * (1 - fy))[None]
+                + fetch(y0 + 1, x0) * ((1 - fx) * fy)[None]
+                + fetch(y0 + 1, x0 + 1) * (fx * fy)[None])
+
+    out = jax.vmap(sample_one)(xv, px, py)
+    return Tensor(out)
+
+
+# ------------------------------------------------------------- misc utilities
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree op): ids/parents
+    [T, B, beam] -> full sequences following parent pointers from the end."""
+    iv = ids.value() if isinstance(ids, Tensor) else jnp.asarray(ids)
+    pv = (parents.value() if isinstance(parents, Tensor)
+          else jnp.asarray(parents)).astype(jnp.int32)
+    T = iv.shape[0]
+
+    def step(beam_idx, t):
+        tok = jnp.take_along_axis(iv[t], beam_idx, axis=-1)
+        nxt = jnp.take_along_axis(pv[t], beam_idx, axis=-1)
+        return nxt, tok
+
+    init = jnp.broadcast_to(jnp.arange(iv.shape[2], dtype=jnp.int32),
+                            iv.shape[1:]).astype(jnp.int32)
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return Tensor(toks[::-1])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    xv = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    nt, c, h, w = xv.shape
+    n = nt // seg_num
+    v = xv.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])],
+                           axis=1)
+    right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                             v[:, :-1, fold:2 * fold]], axis=1)
+    rest = v[:, :, 2 * fold:]
+    return Tensor(jnp.concatenate([left, right, rest], axis=2)
+                  .reshape(nt, c, h, w))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention surface ([B,H,L,D] layout); computed as masked
+    dense attention — the reference CUDA kernel's CSR sparsity pattern becomes
+    an additive mask (XLA fuses the masked softmax; a Pallas block-sparse
+    kernel is the optimization path). Offsets/columns are host metadata."""
+    qv = query.value() if isinstance(query, Tensor) else jnp.asarray(query)
+    kv = key.value() if isinstance(key, Tensor) else jnp.asarray(key)
+    vv = value.value() if isinstance(value, Tensor) else jnp.asarray(value)
+    B, H, L, D = qv.shape
+    off = np.asarray(sparse_csr_offset.numpy()
+                     if isinstance(sparse_csr_offset, Tensor)
+                     else sparse_csr_offset).astype(np.int64)
+    cols = np.asarray(sparse_csr_columns.numpy()
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns).astype(np.int64)
+    mask_np = np.full((B, H, L, L), -1e9, np.float32)
+    for b in range(B):
+        for hh in range(H):
+            for r in range(L):
+                lo, hi = off[b, hh, r], off[b, hh, r + 1]
+                mask_np[b, hh, r, cols[b, hh, lo:hi]] = 0.0
+    logits = jnp.einsum("bhld,bhkd->bhlk", qv, kv) / math.sqrt(D)
+    probs = jax.nn.softmax(logits + jnp.asarray(mask_np), axis=-1)
+    return Tensor(jnp.einsum("bhlk,bhkd->bhld", probs, vv))
+
+
+# ----------------------------------------------------------- inplace variants
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    x._set_value_inplace(elu(x, alpha).value())
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+    x._set_value_inplace(softmax(x, axis).value())
+    return x
+
+
+def tanh_(x, name=None):
+    from ...ops import tanh
+    x._set_value_inplace(tanh(x).value())
+    return x

@@ -79,8 +79,48 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     return _pool(x, kernel_size, stride, padding, 1, "max", data_format, ceil_mode)
 
 
+def _max_pool2d_mask_fwd(x, *, kernel, strides, pads):
+    """Max pool + argmax indices into the flattened INPUT spatial plane
+    (reference max_pool2d return_mask contract, consumed by max_unpool2d)."""
+    import jax.numpy as jnp
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    ph, pw = pads
+    neg = jnp.finfo(jnp.float32).min
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    h2 = (h + 2 * ph - kh) // sh + 1
+    w2 = (w + 2 * pw - kw) // sw + 1
+    wi = jnp.arange(h2)[:, None] * sh + jnp.arange(kh)[None, :]   # [h2, kh]
+    wj = jnp.arange(w2)[:, None] * sw + jnp.arange(kw)[None, :]   # [w2, kw]
+    win = xp[:, :, wi[:, None, :, None], wj[None, :, None, :]]    # [n,c,h2,w2,kh,kw]
+    flat = win.reshape(n, c, h2, w2, kh * kw)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1).astype(x.dtype)
+    gi = wi[:, None, :, None] + jnp.zeros((h2, w2, kh, kw), jnp.int32)
+    gj = wj[None, :, None, :] + jnp.zeros((h2, w2, kh, kw), jnp.int32)
+    gidx = ((gi - ph) * w + (gj - pw)).reshape(h2, w2, kh * kw)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(gidx, (n, c, h2, w2, kh * kw)),
+        arg[..., None], axis=-1)[..., 0]
+    return out, idx.astype(jnp.int32)
+
+
+register_op("max_pool2d_mask", _max_pool2d_mask_fwd)
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        assert data_format == "NCHW" and not ceil_mode, \
+            "return_mask supports NCHW, ceil_mode=False"
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        s = tuple(k) if stride is None else (
+            (stride,) * 2 if isinstance(stride, int) else tuple(stride))
+        p = (padding,) * 2 if isinstance(padding, int) else tuple(padding)
+        return _op("max_pool2d_mask", x, kernel=k, strides=s, pads=p)
     return _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
 
 

@@ -1,0 +1,230 @@
+"""Layer classes closing the reference nn surface: distance/margin losses,
+CTC/RNNT, unpooling, SpectralNorm, beam-search decoding.
+
+Reference analogs: python/paddle/nn/layer/{loss,distance,norm}.py and
+python/paddle/nn/decode.py (BeamSearchDecoder + dynamic_decode)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import functional as F
+from .layer import Layer
+
+__all__ = ["PairwiseDistance", "Softmax2D", "CTCLoss", "RNNTLoss",
+           "HSigmoidLoss", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+           "MultiMarginLoss", "TripletMarginWithDistanceLoss", "SpectralNorm",
+           "BeamSearchDecoder", "dynamic_decode"]
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size])
+        self.bias = (self.create_parameter([num_classes - 1, 1], is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self._a
+        return F.max_unpool1d(x, indices, k, s, p, output_size=o)
+
+
+class MaxUnPool2D(MaxUnPool1D):
+    def forward(self, x, indices):
+        k, s, p, o = self._a
+        return F.max_unpool2d(x, indices, k, s, p, output_size=o)
+
+
+class MaxUnPool3D(MaxUnPool1D):
+    def forward(self, x, indices):
+        k, s, p, o = self._a
+        return F.max_unpool3d(x, indices, k, s, p, output_size=o)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, r = self._a
+        return F.multi_margin_loss(input, label, p, m, w, r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._a
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   d, m, s, r)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight (reference SpectralNorm layer:
+    power-iteration estimate of sigma_max, returns weight / sigma)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        import numpy.random as npr
+        self.weight_u = self.create_parameter([h])
+        self.weight_u.set_value(npr.RandomState(0).randn(h).astype(dtype))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter([w])
+        self.weight_v.set_value(npr.RandomState(1).randn(w).astype(dtype))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        wv = weight.value() if isinstance(weight, Tensor) else \
+            jnp.asarray(weight)
+        mat = jnp.moveaxis(wv, self.dim, 0).reshape(wv.shape[self.dim], -1)
+        u = self.weight_u.value()
+        v = self.weight_v.value()
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        sigma = u @ mat @ v
+        self.weight_u.set_value(u)
+        self.weight_v.set_value(v)
+        return Tensor(wv / sigma)
+
+
+class BeamSearchDecoder:
+    """Greedy/beam decoding over a cell (reference nn.decode.BeamSearchDecoder,
+    simplified: scores = log_softmax(output_fn(cell_out)))."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   **kwargs):
+    """Beam search driver (reference dynamic_decode). Returns (ids, scores):
+    ids [B, beam, T]."""
+    cell = decoder.cell
+    beam = decoder.beam_size
+    state = inits
+    # batch inferred from the initial state pytree's leading dim
+    first_leaf = jax.tree_util.tree_leaves(
+        state.value() if isinstance(state, Tensor) else state)[0]
+    B = int(first_leaf.shape[0])
+
+    tokens = np.full((B, beam), decoder.start_token, np.int64)
+    scores = np.zeros((B, beam), np.float64)
+    scores[:, 1:] = -1e9          # all beams start from the same root
+    states = [state] * beam
+    finished = np.zeros((B, beam), bool)
+    out_ids = []
+
+    for _ in range(max_step_num):
+        cand_scores = []
+        cand_states = []
+        for b in range(beam):
+            inp = Tensor(jnp.asarray(tokens[:, b]))
+            if decoder.embedding_fn is not None:
+                inp = decoder.embedding_fn(inp)
+            out, new_state = cell(inp, states[b])
+            logits = decoder.output_fn(out) if decoder.output_fn else out
+            logp = jax.nn.log_softmax(logits.value(), axis=-1)
+            cand_scores.append(scores[:, b:b + 1]
+                               + np.where(finished[:, b:b + 1], 0.0,
+                                          np.asarray(logp)))
+            cand_states.append(new_state)
+        V = cand_scores[0].shape[-1]
+        allc = np.concatenate(cand_scores, axis=1)         # [B, beam*V]
+        top = np.argsort(-allc, axis=1)[:, :beam]
+        scores = np.take_along_axis(allc, top, axis=1)
+        src_beam = top // V
+        tokens = (top % V).astype(np.int64)
+        tokens = np.where(finished[np.arange(B)[:, None], src_beam],
+                          decoder.end_token, tokens)
+        finished = finished[np.arange(B)[:, None], src_beam] | \
+            (tokens == decoder.end_token)
+        # per-BATCH state backtrace: each batch element follows its own
+        # source beam (a global pick would decode batch>0 with wrong state)
+        def pick_states(b):
+            return jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(
+                    [jnp.asarray(leaves[int(src_beam[i, b])])[i]
+                     for i in range(B)]),
+                *[(st.value() if isinstance(st, Tensor) else st)
+                  for st in cand_states])
+        states = [pick_states(b) for b in range(beam)]
+        out_ids.append(tokens.copy())
+        if finished.all():
+            break
+    ids = np.stack(out_ids, axis=-1)                       # [B, beam, T]
+    return Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(scores))

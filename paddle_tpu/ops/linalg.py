@@ -304,3 +304,26 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         a = a - jnp.mean(a, axis=-2, keepdims=True)
     u, s, vt = jnp.linalg.svd(a, full_matrices=False)
     return Tensor(u[..., :q]), Tensor(s[..., :q]), Tensor(jnp.swapaxes(vt, -1, -2)[..., :q])
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu() results into P, L, U (reference lu_unpack; 2-D inputs —
+    this repo's lu() emits 1-based LAPACK pivots, handled here)."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    lu_v = x.value() if hasattr(x, "value") else jnp.asarray(x)
+    if lu_v.ndim != 2:
+        raise ValueError("lu_unpack supports 2-D factors (got "
+                         f"{lu_v.ndim}-D); unbatch first")
+    piv = _np.asarray(y.numpy() if hasattr(y, "numpy") else y).reshape(-1)
+    piv = piv.astype(_np.int64) - 1          # 1-based LAPACK -> 0-based
+    m, n = lu_v.shape
+    k = min(m, n)
+    L = jnp.tril(lu_v[:, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+    U = jnp.triu(lu_v[:k, :])
+    p_np = _np.arange(m)
+    for i, pv in enumerate(piv[:k]):
+        p_np[[i, pv]] = p_np[[pv, i]]
+    P = jnp.eye(m, dtype=lu_v.dtype)[:, p_np]
+    return Tensor(P), Tensor(L), Tensor(U)

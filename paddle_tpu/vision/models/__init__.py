@@ -8,3 +8,4 @@ from .lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
+from .extra import *  # noqa: F401,F403

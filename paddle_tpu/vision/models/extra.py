@@ -1,0 +1,526 @@
+"""Additional model zoo families: MobileNetV1/V3, SqueezeNet, DenseNet,
+GoogLeNet, InceptionV3, ShuffleNetV2.
+
+Reference analog: python/paddle/vision/models/* (API surface + architecture
+hyperparameters; the math is the published architectures). Implementations
+are composed from paddle_tpu.nn blocks — depthwise convs lower to XLA grouped
+convolutions, which the TPU conv emitter handles natively.
+"""
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ...nn import functional as F
+from ...ops import concat, split
+
+__all__ = [
+    "MobileNetV1", "mobilenet_v1", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v3_small", "mobilenet_v3_large", "SqueezeNet", "squeezenet1_0",
+    "squeezenet1_1", "DenseNet", "densenet121", "densenet161", "densenet169",
+    "densenet201", "densenet264", "GoogLeNet", "googlenet", "InceptionV3",
+    "inception_v3", "ShuffleNetV2", "shufflenet_v2_x0_25",
+    "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
+
+
+def _conv_bn(ic, oc, k, s=1, p=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(ic, oc, k, stride=s, padding=p, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(oc)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+# ------------------------------------------------------------- MobileNet v1
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        blocks = [_conv_bn(3, c(32), 3, s=2, p=1)]
+        for ic, oc, s in cfg:
+            blocks.append(_conv_bn(c(ic), c(ic), 3, s=s, p=1, groups=c(ic)))
+            blocks.append(_conv_bn(c(ic), c(oc), 1))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# ------------------------------------------------------------- MobileNet v3
+
+class _SEBlock(nn.Layer):
+    def __init__(self, ch, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // r, 1)
+        self.fc2 = nn.Conv2D(ch // r, ch, 1)
+
+    def forward(self, x):
+        s = self.fc2(F.relu(self.fc1(self.pool(x))))
+        return x * F.hardsigmoid(s)
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, ic, mid, oc, k, s, use_se, act):
+        super().__init__()
+        self.use_res = (s == 1 and ic == oc)
+        self.expand = _conv_bn(ic, mid, 1, act=act) if mid != ic else None
+        self.dw = _conv_bn(mid, mid, k, s=s, p=k // 2, groups=mid, act=act)
+        self.se = _SEBlock(mid) if use_se else None
+        self.project = _conv_bn(mid, oc, 1, act="none")
+
+    def forward(self, x):
+        h = self.expand(x) if self.expand is not None else x
+        h = self.dw(h)
+        if self.se is not None:
+            h = self.se(h)
+        h = self.project(h)
+        return x + h if self.use_res else h
+
+
+_V3_SMALL = [  # k, mid, oc, se, act, s
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale + 4) // 8 * 8)
+
+        blocks = [_conv_bn(3, c(16), 3, s=2, p=1, act="hardswish")]
+        ic = c(16)
+        for k, mid, oc, se, act, s in cfg:
+            blocks.append(_MBV3Block(ic, c(mid), c(oc), k, s, se, act))
+            ic = c(oc)
+        last_conv = c(cfg[-1][1])
+        blocks.append(_conv_bn(ic, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+# --------------------------------------------------------------- SqueezeNet
+
+class _Fire(nn.Layer):
+    def __init__(self, ic, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(ic, squeeze, 1)
+        self.e1 = nn.Conv2D(squeeze, e1, 1)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return concat([F.relu(self.e1(s)), F.relu(self.e3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# ----------------------------------------------------------------- DenseNet
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, ic, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(ic)
+        self.conv1 = nn.Conv2D(ic, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        h = self.conv1(F.relu(self.bn1(x)))
+        h = self.conv2(F.relu(self.bn2(h)))
+        return concat([x, h], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, ic, oc):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(ic)
+        self.conv = nn.Conv2D(ic, oc, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (64, 32, (6, 12, 24, 16)), 161: (96, 48, (6, 12, 36, 24)),
+              169: (64, 32, (6, 12, 32, 32)), 201: (64, 32, (6, 12, 48, 32)),
+              264: (64, 32, (6, 12, 64, 48))}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_ch, growth, blocks = _DENSE_CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_ch), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_ch
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if bi != len(blocks) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+# ----------------------------------------------------------------- GoogLeNet
+
+class _Inception(nn.Layer):
+    def __init__(self, ic, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_bn(ic, c1, 1)
+        self.b2 = nn.Sequential(_conv_bn(ic, c3r, 1), _conv_bn(c3r, c3, 3,
+                                                               p=1))
+        self.b3 = nn.Sequential(_conv_bn(ic, c5r, 1), _conv_bn(c5r, c5, 5,
+                                                               p=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_bn(ic, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_bn(3, 64, 7, s=2, p=3), nn.MaxPool2D(3, stride=2,
+                                                       padding=1),
+            _conv_bn(64, 64, 1), _conv_bn(64, 192, 3, p=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.blocks = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        # reference returns (out, aux1, aux2); aux heads are train-time only
+        return x, x, x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------- Inception
+
+class InceptionV3(nn.Layer):
+    """InceptionV3 trunk (A/B/C blocks with the published channel plan)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_bn(3, 32, 3, s=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, p=1), nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        # three Inception-A-style mixed blocks, then reduction, then B block
+        self.mixed = nn.Sequential(
+            _Inception(192, 64, 48, 64, 64, 96, 32),
+            _Inception(256, 64, 48, 64, 64, 96, 64),
+            _Inception(288, 64, 48, 64, 64, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _Inception(288, 192, 128, 320, 32, 128, 128),
+            _Inception(768, 192, 160, 320, 32, 128, 128))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.mixed(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+# -------------------------------------------------------------- ShuffleNetV2
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    return (x.reshape([n, groups, c // groups, h, w])
+            .transpose([0, 2, 1, 3, 4]).reshape([n, c, h, w]))
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, ic, oc, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = oc // 2
+        if stride == 1:
+            self.right = nn.Sequential(
+                _conv_bn(ic // 2, branch, 1, act=act),
+                _conv_bn(branch, branch, 3, s=1, p=1, groups=branch,
+                         act="none"),
+                _conv_bn(branch, branch, 1, act=act))
+            self.left = None
+        else:
+            self.right = nn.Sequential(
+                _conv_bn(ic, branch, 1, act=act),
+                _conv_bn(branch, branch, 3, s=2, p=1, groups=branch,
+                         act="none"),
+                _conv_bn(branch, branch, 1, act=act))
+            self.left = nn.Sequential(
+                _conv_bn(ic, ic, 3, s=2, p=1, groups=ic, act="none"),
+                _conv_bn(ic, branch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            l, r = split(x, [half, x.shape[1] - half], axis=1)
+            out = concat([l, self.right(r)], axis=1)
+        else:
+            out = concat([self.left(x), self.right(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CH = {0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+               0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+               1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c0, c1, c2, c3, c4 = _SHUFFLE_CH[scale]
+        self.stem = nn.Sequential(_conv_bn(3, c0, 3, s=2, p=1, act=act),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        ic = c0
+        for oc, reps in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_ShuffleUnit(ic, oc, 2, act))
+            for _ in range(reps - 1):
+                stages.append(_ShuffleUnit(oc, oc, 1, act))
+            ic = oc
+        self.stages = nn.Sequential(*stages)
+        self.head = _conv_bn(c3, c4, 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c4, num_classes)
+
+    def forward(self, x):
+        x = self.head(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)
