@@ -128,3 +128,114 @@ def is_first_worker() -> bool:
 def barrier_worker():
     from ..collective import barrier
     barrier()
+
+
+class Fleet:
+    """Object form of the fleet facade (reference fleet.Fleet — the module
+    functions above are the default instance's methods)."""
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_num(self):
+        return worker_num()
+
+    def worker_index(self):
+        return worker_index()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    @property
+    def util(self):
+        return UtilBase()
+
+
+class UtilBase:
+    """reference UtilBase: small cross-worker helpers."""
+
+    def all_reduce(self, input, mode="sum"):
+        import numpy as np
+        return np.asarray(input)  # single-controller: already global
+
+    def barrier(self):
+        from .. import collective
+        collective.barrier()
+
+    def get_file_shard(self, files):
+        import jax
+        n, i = jax.process_count(), jax.process_index()
+        return list(files)[i::n]
+
+
+class Role:
+    """reference role_maker.Role."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Env-contract role maker (reference PaddleCloudRoleMaker)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_num(self):
+        return worker_num()
+
+    def _worker_index(self):
+        return worker_index()
+
+    def _role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+        self._kw = kwargs
+
+
+class MultiSlotDataGenerator:
+    """PS data generator (reference fleet data_generator): subclass implements
+    generate_sample; run_from_stdin/files emit the slot:feasign text format."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        parts = []
+        for name, feas in sample:
+            parts.append(f"{len(feas)} " + " ".join(str(f) for f in feas))
+        return " ".join(parts)
+
+    def run_from_files(self, filelist, output_path):
+        with open(output_path, "w") as out:
+            for path in filelist:
+                with open(path) as f:
+                    for line in f:
+                        gen = self.generate_sample(line.rstrip("\n"))
+                        for sample in (gen() if callable(gen) else [gen]):
+                            out.write(self._format(sample) + "\n")
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line.rstrip("\n"))
+            for sample in (gen() if callable(gen) else [gen]):
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

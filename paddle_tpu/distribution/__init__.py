@@ -281,3 +281,118 @@ def _kl_categorical(p, q):
     logp = jax.nn.log_softmax(p.logits, axis=-1)
     logq = jax.nn.log_softmax(q.logits, axis=-1)
     return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    ExponentialFamily: natural-parameter form with Bregman-divergence
+    entropy). Subclasses supply _natural_parameters/_log_normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, concentration1=None, beta=None, name=None):
+        a = alpha
+        b = beta if beta is not None else concentration1
+        self.alpha = _val(a)
+        self.beta = _val(b)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                    self.beta.shape)
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = jnp.clip(_val(value), 1e-6, 1 - 1e-6)
+        from jax.scipy.special import betaln
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+
+    def sample(self, shape=()):
+        batch = self.concentration.shape[:-1]
+        return Tensor(jax.random.dirichlet(_key(), self.concentration,
+                                           tuple(shape) + batch))
+
+    def log_prob(self, value):
+        v = jnp.clip(_val(value), 1e-9, 1.0)
+        from jax.scipy.special import gammaln
+        c = self.concentration
+        norm = jnp.sum(gammaln(c), -1) - gammaln(jnp.sum(c, -1))
+        return Tensor(jnp.sum((c - 1) * jnp.log(v), -1) - norm)
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / jnp.sum(c, -1, keepdims=True))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value).value()
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = self.base.entropy().value()
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class TransformedDistribution(Distribution):
+    """base pushed through invertible transforms (reference
+    TransformedDistribution). Transforms provide forward / inverse /
+    forward_log_det_jacobian over Tensors."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        v = value
+        for t in reversed(self.transforms):
+            prev = t.inverse(v)
+            ldj = t.forward_log_det_jacobian(prev)
+            lp = lp - _val(ldj)
+            v = prev
+        return Tensor(_val(self.base.log_prob(v)) + lp)
+
+
+__all__ += ["Beta", "Dirichlet", "ExponentialFamily", "Independent",
+            "TransformedDistribution"]

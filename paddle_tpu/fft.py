@@ -104,3 +104,40 @@ def fftfreq(n, d=1.0, dtype=None, name=None):
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     from .core.tensor import Tensor
     return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def _s_at(s, i):
+    return None if s is None else (s[i] if i < len(s) else None)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT: fft over axes[:-1] (each with its s entry),
+    hfft over the last axis."""
+    y = fft(x, n=_s_at(s, 0), axis=axes[0], norm=norm)
+    return hfft(y, n=_s_at(s, 1), axis=axes[-1], norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    y = ihfft(x, n=_s_at(s, 1), axis=axes[-1], norm=norm)
+    return ifft(y, n=_s_at(s, 0), axis=axes[0], norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    nd = len(x.shape)
+    axes = tuple(axes) if axes is not None else tuple(range(nd))
+    y = x
+    for i, ax in enumerate(axes[:-1]):
+        y = fft(y, n=_s_at(s, i), axis=ax, norm=norm)
+    return hfft(y, n=_s_at(s, len(axes) - 1), axis=axes[-1], norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    nd = len(x.shape)
+    axes = tuple(axes) if axes is not None else tuple(range(nd))
+    y = ihfft(x, n=_s_at(s, len(axes) - 1), axis=axes[-1], norm=norm)
+    for i, ax in enumerate(axes[:-1]):
+        y = ifft(y, n=_s_at(s, i), axis=ax, norm=norm)
+    return y
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

@@ -1,3 +1,23 @@
 from .api import to_static, not_to_static, save, load, TranslatedLayer, ignore_module  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 from .train_step import TrainStep  # noqa: F401
+
+_dy2static_enabled = True
+_verbosity = 0
+
+
+def enable_to_static(flag: bool = True):
+    """Globally toggle to_static (reference enable_to_static)."""
+    global _dy2static_enabled
+    _dy2static_enabled = bool(flag)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    # reference dumps transformed AST code; trace-based to_static has no
+    # transformed source to show — accepted for parity
+    pass

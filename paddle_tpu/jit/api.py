@@ -193,6 +193,12 @@ class StaticFunction:
     # ------------------------------------------------------------------ call
 
     def __call__(self, *args, **kwargs):
+        from . import _dy2static_enabled
+        if not _dy2static_enabled:
+            # enable_to_static(False): run the original dygraph function
+            if self._instance is not None:
+                return self._fn(self._instance, *args, **kwargs)
+            return self._fn(*args, **kwargs)
         arg_tensors: List[Tensor] = []
         struct_spec = _flatten((list(args), kwargs), arg_tensors)
         training = self._instance.training if isinstance(self._instance, Layer) else None

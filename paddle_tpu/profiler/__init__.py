@@ -284,3 +284,46 @@ class Profiler:
     def reset(self):
         _recorder.events.clear()
         self._step_times.clear()
+
+
+class SortedKeys(Enum):
+    """Summary sort orders (reference profiler.SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Summary table selector (reference profiler.SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler in the reference's protobuf format slot; the
+    trace payload here is the Chrome-trace JSON (documented format
+    difference — TPU tooling consumes Chrome/perfetto traces)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.paddle_trace.pb.json")
+        prof._export_chrome(path)
+        prof.last_export_path = path
+
+    return handler
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

@@ -164,3 +164,104 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# ------------------------------------------------------- elementwise value ops
+def _unary_on_values(name, jfn):
+    def api(x: SparseCooTensor) -> SparseCooTensor:
+        b = x._bcoo
+        return SparseCooTensor(jsparse.BCOO((jfn(b.data), b.indices),
+                                            shape=b.shape))
+    api.__name__ = name
+    api.__doc__ = f"Elementwise {name} over the sparse values (zeros preserved)."
+    return api
+
+
+sin = _unary_on_values("sin", jnp.sin)
+tan = _unary_on_values("tan", jnp.tan)
+asin = _unary_on_values("asin", jnp.arcsin)
+atan = _unary_on_values("atan", jnp.arctan)
+sinh = _unary_on_values("sinh", jnp.sinh)
+tanh = _unary_on_values("tanh", jnp.tanh)
+asinh = _unary_on_values("asinh", jnp.arcsinh)
+atanh = _unary_on_values("atanh", jnp.arctanh)
+sqrt = _unary_on_values("sqrt", jnp.sqrt)
+square = _unary_on_values("square", jnp.square)
+log1p = _unary_on_values("log1p", jnp.log1p)
+abs = _unary_on_values("abs", jnp.abs)  # noqa: A001
+neg = _unary_on_values("neg", jnp.negative)
+deg2rad = _unary_on_values("deg2rad", jnp.deg2rad)
+rad2deg = _unary_on_values("rad2deg", jnp.rad2deg)
+expm1 = _unary_on_values("expm1", jnp.expm1)
+isnan = _unary_on_values("isnan", jnp.isnan)
+
+
+def pow(x: SparseCooTensor, factor) -> SparseCooTensor:  # noqa: A001
+    b = x._bcoo
+    return SparseCooTensor(jsparse.BCOO((b.data ** factor, b.indices),
+                                        shape=b.shape))
+
+
+def cast(x: SparseCooTensor, index_dtype=None, value_dtype=None):
+    from ..core.dtype import convert_dtype
+    b = x._bcoo
+    data = b.data.astype(convert_dtype(value_dtype)) if value_dtype else b.data
+    idx = b.indices.astype(convert_dtype(index_dtype)) if index_dtype \
+        else b.indices
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()
+
+
+def subtract(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    yneg = SparseCooTensor(jsparse.BCOO((-y._bcoo.data, y._bcoo.indices),
+                                        shape=y._bcoo.shape))
+    return add(x, yneg)
+
+
+def multiply(x: SparseCooTensor, y) -> SparseCooTensor:
+    b = x._bcoo
+    if isinstance(y, SparseCooTensor):
+        # same-pattern elementwise product (coalesced operands)
+        yv = y.to_dense().value()[tuple(b.indices.T)]
+        return SparseCooTensor(jsparse.BCOO((b.data * yv, b.indices),
+                                            shape=b.shape))
+    yv = _dense_value(y)
+    vals = b.data * (yv[tuple(b.indices.T)] if jnp.ndim(yv) else yv)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def divide(x: SparseCooTensor, y) -> SparseCooTensor:
+    b = x._bcoo
+    yv = _dense_value(y)
+    vals = b.data / (yv[tuple(b.indices.T)] if jnp.ndim(yv) else yv)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def transpose(x: SparseCooTensor, perm) -> SparseCooTensor:
+    b = x._bcoo
+    idx = b.indices[:, list(perm)]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
+
+
+def reshape(x: SparseCooTensor, shape) -> SparseCooTensor:
+    b = x._bcoo
+    if int(np.prod(shape)) != int(np.prod(b.shape)):
+        raise ValueError(f"cannot reshape sparse tensor of shape "
+                         f"{tuple(b.shape)} into {tuple(shape)}")
+    flat = jnp.ravel_multi_index(tuple(b.indices.T), b.shape, mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(flat, tuple(shape)), axis=1)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx),
+                                        shape=tuple(shape)))
+
+
+def mv(x: SparseCooTensor, vec) -> Tensor:
+    return Tensor(x._bcoo @ _dense_value(vec))
+
+
+def addmm(input, x: SparseCooTensor, y, beta=1.0, alpha=1.0) -> Tensor:
+    return Tensor(beta * _dense_value(input)
+                  + alpha * (x._bcoo @ _dense_value(y)))

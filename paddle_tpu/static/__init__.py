@@ -122,10 +122,6 @@ class Executor:
             "@paddle_tpu.jit.to_static and call it — the trace IS the program")
 
 
-def default_main_program():
-    raise NotImplementedError("no ProgramDesc in paddle_tpu; use jit.to_static")
-
-
 class name_scope:
     def __init__(self, name=""):
         self.name = name
@@ -135,3 +131,7 @@ class name_scope:
 
     def __exit__(self, *a):
         return False
+
+from .compat import *  # noqa: F401,F403,E402
+from .compat import __all__ as _compat_all  # noqa: E402
+__all__ = list(__all__) + list(_compat_all)

@@ -1,0 +1,474 @@
+"""Static-graph compatibility surface.
+
+Reference analog: python/paddle/static — ProgramDesc-building APIs over the
+C++ interpreter (SURVEY.md §2.2, §3.3). In this framework the "static graph"
+IS the traced jit program (jit/api.py), so most Program machinery maps onto
+trace/compile primitives; names whose job the compiler subsumes are accepted
+as configuration shells and documented as such. The load-bearing pieces —
+inference save/load, serialization round-trip, EMA, gradients — are real.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Variable", "save", "load",
+    "save_inference_model", "load_inference_model", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "cpu_places", "cuda_places",
+    "xpu_places", "device_guard", "scope_guard", "global_scope",
+    "create_global_var", "create_parameter", "accuracy", "auc", "Print",
+    "py_func", "gradients", "append_backward", "BuildStrategy",
+    "ExecutionStrategy", "CompiledProgram", "ExponentialMovingAverage",
+    "WeightNormParamAttr", "ipu_shard_guard", "IpuCompiledProgram",
+    "IpuStrategy",
+]
+
+Variable = Tensor   # static Variable == Tensor here (one runtime)
+
+
+class Program:
+    """Container for a traced region's artifacts (reference ProgramDesc).
+
+    There is no separate op-by-op graph IR: tracing produces XLA programs
+    directly. Program carries the state the reference APIs hang off it —
+    random seed, captured parameters, and (after save/load) the exported
+    module prefix."""
+
+    def __init__(self):
+        self.random_seed = 0
+        self._params: Dict[str, Any] = {}
+        self._export_prefix: Optional[str] = None
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self._params.values())
+
+    def state_dict(self, mode="all"):
+        return dict(self._params)
+
+    def set_state_dict(self, sd):
+        self._params.update(sd)
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.random_seed = self.random_seed
+        p._params = dict(self._params)
+        return p
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _prog_stack[-1] if _prog_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    _prog_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Placeholder declaration → InputSpec (the trace-time equivalent of a
+    feed Variable)."""
+    from ..jit.api import InputSpec
+    return InputSpec(shape, dtype, name=name)
+
+
+# ------------------------------------------------------------------ places
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+    import os as _os
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips here)."""
+    import jax
+    from ..core.device import TPUPlace
+    ids = device_ids if device_ids is not None else range(jax.device_count())
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference pins ops to a device inside a program; placement here is
+    sharding-driven — the guard is accepted and scoped as documentation."""
+    yield
+
+
+# ------------------------------------------------------------------- scopes
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, Tensor(np.zeros(1, np.float32)))
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_global_scope = _Scope()
+_scope_stack: List[_Scope] = []
+
+
+def global_scope() -> _Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: _Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    t = Tensor(np.full(shape, value, dtype))
+    t.persistable = persistable
+    t.name = name or ""
+    global_scope()[t.name or id(t)] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .._api_completion import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+# ----------------------------------------------------------- save/load (real)
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export a Layer for inference. fetch_vars carries the LAYER to export via
+    its `.layer` attribute or pass model= in kwargs (jit.save underneath)."""
+    from .. import jit
+    model = kwargs.get("model") or getattr(fetch_vars, "layer", None)
+    if model is None:
+        raise ValueError("pass model=<Layer> (the traced network) — the XLA "
+                         "build exports whole traced modules, not fetch lists")
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    jit.save(model, path_prefix, input_spec=list(specs))
+    target = program if program is not None else default_main_program()
+    if hasattr(target, "_export_prefix"):
+        target._export_prefix = path_prefix   # serialize_program reads this
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from .. import jit
+    layer = jit.load(path_prefix)
+    feed_names = [f"input_{i}"
+                  for i in range(len(getattr(layer, "_input_specs", []) or []))]
+    return [layer, feed_names, ["output_0"]]
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None, **kw):
+    if isinstance(program, str):
+        prefix = program                    # accept an export prefix directly
+    else:
+        target = program if program is not None else default_main_program()
+        prefix = getattr(target, "_export_prefix", None)
+    if prefix and os.path.exists(prefix + ".pdmodel"):
+        with open(prefix + ".pdmodel", "rb") as f:
+            return f.read()
+    raise ValueError("serialize_program needs a Program exported via "
+                     "save_inference_model (prefix recorded on the Program)")
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None, **kw):
+    import pickle
+    target = program if hasattr(program, "state_dict") else None
+    if target is None:
+        raise ValueError("pass program=<Layer or Program with state>")
+    from ..framework import io as fio
+    import io as _io
+    buf = _io.BytesIO()
+    pickle.dump(fio._pack(dict(target.state_dict())), buf)
+    return buf.getvalue()
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(blob: bytes):
+    from jax import export as jax_export
+    return jax_export.deserialize(blob)
+
+
+def deserialize_persistables(program, blob: bytes, executor=None):
+    import io as _io
+    import pickle
+    from ..framework import io as fio
+    state = fio._unpack(pickle.load(_io.BytesIO(blob)))
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None, **kw):
+    return program  # trace output is already the normalized executable form
+
+
+def load_program_state(model_path: str, var_list=None):
+    from ..framework import io as fio
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    return fio.load(path, return_numpy=True)
+
+
+def set_program_state(program, state_dict):
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+    return program
+
+
+# ------------------------------------------------------------------- metrics
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+    iv = input.value() if isinstance(input, Tensor) else jnp.asarray(input)
+    lv = (label.value() if isinstance(label, Tensor)
+          else jnp.asarray(label)).reshape(-1)
+    topk = jnp.argsort(-iv, axis=-1)[:, :k]
+    hit = (topk == lv[:, None]).any(axis=-1)
+    return Tensor(hit.mean(dtype=jnp.float32))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    preds = input.numpy() if isinstance(input, Tensor) else np.asarray(input)
+    labels = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+    m.update(preds, labels)
+    return Tensor(np.asarray(m.accumulate(), np.float32))
+
+
+# ---------------------------------------------------------------- op helpers
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print (reference Print op). Eager: prints now; identity return."""
+    msg = message or ""
+    arr = input.numpy() if isinstance(input, Tensor) else input
+    print(f"{msg} shape={getattr(arr, 'shape', None)} values="
+          f"{np.asarray(arr).reshape(-1)[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference py_func). Eager execution applies directly."""
+    ins = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*[t.numpy() if isinstance(t, Tensor) else t for t in ins])
+    res = res if isinstance(res, (list, tuple)) else [res]
+    outs = [Tensor(np.asarray(r)) for r in res]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) (reference static gradients == autograd here)."""
+    from ..core.autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Builds grads for the loss (reference append_backward). Returns
+    [(param, grad)] like the reference."""
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    return [(p, Tensor(p._grad) if p._grad is not None else None)
+            for p in params]
+
+
+# ----------------------------------------------------------- config shells
+
+class BuildStrategy:
+    """Fusion/exec toggles (reference BuildStrategy). XLA owns fusion; fields
+    are recorded for compatibility and ignored by compilation."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """reference CompiledProgram(program).with_data_parallel — compilation is
+    jit's job; this keeps the handle type for ported scripts."""
+
+    def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class ExponentialMovingAverage:
+    """EMA over parameters (reference static.ExponentialMovingAverage) —
+    fully functional: update() after each step, apply()/restore() around eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema: Dict[int, Any] = {}
+        self._backup: Dict[int, Any] = {}
+        self._params: List[Parameter] = []
+        self._step = 0
+
+    def register(self, parameters):
+        self._params = list(parameters)
+        for p in self._params:
+            self._ema[id(p)] = p.value()
+
+    def update(self):
+        import jax.numpy as jnp
+        if not self._params:
+            raise ValueError("call register(parameters) first")
+        self._step += 1
+        # Adam-style bias-corrected dynamic decay (reference formula)
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * p.value()
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p.value()
+            p._data = self._ema[id(p)]
+            p._version += 1
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+                p._version += 1
+
+
+class WeightNormParamAttr:
+    """reference WeightNormParamAttr; weight-norm reparameterization is
+    available as nn.utils-style wrapper — this records the config."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+# ------------------------------------------------------------------ IPU stubs
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """No IPU hardware in the TPU build; accepted for import parity."""
+    yield
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.config = {}
+
+    def set_graph_config(self, **kw):
+        self.config.update(kw)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise NotImplementedError("IPU backend does not exist in the TPU "
+                                  "build; use the default jit path")
+
+
+def save(program, model_path, protocol=4, **configs):
+    from ..framework import io as fio
+    fio.save(dict(program.state_dict()) if hasattr(program, "state_dict")
+             else program, model_path)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework import io as fio
+    state = fio.load(model_path)
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    """IPU sharding annotation — no IPU backend here; returns the layer."""
+    return layer
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics bundle (reference PS-era helper): returns (auc, batch_auc,
+    [stat tensors])."""
+    a = auc(input, label)
+    return a, a, []
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """Legacy LR schedule constructor (reference static exponential_decay) —
+    returns the dygraph ExponentialDecay scheduler."""
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+
+__all__ += ["set_ipu_shard", "ctr_metric_bundle", "exponential_decay"]

@@ -2,3 +2,29 @@
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend: str):
+    """reference set_image_backend (pil/cv2); this build decodes via numpy
+    (+PIL when importable)."""
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path: str, backend=None):
+    """Load an image file to an HWC array (.npy always; PIL for encoded)."""
+    import numpy as np
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+    except ImportError:
+        raise RuntimeError("image_load needs PIL for encoded images; "
+                           "save arrays as .npy in this environment")
+    return np.asarray(Image.open(path))

@@ -8,3 +8,4 @@ from .transforms import (  # noqa: F401
     RandomVerticalFlip, Normalize, Transpose, ToTensor, Pad, BrightnessTransform,
     ContrastTransform, RandomResizedCrop,
 )
+from .extended import *  # noqa: F401,F403

@@ -1,0 +1,390 @@
+"""Remaining transforms surface: functional ops (flip/pad/crop/color/warp)
+and the randomized class transforms built on them.
+
+Reference analog: python/paddle/vision/transforms/{functional,transforms}.py
+(PIL/cv2 backends there; pure numpy here — HWC uint8/float arrays, bilinear
+warps via inverse mapping)."""
+from __future__ import annotations
+
+import math
+import numbers
+import random as _random
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .transforms import Compose, Resize, _as_hwc, _resize
+
+__all__ = [
+    "BaseTransform", "SaturationTransform", "HueTransform", "ColorJitter",
+    "RandomAffine", "RandomRotation", "RandomPerspective", "Grayscale",
+    "RandomErasing", "to_tensor", "hflip", "vflip", "resize", "pad", "affine",
+    "rotate", "perspective", "to_grayscale", "crop", "center_crop",
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "normalize", "erase",
+]
+
+
+# ---------------------------------------------------------------- functional
+
+def to_tensor(pic, data_format="CHW"):
+    from ...core.tensor import Tensor
+    raw = _as_hwc(pic)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:       # dtype-keyed, like the reference
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize(img, size, interpolation)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        l = r = t = b = int(padding)
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    h, w = img.shape[:2]
+    return crop(img, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img).astype(np.float32)
+    gray = img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _as_hwc(img)
+    out = img.astype(np.float32) * brightness_factor
+    return _clip_like(out, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    mean = to_grayscale(f).mean()
+    out = (f - mean) * contrast_factor + mean
+    return _clip_like(out, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    gray = to_grayscale(f, 3)
+    out = gray + (f - gray) * saturation_factor
+    return _clip_like(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate the hue channel by hue_factor (in [-0.5, 0.5] turns)."""
+    assert -0.5 <= hue_factor <= 0.5
+    img = _as_hwc(img)
+    f = img.astype(np.float32) / (255.0 if img.dtype == np.uint8 else 1.0)
+    mx = f.max(-1)
+    mn = f.min(-1)
+    diff = mx - mn + 1e-8
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    s = np.where(mx > 0, diff / (mx + 1e-8), 0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6)
+    fr = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - fr * s)
+    t = v * (1 - (1 - fr) * s)
+    i = i.astype(int) % 6
+    conds = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+             np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+             np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
+    out = np.select([i[..., None] == k for k in range(6)], conds)
+    if img.dtype == np.uint8:
+        out = out * 255.0
+    return _clip_like(out, img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _as_hwc(img) if not inplace else img
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def _clip_like(out, ref):
+    if ref.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(ref.dtype)
+
+
+def _warp(img, inv_matrix, fill=0):
+    """Inverse-mapped bilinear warp (3x3 homography, numpy)."""
+    img = _as_hwc(img).astype(np.float32)
+    h, w = img.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = inv_matrix @ coords
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    x0 = np.floor(sx).astype(int)
+    y0 = np.floor(sy).astype(int)
+    fx = sx - x0
+    fy = sy - y0
+
+    def fetch(yy, xx):
+        inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = np.clip(yy, 0, h - 1)
+        xc = np.clip(xx, 0, w - 1)
+        vals = img[yc, xc]
+        vals[~inside] = fill
+        return vals
+
+    out = (fetch(y0, x0) * ((1 - fx) * (1 - fy))[:, None]
+           + fetch(y0, x0 + 1) * (fx * (1 - fy))[:, None]
+           + fetch(y0 + 1, x0) * ((1 - fx) * fy)[:, None]
+           + fetch(y0 + 1, x0 + 1) * (fx * fy)[:, None])
+    return out.reshape(h, w, img.shape[2])
+
+
+def _affine_inv(angle, translate, scale, shear, center):
+    a = math.radians(angle)
+    sx, sy = (math.radians(s) for s in (shear if isinstance(shear, (list,
+                                        tuple)) else (shear, 0.0)))
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix: T(center) R S Shear T(-center) + translate
+    m = np.array([[math.cos(a + sy) * scale, -math.sin(a + sx) * scale, 0],
+                  [math.sin(a + sy) * scale, math.cos(a + sx) * scale, 0],
+                  [0, 0, 1]], np.float32)
+    pre = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float32)
+    post = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]], np.float32)
+    fwd = post @ m @ pre
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    c = center or ((w - 1) / 2, (h - 1) / 2)
+    out = _warp(img, _affine_inv(angle, translate, scale, shear, c), fill)
+    return _clip_like(out, img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    return affine(img, angle=angle, fill=fill, center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Warp mapping startpoints -> endpoints (4 corners each)."""
+    img = _as_hwc(img)
+    A = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        A.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        b += [ex, ey]
+    coeffs = np.linalg.lstsq(np.asarray(A, np.float32),
+                             np.asarray(b, np.float32), rcond=None)[0]
+    fwd = np.append(coeffs, 1).reshape(3, 3)
+    out = _warp(img, np.linalg.inv(fwd), fill)
+    return _clip_like(out, img)
+
+
+# -------------------------------------------------------------------- classes
+
+class BaseTransform:
+    """reference BaseTransform: keys-aware __call__ dispatching to _apply_*."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            return type(inputs)(self._apply_image(i) for i in inputs)
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value=0.0, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + _random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value=0.0, keys=None):
+        super().__init__(keys)
+        self.value = min(value, 0.5)
+
+    def _apply_image(self, img):
+        return adjust_hue(img, _random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0,
+                 keys=None):
+        super().__init__(keys)
+        self.b, self.c, self.s, self.h = brightness, contrast, saturation, \
+            min(hue, 0.5)
+
+    def _apply_image(self, img):
+        ops = []
+        if self.b:
+            ops.append(lambda im: adjust_brightness(
+                im, 1 + _random.uniform(-self.b, self.b)))
+        if self.c:
+            ops.append(lambda im: adjust_contrast(
+                im, 1 + _random.uniform(-self.c, self.c)))
+        if self.s:
+            ops.append(lambda im: adjust_saturation(
+                im, 1 + _random.uniform(-self.s, self.s)))
+        if self.h:
+            ops.append(lambda im: adjust_hue(
+                im, _random.uniform(-self.h, self.h)))
+        _random.shuffle(ops)
+        for op in ops:
+            img = op(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return rotate(img, _random.uniform(*self.degrees), center=self.center,
+                      fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = _as_hwc(img).shape[:2]
+        angle = _random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = _random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = _random.uniform(*self.scale) if self.scale else 1.0
+        sh = _random.uniform(-self.shear, self.shear) \
+            if isinstance(self.shear, numbers.Number) else 0.0
+        return affine(img, angle, (tx, ty), sc, (sh, 0.0), fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.d = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if _random.random() > self.prob:
+            return img
+        h, w = _as_hwc(img).shape[:2]
+        dx = int(self.d * w / 2)
+        dy = int(self.d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(_random.randint(0, dx), _random.randint(0, dy)),
+               (w - 1 - _random.randint(0, dx), _random.randint(0, dy)),
+               (w - 1 - _random.randint(0, dx), h - 1 - _random.randint(0, dy)),
+               (_random.randint(0, dx), h - 1 - _random.randint(0, dy))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if _random.random() > self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w * _random.uniform(*self.scale)
+        aspect = _random.uniform(*self.ratio)
+        eh = min(h, max(1, int(round(math.sqrt(area * aspect)))))
+        ew = min(w, max(1, int(round(math.sqrt(area / aspect)))))
+        i = _random.randint(0, h - eh)
+        j = _random.randint(0, w - ew)
+        return erase(arr, i, j, eh, ew, self.value)

@@ -32,6 +32,21 @@ def _ref_all(path):
     ("/root/reference/python/paddle/vision/models/__init__.py",
      "vision.models"),
     ("/root/reference/python/paddle/optimizer/__init__.py", "optimizer"),
+    ("/root/reference/python/paddle/static/__init__.py", "static"),
+    ("/root/reference/python/paddle/jit/__init__.py", "jit"),
+    ("/root/reference/python/paddle/io/__init__.py", "io"),
+    ("/root/reference/python/paddle/amp/__init__.py", "amp"),
+    ("/root/reference/python/paddle/metric/__init__.py", "metric"),
+    ("/root/reference/python/paddle/vision/__init__.py", "vision"),
+    ("/root/reference/python/paddle/vision/transforms/__init__.py",
+     "vision.transforms"),
+    ("/root/reference/python/paddle/sparse/__init__.py", "sparse"),
+    ("/root/reference/python/paddle/distribution/__init__.py",
+     "distribution"),
+    ("/root/reference/python/paddle/profiler/__init__.py", "profiler"),
+    ("/root/reference/python/paddle/fft.py", "fft"),
+    ("/root/reference/python/paddle/distributed/fleet/__init__.py",
+     "distributed.fleet"),
 ])
 def test_namespace_parity(ref_path, ours):
     mod = paddle
@@ -180,3 +195,64 @@ def test_grid_sample_border_mode():
     out_z = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
                           padding_mode="zeros")
     assert float(out_z.numpy().max()) == 0.0
+
+
+def test_transforms_functional_correctness():
+    from paddle_tpu.vision import transforms as T
+
+    img = (np.arange(48, dtype="float32").reshape(4, 4, 3))
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    assert T.pad(img, 1).shape == (6, 6, 3)
+    assert T.center_crop(img, 2).shape == (2, 2, 3)
+    g = T.to_grayscale(img)
+    assert g.shape == (4, 4, 1)
+    b = T.adjust_brightness(img, 2.0)
+    np.testing.assert_allclose(b, img * 2)
+    # identity affine returns the image
+    same = T.affine(img, angle=0.0)
+    np.testing.assert_allclose(same, img, atol=1e-3)
+    rot = T.rotate(img, 180.0)
+    np.testing.assert_allclose(rot[..., 0], img[::-1, ::-1, 0], atol=1e-2)
+    t = T.to_tensor((img / 48 * 255).astype("uint8"))
+    assert tuple(t.shape) == (3, 4, 4) and float(t.numpy().max()) <= 1.0
+    jit = T.ColorJitter(0.2, 0.2, 0.2, 0.1)
+    assert jit(img.astype("uint8")).shape == img.shape
+
+
+def test_static_inference_save_load_and_ema(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "sinf")
+    paddle.static.save_inference_model(
+        prefix, [paddle.static.InputSpec([-1, 4], "float32")], None,
+        model=net)
+    layer, feeds, fetches = paddle.static.load_inference_model(prefix)
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+    np.testing.assert_allclose(layer(paddle.to_tensor(x)).numpy(),
+                               net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    with paddle.static.program_guard(paddle.static.Program()):
+        spec = paddle.static.data("x", [-1, 4])
+        assert spec.name == "x"
+
+
+def test_sparse_value_ops():
+    sp = paddle.sparse.sparse_coo_tensor([[0, 1], [1, 0]], [1.0, -4.0],
+                                         [2, 2])
+    t = paddle.sparse.tanh(sp)
+    np.testing.assert_allclose(t.values().numpy(),
+                               np.tanh([1.0, -4.0]), rtol=1e-6)
+    sq = paddle.sparse.square(sp)
+    np.testing.assert_allclose(sq.values().numpy(), [1.0, 16.0])
+    tr = paddle.sparse.transpose(sp, [1, 0])
+    np.testing.assert_allclose(tr.to_dense().numpy(),
+                               sp.to_dense().numpy().T)
+    r = paddle.sparse.reshape(sp, [4])
+    assert r.shape == [4]
+    mvout = paddle.sparse.mv(sp, paddle.to_tensor(
+        np.array([1.0, 2.0], "float32")))
+    np.testing.assert_allclose(mvout.numpy(), [2.0, -4.0])
