@@ -337,3 +337,107 @@ def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
             total += 2 * int(np.prod(w.shape))
     batch_elems = int(np.prod(input_size[:1])) if input_size else 1
     return total * max(batch_elems, 1)
+
+
+# ------------------------------------------------- Tensor method completion
+def _patch_tensor_methods():
+    """Reference tensor_method_func: every listed fn is also a Tensor method."""
+    import jax.numpy as _jnp
+
+    from .nn import functional as _F
+    from .ops import erfinv, flatten, lerp, put_along_axis
+
+    T = Tensor
+    for name, fn in [
+        ("add_n", lambda s, xs=None: add_n([s] + list(xs or []))),
+        ("floor_mod", floor_mod),
+        ("broadcast_shape", lambda s, other: broadcast_shape(s.shape, other)),
+        ("reverse", reverse),
+        ("vsplit", vsplit),
+        ("nanmedian", nanmedian),
+        ("quantile", quantile),
+        ("nanquantile", nanquantile),
+        ("is_complex", is_complex),
+        ("is_integer", is_integer),
+        ("is_floating_point", is_floating_point),
+        ("diagonal", diagonal),
+        ("frexp", frexp),
+        ("trapezoid", lambda s, *a, **k: __import__("paddle_tpu")
+         .trapezoid(s, *a, **k)),
+        ("cumulative_trapezoid", cumulative_trapezoid),
+        ("polar", lambda s, angle: __import__("paddle_tpu").polar(s, angle)),
+        ("sigmoid", lambda s: _F.sigmoid(s)),
+    ]:
+        if not hasattr(T, name):
+            setattr(T, name, fn)
+
+    def _mk_inp(out_fn):
+        def method(t, *a, **k):
+            from .core.dispatch import in_trace, trace_ctx
+            out = out_fn(t, *a, **k)
+            arr = out.value()
+            if tuple(arr.shape) != tuple(t.shape):
+                # shape-changing inplace op: still record under a trace so
+                # TraceContext.restore() un-leaks the tracer
+                if in_trace():
+                    ctx = trace_ctx()
+                    if ctx is not None:
+                        ctx.record_buffer_update(t, arr)
+                    t._data = arr
+                else:
+                    t._data = arr
+                    t._version += 1
+            else:
+                t._set_value_inplace(arr)
+            return t
+        return method
+
+    from .ops import mod as _mod
+    if not hasattr(T, "remainder_"):
+        T.remainder_ = _mk_inp(_mod)
+    if not hasattr(T, "flatten_"):
+        T.flatten_ = _mk_inp(flatten)
+    if not hasattr(T, "lerp_"):
+        T.lerp_ = _mk_inp(lerp)
+    if not hasattr(T, "erfinv_"):
+        T.erfinv_ = _mk_inp(erfinv)
+    if not hasattr(T, "put_along_axis_"):
+        T.put_along_axis_ = _mk_inp(put_along_axis)
+    if not hasattr(T, "sigmoid_"):
+        T.sigmoid_ = _mk_inp(lambda s: _F.sigmoid(s))
+
+    def exponential_(t, lam=1.0, name=None):
+        import jax as _jax
+        from .core import random as _rng
+        arr = _jax.random.exponential(_rng.split_key(),
+                                      tuple(t.shape)) / lam
+        t._set_value_inplace(arr.astype(t.value().dtype))
+        return t
+
+    if not hasattr(T, "exponential_"):
+        T.exponential_ = exponential_
+
+    from .ops import linalg as _lin
+    if not hasattr(T, "inverse"):
+        T.inverse = _lin.inv
+    if not hasattr(T, "lu_unpack"):
+        T.lu_unpack = lambda s, y, *a, **k: _lin.lu_unpack(s, y, *a, **k)
+    if not hasattr(T, "multi_dot"):
+        T.multi_dot = lambda s, others: _lin.multi_dot([s] + list(others))
+    if not hasattr(T, "broadcast_tensors"):
+        from .ops import broadcast_tensors as _bt
+        T.broadcast_tensors = lambda s, others: _bt([s] + list(others))
+    if not hasattr(T, "is_tensor"):
+        T.is_tensor = staticmethod(lambda x: isinstance(x, Tensor))
+    if not hasattr(T, "create_parameter"):
+        T.create_parameter = staticmethod(create_parameter)
+    if not hasattr(T, "create_tensor"):
+        T.create_tensor = staticmethod(
+            lambda dtype="float32", *a, **k: Tensor(
+                np.zeros([0], np.dtype(str(dtype).replace("paddle.", "")))))
+    if not hasattr(T, "vander"):
+        from .ops import vander as _vander
+        T.vander = _vander
+
+
+_patch_tensor_methods()

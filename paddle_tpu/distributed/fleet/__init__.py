@@ -161,8 +161,20 @@ class UtilBase:
     """reference UtilBase: small cross-worker helpers."""
 
     def all_reduce(self, input, mode="sum"):
+        import jax
         import numpy as np
-        return np.asarray(input)  # single-controller: already global
+        arr = np.asarray(input)
+        if jax.process_count() <= 1:
+            return arr            # single-controller: already global
+        from jax.experimental import multihost_utils
+        gathered = np.asarray(multihost_utils.process_allgather(arr))
+        if mode == "sum":
+            return gathered.sum(axis=0)
+        if mode == "max":
+            return gathered.max(axis=0)
+        if mode == "min":
+            return gathered.min(axis=0)
+        raise ValueError(f"unsupported mode {mode!r}")
 
     def barrier(self):
         from .. import collective

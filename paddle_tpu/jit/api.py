@@ -196,8 +196,7 @@ class StaticFunction:
         from . import _dy2static_enabled
         if not _dy2static_enabled:
             # enable_to_static(False): run the original dygraph function
-            if self._instance is not None:
-                return self._fn(self._instance, *args, **kwargs)
+            # (_fn is already bound when created via StaticFunctionBound)
             return self._fn(*args, **kwargs)
         arg_tensors: List[Tensor] = []
         struct_spec = _flatten((list(args), kwargs), arg_tensors)

@@ -224,10 +224,16 @@ def subtract(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
 def multiply(x: SparseCooTensor, y) -> SparseCooTensor:
     b = x._bcoo
     if isinstance(y, SparseCooTensor):
-        # same-pattern elementwise product (coalesced operands)
-        yv = y.to_dense().value()[tuple(b.indices.T)]
-        return SparseCooTensor(jsparse.BCOO((b.data * yv, b.indices),
-                                            shape=b.shape))
+        # index-match on host (no densification: O(nse), not O(prod(shape)))
+        yb = y._bcoo.sum_duplicates()
+        ymap = {tuple(ix): i for i, ix in
+                enumerate(np.asarray(yb.indices))}
+        yvals = np.asarray(yb.data)
+        gathered = np.array(
+            [yvals[ymap[tuple(ix)]] if tuple(ix) in ymap else 0
+             for ix in np.asarray(b.indices)], yvals.dtype)
+        return SparseCooTensor(jsparse.BCOO(
+            (b.data * jnp.asarray(gathered), b.indices), shape=b.shape))
     yv = _dense_value(y)
     vals = b.data * (yv[tuple(b.indices.T)] if jnp.ndim(yv) else yv)
     return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))

@@ -292,8 +292,9 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
     """Debug print (reference Print op). Eager: prints now; identity return."""
     msg = message or ""
     arr = input.numpy() if isinstance(input, Tensor) else input
-    print(f"{msg} shape={getattr(arr, 'shape', None)} values="
-          f"{np.asarray(arr).reshape(-1)[:summarize]}")
+    flat = np.asarray(arr).reshape(-1)
+    shown = flat if summarize < 0 else flat[:summarize]
+    print(f"{msg} shape={getattr(arr, 'shape', None)} values={shown}")
     return input
 
 
@@ -318,7 +319,22 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     """Builds grads for the loss (reference append_backward). Returns
     [(param, grad)] like the reference."""
     loss.backward(retain_graph=True)
-    params = parameter_list or []
+    params = parameter_list
+    if params is None:
+        # reference default: every trainable parameter on the loss's graph
+        from ..core.tensor import Parameter
+        params, seen, stack = [], set(), [loss._grad_node]
+        while stack:
+            node = stack.pop()
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            for t in node.input_tensors:
+                if isinstance(t, Parameter) and id(t) not in seen:
+                    seen.add(id(t))
+                    params.append(t)
+                if t._grad_node is not None:
+                    stack.append(t._grad_node)
     return [(p, Tensor(p._grad) if p._grad is not None else None)
             for p in params]
 

@@ -214,7 +214,18 @@ def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    return affine(img, angle=angle, fill=fill, center=center)
+    if not expand:
+        return affine(img, angle=angle, fill=fill, center=center)
+    # expand: enlarge the canvas to hold the whole rotated image
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    a = math.radians(angle % 360)
+    nw = int(math.ceil(abs(w * math.cos(a)) + abs(h * math.sin(a))))
+    nh = int(math.ceil(abs(w * math.sin(a)) + abs(h * math.cos(a))))
+    pl, pt = (nw - w) // 2, (nh - h) // 2
+    padded = np.pad(arr, ((pt, nh - h - pt), (pl, nw - w - pl), (0, 0)),
+                    constant_values=fill)
+    return affine(padded, angle=angle, fill=fill)
 
 
 def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
@@ -339,9 +350,16 @@ class RandomAffine(BaseTransform):
             tx = _random.uniform(-self.translate[0], self.translate[0]) * w
             ty = _random.uniform(-self.translate[1], self.translate[1]) * h
         sc = _random.uniform(*self.scale) if self.scale else 1.0
-        sh = _random.uniform(-self.shear, self.shear) \
-            if isinstance(self.shear, numbers.Number) else 0.0
-        return affine(img, angle, (tx, ty), sc, (sh, 0.0), fill=self.fill,
+        if isinstance(self.shear, numbers.Number):
+            sh = (_random.uniform(-self.shear, self.shear), 0.0)
+        elif self.shear is not None and len(self.shear) == 2:
+            sh = (_random.uniform(self.shear[0], self.shear[1]), 0.0)
+        elif self.shear is not None and len(self.shear) == 4:
+            sh = (_random.uniform(self.shear[0], self.shear[1]),
+                  _random.uniform(self.shear[2], self.shear[3]))
+        else:
+            sh = (0.0, 0.0)
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
                       center=self.center)
 
 

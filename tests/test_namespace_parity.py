@@ -256,3 +256,33 @@ def test_sparse_value_ops():
     mvout = paddle.sparse.mv(sp, paddle.to_tensor(
         np.array([1.0, 2.0], "float32")))
     np.testing.assert_allclose(mvout.numpy(), [2.0, -4.0])
+
+
+def test_tensor_method_parity():
+    """Every name in the reference's tensor_method_func list is a Tensor
+    method here."""
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    names = []
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "tensor_method_func":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    assert len(names) > 200
+    missing = [n for n in names if not hasattr(paddle.Tensor, n)]
+    assert not missing, f"Tensor missing methods: {missing}"
+
+    # spot-check the newly patched ones behave
+    t = paddle.to_tensor(np.array([[4.0, 7.0], [2.0, 6.0]], "float32"))
+    inv = t.inverse()
+    np.testing.assert_allclose((t.numpy() @ inv.numpy()), np.eye(2),
+                               atol=1e-5)
+    s = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    s.sigmoid_()
+    np.testing.assert_allclose(s.numpy(), 1 / (1 + np.exp(-np.array([1.0, 2.0]))),
+                               rtol=1e-6)
+    q = paddle.to_tensor(np.arange(5, dtype="float32")).quantile(0.5)
+    assert float(q.numpy()) == 2.0
+    f = paddle.to_tensor(np.zeros((2, 3), "float32"))
+    f.flatten_()
+    assert tuple(f.shape) == (6,)
