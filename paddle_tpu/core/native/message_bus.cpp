@@ -1,0 +1,315 @@
+// MessageBus: native actor mailboxes with in-process and TCP delivery.
+//
+// Reference analog: paddle/fluid/distributed/fleet_executor/message_bus.cc —
+// the transport under the actor-based pipeline runtime (Carrier/Interceptor).
+// There, InterceptorMessage protos travel through an in-proc queue for
+// same-rank actors and brpc across ranks. Here the same routing contract is a
+// single C++ translation unit: every actor id owns a condvar mailbox; sends to
+// a local actor push directly, sends to a remote actor write a length-prefixed
+// frame to that rank's socket, and a receiver thread demuxes inbound frames
+// into mailboxes. Payloads are opaque bytes (the Python layer pickles).
+//
+// Frame wire format (little-endian): [i64 src][i64 dst][i32 type][i32 len][payload]
+//
+// C ABI (ctypes-bound from paddle_tpu/distributed/fleet_executor/bus.py):
+//   bus_create(rank) -> handle
+//   bus_listen(bus, port) -> bound port (0 = ephemeral)
+//   bus_connect(bus, rank, host, port) -> 0/-1
+//   bus_route(bus, actor_id, rank)            routing table entry
+//   bus_open_mailbox(bus, actor_id)           local mailbox (actor lives here)
+//   bus_send(bus, src, dst, type, payload, len) -> 0 ok, -1 no route/peer
+//   bus_recv(bus, actor_id, &src, &type, buf, cap, timeout_ms)
+//       -> payload length (<= cap, message consumed), -1 timeout,
+//          -3 if the pending message is larger than cap (left queued; the
+//          required size is written to *src — call again with that buffer),
+//          -2 unknown mailbox
+//   bus_destroy(bus)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Msg {
+  int64_t src;
+  int32_t type;
+  std::string payload;
+};
+
+struct Mailbox {
+  std::deque<Msg> q;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Peer {
+  int fd = -1;
+  std::mutex write_mu;
+};
+
+struct Bus {
+  int rank = 0;
+  std::mutex mu;  // guards mailboxes/routes/peers maps (not mailbox queues)
+  std::map<int64_t, std::unique_ptr<Mailbox>> mailboxes;
+  std::map<int64_t, int> routes;           // actor id -> rank
+  std::map<int, std::unique_ptr<Peer>> peers;  // rank -> outbound socket
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> readers;
+  std::vector<int> reader_fds;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void deliver_local(Bus* bus, int64_t src, int64_t dst, int32_t type,
+                   const char* payload, int32_t len) {
+  Mailbox* mb = nullptr;
+  {
+    std::lock_guard<std::mutex> g(bus->mu);
+    auto it = bus->mailboxes.find(dst);
+    if (it == bus->mailboxes.end()) {
+      // auto-open: a frame can arrive before the interceptor thread opened
+      // its mailbox (rank startup races are the norm, not the exception)
+      auto mbp = std::make_unique<Mailbox>();
+      mb = mbp.get();
+      bus->mailboxes.emplace(dst, std::move(mbp));
+    } else {
+      mb = it->second.get();
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(mb->mu);
+    mb->q.push_back(Msg{src, type, std::string(payload, payload + len)});
+  }
+  mb->cv.notify_all();
+}
+
+void reader_loop(Bus* bus, int fd) {
+  while (!bus->stop.load()) {
+    char hdr[24];
+    if (!read_full(fd, hdr, sizeof(hdr))) break;
+    int64_t src, dst;
+    int32_t type, len;
+    std::memcpy(&src, hdr, 8);
+    std::memcpy(&dst, hdr + 8, 8);
+    std::memcpy(&type, hdr + 16, 4);
+    std::memcpy(&len, hdr + 20, 4);
+    if (len < 0 || len > (1 << 30)) break;
+    std::string payload(static_cast<size_t>(len), '\0');
+    if (len > 0 && !read_full(fd, &payload[0], payload.size())) break;
+    deliver_local(bus, src, dst, type, payload.data(),
+                  static_cast<int32_t>(payload.size()));
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bus_create(int rank) {
+  auto* bus = new Bus();
+  bus->rank = rank;
+  return bus;
+}
+
+int bus_listen(void* h, int port) {
+  auto* bus = static_cast<Bus*>(h);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  bus->listen_fd = fd;
+  bus->accept_thread = std::thread([bus]() {
+    while (!bus->stop.load()) {
+      int cfd = ::accept(bus->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(bus->mu);
+      bus->reader_fds.push_back(cfd);
+      bus->readers.emplace_back(reader_loop, bus, cfd);
+    }
+  });
+  return ntohs(addr.sin_port);
+}
+
+int bus_connect(void* h, int rank, const char* host, int port) {
+  auto* bus = static_cast<Bus*>(h);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  // bounded retry: the peer's listener may not be up yet at job start
+  for (int attempt = 0; attempt < 200; ++attempt) {
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto peer = std::make_unique<Peer>();
+      peer->fd = fd;
+      std::lock_guard<std::mutex> g(bus->mu);
+      bus->peers[rank] = std::move(peer);
+      return 0;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    ::close(fd);
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+  }
+  ::close(fd);
+  return -1;
+}
+
+void bus_route(void* h, int64_t actor_id, int rank) {
+  auto* bus = static_cast<Bus*>(h);
+  std::lock_guard<std::mutex> g(bus->mu);
+  bus->routes[actor_id] = rank;
+}
+
+void bus_open_mailbox(void* h, int64_t actor_id) {
+  auto* bus = static_cast<Bus*>(h);
+  std::lock_guard<std::mutex> g(bus->mu);
+  if (!bus->mailboxes.count(actor_id))
+    bus->mailboxes.emplace(actor_id, std::make_unique<Mailbox>());
+  bus->routes[actor_id] = bus->rank;
+}
+
+int bus_send(void* h, int64_t src, int64_t dst, int type,
+             const char* payload, int len) {
+  auto* bus = static_cast<Bus*>(h);
+  int dst_rank;
+  {
+    std::lock_guard<std::mutex> g(bus->mu);
+    auto it = bus->routes.find(dst);
+    if (it == bus->routes.end()) return -1;  // no route: fail at the send site
+    dst_rank = it->second;
+  }
+  if (dst_rank == bus->rank) {
+    deliver_local(bus, src, dst, type, payload, len);
+    return 0;
+  }
+  Peer* peer = nullptr;
+  {
+    std::lock_guard<std::mutex> g(bus->mu);
+    auto it = bus->peers.find(dst_rank);
+    if (it == bus->peers.end()) return -1;
+    peer = it->second.get();
+  }
+  char hdr[24];
+  int64_t s = src, d = dst;
+  int32_t t = type, l = len;
+  std::memcpy(hdr, &s, 8);
+  std::memcpy(hdr + 8, &d, 8);
+  std::memcpy(hdr + 16, &t, 4);
+  std::memcpy(hdr + 20, &l, 4);
+  std::lock_guard<std::mutex> g(peer->write_mu);
+  if (!write_full(peer->fd, hdr, sizeof(hdr))) return -1;
+  if (len > 0 && !write_full(peer->fd, payload, static_cast<size_t>(len)))
+    return -1;
+  return 0;
+}
+
+int bus_recv(void* h, int64_t actor_id, int64_t* src, int* type,
+             char* buf, int cap, int timeout_ms) {
+  auto* bus = static_cast<Bus*>(h);
+  Mailbox* mb = nullptr;
+  {
+    std::lock_guard<std::mutex> g(bus->mu);
+    auto it = bus->mailboxes.find(actor_id);
+    if (it == bus->mailboxes.end()) return -2;
+    mb = it->second.get();
+  }
+  std::unique_lock<std::mutex> lk(mb->mu);
+  if (mb->q.empty()) {
+    if (timeout_ms < 0) {
+      mb->cv.wait(lk, [&] { return !mb->q.empty(); });
+    } else if (!mb->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                [&] { return !mb->q.empty(); })) {
+      return -1;
+    }
+  }
+  Msg& m = mb->q.front();
+  int n = static_cast<int>(m.payload.size());
+  if (n > cap) {
+    *src = n;  // required buffer size; caller retries with exactly this
+    return -3;
+  }
+  *src = m.src;
+  *type = m.type;
+  if (n > 0) std::memcpy(buf, m.payload.data(), static_cast<size_t>(n));
+  mb->q.pop_front();
+  return n;
+}
+
+void bus_destroy(void* h) {
+  auto* bus = static_cast<Bus*>(h);
+  bus->stop.store(true);
+  if (bus->listen_fd >= 0) ::shutdown(bus->listen_fd, SHUT_RDWR);
+  if (bus->listen_fd >= 0) ::close(bus->listen_fd);
+  if (bus->accept_thread.joinable()) bus->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(bus->mu);
+    for (auto& kv : bus->peers)
+      if (kv.second->fd >= 0) ::close(kv.second->fd);
+    // unblock reader threads stuck in recv(); reader_loop closes each fd
+    for (int fd : bus->reader_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : bus->readers)
+    if (t.joinable()) t.join();
+  delete bus;
+}
+
+}  // extern "C"

@@ -1,0 +1,404 @@
+"""Actor-based pipeline runtime (fleet executor).
+
+Reference analog: paddle/fluid/distributed/fleet_executor/ — FleetExecutor
+builds a RuntimeGraph of TaskNodes; a Carrier spawns Interceptor actors
+(source/compute/amplifier/sink/cond) that exchange InterceptorMessage
+(DATA_IS_READY downstream, DATA_IS_USELESS credit upstream) over an in-proc
+queue or brpc MessageBus across ranks; it also backs distributed inference
+(DistModel, dist_model.cc).
+
+TPU-native redesign: the transport is a native C++ bus
+(core/native/message_bus.cpp, condvar mailboxes + TCP frames) and the actors
+are Python threads whose "programs" are callables dispatching jax work — the
+actual math still compiles to XLA executables; the actor layer only decides
+WHEN each micro-batch's stage runs and WHERE its output goes, which is exactly
+the part of pipeline orchestration XLA's single-program model doesn't express
+across processes. Credit-based flow control (buffer sizes on edges) gives the
+same bounded-memory 1F1B-style backpressure the reference gets from its
+interceptor buffers.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from .bus import DATA_IS_READY, DATA_IS_USELESS, STOP, MessageBus
+from .dist_model import DistModel, DistModelConfig
+
+__all__ = ["TaskNode", "RuntimeGraph", "Carrier", "FleetExecutor",
+           "MessageBus", "DistModel", "DistModelConfig"]
+
+_NODE_LOCK = threading.Lock()
+_NODE_COUNTER = [1 << 20]  # auto ids start high so explicit small ids can't collide
+
+
+class TaskNode:
+    """One actor in the runtime graph (reference task_node.cc).
+
+    role: "source" | "compute" | "amplifier" | "sink" | "cond"
+    fn:   compute — called with one payload per upstream (in edge order);
+          amplifier — split/merge hook (see AmplifierInterceptor);
+          cond — predicate payload -> bool.
+    max_run_times: micro-batch count this actor processes per run.
+    """
+
+    def __init__(self, role: str, rank: int = 0,
+                 fn: Optional[Callable] = None, max_run_times: int = 1,
+                 node_id: Optional[int] = None, name: str = ""):
+        if node_id is None:
+            with _NODE_LOCK:
+                _NODE_COUNTER[0] += 1
+                node_id = _NODE_COUNTER[0]
+        self.node_id = node_id
+        self.role = role
+        self.rank = rank
+        self.fn = fn
+        self.max_run_times = max_run_times
+        self.name = name or f"{role}_{node_id}"
+        self.upstreams: List[int] = []          # node ids
+        self.downstreams: List[Tuple[int, int]] = []  # (node id, buffer credits)
+
+
+class RuntimeGraph:
+    """TaskNodes + buffered edges (reference runtime_graph.cc)."""
+
+    def __init__(self):
+        self.nodes: Dict[int, TaskNode] = {}
+
+    def add(self, node: TaskNode) -> TaskNode:
+        if node.node_id in self.nodes:
+            raise ValueError(f"duplicate task node id {node.node_id}")
+        self.nodes[node.node_id] = node
+        return node
+
+    def connect(self, up: TaskNode, down: TaskNode, buffer_size: int = 1):
+        """Edge with `buffer_size` credits: up may run at most buffer_size
+        micro-batches ahead of down (the 1F1B memory bound)."""
+        up.downstreams.append((down.node_id, buffer_size))
+        down.upstreams.append(up.node_id)
+
+    def by_role(self, role: str) -> List[TaskNode]:
+        return [n for n in self.nodes.values() if n.role == role]
+
+
+class _Interceptor(threading.Thread):
+    """Base actor: mailbox loop + credit bookkeeping (interceptor.cc)."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus, carrier: "Carrier"):
+        super().__init__(daemon=True, name=f"interceptor-{node.name}")
+        self.node = node
+        self.bus = bus
+        self.carrier = carrier
+        self.pending: Dict[int, List[bytes]] = {u: [] for u in node.upstreams}
+        self.credits: Dict[int, int] = {d: cap for d, cap in node.downstreams}
+        self.stops_seen = 0
+        self.error: Optional[BaseException] = None
+
+    # --- messaging helpers ---
+    def send_down(self, payload: Any):
+        raw = pickle.dumps(payload)
+        for dst, _ in self.node.downstreams:
+            self.bus.send(self.node.node_id, dst, DATA_IS_READY, raw)
+
+    def send_stop_down(self):
+        # best-effort: a finished peer rank may already have torn its bus down
+        for dst, _ in self.node.downstreams:
+            try:
+                self.bus.send(self.node.node_id, dst, STOP)
+            except RuntimeError:
+                pass
+
+    def return_credit(self, up_id: int):
+        try:
+            self.bus.send(self.node.node_id, up_id, DATA_IS_USELESS)
+        except RuntimeError:
+            pass  # upstream rank already shut down; credit is moot
+
+    def handle(self, src: int, typ: int, payload: bytes):
+        """Bookkeeping only — STOP marks upstream exhaustion; the role loops
+        decide when to finish (an actor may hold buffered work past the
+        upstream's STOP, e.g. an expanding amplifier mid fan-out)."""
+        if typ == DATA_IS_READY:
+            self.pending[src].append(payload)
+        elif typ == DATA_IS_USELESS:
+            self.credits[src] = self.credits.get(src, 0) + 1
+        elif typ == STOP:
+            self.stops_seen += 1
+
+    def upstream_done(self) -> bool:
+        return self.stops_seen >= max(1, len(self.node.upstreams))
+
+    def wait_inputs(self, need: int = 1) -> bool:
+        """Block until every upstream has `need` pending payloads; False if
+        the upstreams stopped first (no more data will ever arrive)."""
+        while not all(len(self.pending[u]) >= need
+                      for u in self.node.upstreams):
+            if self.upstream_done():
+                return False
+            msg = self.bus.recv(self.node.node_id,
+                                timeout_ms=self.carrier.timeout_ms)
+            if msg is None:
+                raise TimeoutError(f"{self.node.name} starved")
+            self.handle(*msg)
+        return True
+
+    def wait_credit(self):
+        """Block until every downstream edge has a free buffer slot (credits
+        come from downstream, so upstream STOPs don't end this wait)."""
+        while not all(self.credits.get(d, 0) > 0
+                      for d, _ in self.node.downstreams):
+            msg = self.bus.recv(self.node.node_id,
+                                timeout_ms=self.carrier.timeout_ms)
+            if msg is None:
+                raise TimeoutError(f"{self.node.name} has no credit")
+            self.handle(*msg)
+
+    def consume_inputs(self) -> List[Any]:
+        inputs = []
+        for u in self.node.upstreams:
+            inputs.append(pickle.loads(self.pending[u].pop(0)))
+            self.return_credit(u)
+        for d, _ in self.node.downstreams:
+            self.credits[d] -= 1
+        return inputs
+
+    def run(self):
+        try:
+            self.loop()
+        except BaseException as e:  # surfaced by Carrier.run's join
+            self.error = e
+
+    def loop(self):
+        raise NotImplementedError
+
+
+class ComputeInterceptor(_Interceptor):
+    """Runs fn once per micro-batch when inputs + downstream credit are ready
+    (compute_interceptor.cc)."""
+
+    def loop(self):
+        runs = 0
+        while runs < self.node.max_run_times:
+            if not self.wait_inputs():
+                break  # upstream produced fewer micro-batches than planned
+            self.wait_credit()
+            out = self.node.fn(*self.consume_inputs())
+            self.send_down(out)
+            runs += 1
+        # wait for the upstream STOP so shutdown ripples front-to-back
+        while not self.upstream_done():
+            msg = self.bus.recv(self.node.node_id,
+                                timeout_ms=self.carrier.timeout_ms)
+            if msg is None:
+                break
+            self.handle(*msg)
+        self.send_stop_down()
+
+
+class SourceInterceptor(_Interceptor):
+    """Feeds micro-batches into the graph (source_interceptor.cc); the feed
+    iterable comes from Carrier.run."""
+
+    def loop(self):
+        feed = self.carrier.feeds.get(self.node.node_id, [])
+        for item in feed:
+            self.wait_credit()
+            for d, _ in self.node.downstreams:
+                self.credits[d] -= 1
+            self.send_down(item)
+        self.send_stop_down()
+
+
+class SinkInterceptor(_Interceptor):
+    """Collects results (sink_interceptor.cc); Carrier.run returns them."""
+
+    def loop(self):
+        self.results: List[Any] = []
+        while True:
+            msg = self.bus.recv(self.node.node_id,
+                                timeout_ms=self.carrier.timeout_ms)
+            if msg is None:
+                raise TimeoutError(f"{self.node.name} starved")
+            src, typ, payload = msg
+            if typ == DATA_IS_READY:
+                self.results.append(pickle.loads(payload))
+                self.return_credit(src)
+                if len(self.results) >= self.node.max_run_times:
+                    self.carrier.results[self.node.node_id] = self.results
+                    return
+            elif typ == STOP:
+                self.stops_seen += 1
+                if self.stops_seen >= max(1, len(self.node.upstreams)):
+                    self.carrier.results[self.node.node_id] = self.results
+                    return
+
+
+class AmplifierInterceptor(_Interceptor):
+    """Micro-batch fan-out/in (amplifier_interceptor.cc): one upstream payload
+    becomes `factor` downstream sends (fn splits), or `factor` upstream
+    payloads merge into one (fn merges a list)."""
+
+    def __init__(self, node, bus, carrier, factor: int, mode: str):
+        super().__init__(node, bus, carrier)
+        self.factor = factor
+        self.mode = mode  # "expand" | "merge"
+        if mode == "expand" and len(node.upstreams) != 1:
+            raise ValueError("expanding amplifier requires exactly one "
+                             "upstream (got %d)" % len(node.upstreams))
+
+    def loop(self):
+        runs = 0
+        while runs < self.node.max_run_times:
+            need = 1 if self.mode == "expand" else self.factor
+            if not self.wait_inputs(need):
+                break
+            if self.mode == "expand":
+                up = self.node.upstreams[0]
+                item = pickle.loads(self.pending[up].pop(0))
+                self.return_credit(up)
+                parts = (self.node.fn(item, self.factor) if self.node.fn
+                         else list(item))
+                for part in parts:
+                    # per-part credit wait so buffer_size=1 edges can't deadlock
+                    self.wait_credit()
+                    for d, _ in self.node.downstreams:
+                        self.credits[d] -= 1
+                    self.send_down(part)
+            else:
+                batches = []
+                for _ in range(self.factor):
+                    for u in self.node.upstreams:
+                        batches.append(pickle.loads(self.pending[u].pop(0)))
+                        self.return_credit(u)
+                merged = self.node.fn(batches) if self.node.fn else batches
+                self.wait_credit()
+                for d, _ in self.node.downstreams:
+                    self.credits[d] -= 1
+                self.send_down(merged)
+            runs += 1
+        while not self.upstream_done():
+            msg = self.bus.recv(self.node.node_id,
+                                timeout_ms=self.carrier.timeout_ms)
+            if msg is None:
+                break
+            self.handle(*msg)
+        self.send_stop_down()
+
+
+class CondInterceptor(_Interceptor):
+    """Routes each payload to downstream[0] (true) or downstream[1] (false)
+    by predicate — the loop-control actor (cond_interceptor.cc). Exactly one
+    upstream; backpressure applies per chosen branch."""
+
+    def loop(self):
+        if len(self.node.upstreams) != 1:
+            raise ValueError("cond interceptor requires exactly one upstream")
+        up = self.node.upstreams[0]
+        runs = 0
+        while runs < self.node.max_run_times:
+            if not self.wait_inputs():
+                break
+            item = pickle.loads(self.pending[up].pop(0))
+            self.return_credit(up)
+            branch = 0 if self.node.fn(item) else 1
+            dst, _ = self.node.downstreams[branch]
+            while self.credits.get(dst, 0) <= 0:   # branch-local backpressure
+                msg = self.bus.recv(self.node.node_id,
+                                    timeout_ms=self.carrier.timeout_ms)
+                if msg is None:
+                    raise TimeoutError(f"{self.node.name} has no credit")
+                self.handle(*msg)
+            self.credits[dst] -= 1
+            self.bus.send(self.node.node_id, dst, DATA_IS_READY,
+                          pickle.dumps(item))
+            runs += 1
+        while not self.upstream_done():
+            msg = self.bus.recv(self.node.node_id,
+                                timeout_ms=self.carrier.timeout_ms)
+            if msg is None:
+                break
+            self.handle(*msg)
+        self.send_stop_down()
+
+
+_ROLE_TO_CLS = {
+    "compute": ComputeInterceptor,
+    "source": SourceInterceptor,
+    "sink": SinkInterceptor,
+    "cond": CondInterceptor,
+}
+
+
+class Carrier:
+    """Owns this rank's interceptor threads (carrier.cc)."""
+
+    def __init__(self, graph: RuntimeGraph, bus: MessageBus, rank: int = 0,
+                 timeout_s: float = 120.0):
+        self.graph = graph
+        self.bus = bus
+        self.rank = rank
+        self.timeout_ms = int(timeout_s * 1000)
+        self.feeds: Dict[int, Iterable] = {}
+        self.results: Dict[int, List[Any]] = {}
+        self._interceptors: List[_Interceptor] = []
+        for node in graph.nodes.values():
+            bus.route(node.node_id, node.rank)
+        for node in graph.nodes.values():
+            if node.rank != rank:
+                continue
+            bus.open_mailbox(node.node_id)
+            if node.role == "amplifier":
+                factor = getattr(node, "factor", 1)
+                mode = getattr(node, "mode", "expand")
+                icp = AmplifierInterceptor(node, bus, self, factor, mode)
+            else:
+                icp = _ROLE_TO_CLS[node.role](node, bus, self)
+            self._interceptors.append(icp)
+
+    def run(self, feeds: Optional[Dict[int, Iterable]] = None
+            ) -> Dict[int, List[Any]]:
+        """Start every local interceptor, wait for completion, and return
+        {sink node id: collected results} for local sinks."""
+        self.feeds = feeds or {}
+        self.results = {}
+        for icp in self._interceptors:
+            icp.start()
+        for icp in self._interceptors:
+            icp.join(timeout=self.timeout_ms / 1000.0 + 5)
+            if icp.is_alive():
+                raise TimeoutError(f"interceptor {icp.node.name} hung")
+            if icp.error is not None:
+                raise RuntimeError(
+                    f"interceptor {icp.node.name} failed") from icp.error
+        return self.results
+
+
+class FleetExecutor:
+    """Builds the bus + carrier for this rank and runs the graph
+    (fleet_executor.cc). endpoints: "host:port" per rank for the cross-rank
+    bus links; single-rank jobs skip sockets entirely."""
+
+    def __init__(self, graph: RuntimeGraph, rank: int = 0,
+                 endpoints: Optional[List[str]] = None,
+                 timeout_s: float = 120.0):
+        self.graph = graph
+        self.rank = rank
+        self.bus = MessageBus(rank)
+        if endpoints and len(endpoints) > 1:
+            my = endpoints[rank]
+            port = int(my.rsplit(":", 1)[1])
+            self.bus.listen(port)
+            for r, ep in enumerate(endpoints):
+                if r == rank:
+                    continue
+                host, p = ep.rsplit(":", 1)
+                self.bus.connect(r, host, int(p))
+        self.carrier = Carrier(graph, self.bus, rank, timeout_s)
+
+    def run(self, feeds: Optional[Dict[int, Iterable]] = None
+            ) -> Dict[int, List[Any]]:
+        return self.carrier.run(feeds)
+
+    def shutdown(self):
+        self.bus.close()

@@ -1,0 +1,106 @@
+"""ctypes binding for the native actor message bus.
+
+Reference analog: fleet_executor/message_bus.cc (brpc InterceptorMessage
+transport) — here a single C++ unit (core/native/message_bus.cpp) with
+in-process condvar mailboxes and length-prefixed TCP frames across ranks.
+"""
+from __future__ import annotations
+
+import ctypes
+import socket
+from typing import Optional, Tuple
+
+from ...core.native import load_library
+
+# message types shared with the interceptors
+DATA_IS_READY = 0
+DATA_IS_USELESS = 1
+STOP = 2
+
+
+def _lib():
+    lib = load_library("message_bus")
+    lib.bus_create.restype = ctypes.c_void_p
+    lib.bus_create.argtypes = [ctypes.c_int]
+    lib.bus_listen.restype = ctypes.c_int
+    lib.bus_listen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bus_connect.restype = ctypes.c_int
+    lib.bus_connect.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                ctypes.c_char_p, ctypes.c_int]
+    lib.bus_route.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
+    lib.bus_open_mailbox.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.bus_send.restype = ctypes.c_int
+    lib.bus_send.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                             ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.bus_recv.restype = ctypes.c_int
+    lib.bus_recv.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                             ctypes.POINTER(ctypes.c_int64),
+                             ctypes.POINTER(ctypes.c_int),
+                             ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.bus_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class MessageBus:
+    """Per-rank bus: local mailboxes + TCP links to peer ranks."""
+
+    def __init__(self, rank: int = 0):
+        self._lib = _lib()
+        self._h = self._lib.bus_create(rank)
+        self.rank = rank
+        self.port: Optional[int] = None
+
+    def listen(self, port: int = 0) -> int:
+        p = self._lib.bus_listen(self._h, port)
+        if p < 0:
+            raise RuntimeError(f"message bus failed to listen on port {port}")
+        self.port = p
+        return p
+
+    def connect(self, rank: int, host: str, port: int):
+        host_ip = socket.gethostbyname(host)
+        if self._lib.bus_connect(self._h, rank, host_ip.encode(), port) != 0:
+            raise RuntimeError(f"message bus failed to connect rank {rank} "
+                               f"at {host}:{port}")
+
+    def route(self, actor_id: int, rank: int):
+        self._lib.bus_route(self._h, actor_id, rank)
+
+    def open_mailbox(self, actor_id: int):
+        self._lib.bus_open_mailbox(self._h, actor_id)
+
+    def send(self, src: int, dst: int, msg_type: int, payload: bytes = b""):
+        rc = self._lib.bus_send(self._h, src, dst, msg_type, payload,
+                                len(payload))
+        if rc != 0:
+            raise RuntimeError(f"bus send {src}->{dst} failed (no route/peer)")
+
+    def recv(self, actor_id: int,
+             timeout_ms: int = -1) -> Optional[Tuple[int, int, bytes]]:
+        """Returns (src, type, payload) or None on timeout."""
+        cap = 1 << 16
+        while True:
+            src = ctypes.c_int64(0)
+            typ = ctypes.c_int(0)
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.bus_recv(self._h, actor_id, ctypes.byref(src),
+                                   ctypes.byref(typ), buf, cap, timeout_ms)
+            if n == -1:
+                return None
+            if n == -2:
+                raise KeyError(f"no mailbox for actor {actor_id}")
+            if n == -3:
+                cap = src.value  # exact required size reported by the bus
+                continue
+            return src.value, typ.value, buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.bus_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
