@@ -70,6 +70,7 @@ from . import callbacks  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 DataParallel = distributed.DataParallel
 
 

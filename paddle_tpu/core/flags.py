@@ -52,3 +52,5 @@ define_flag("FLAGS_retain_grad_for_all", False, "retain .grad for non-leaf tenso
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "kept for API parity; XLA owns HBM on TPU")
 define_flag("FLAGS_cudnn_deterministic", False, "kept for API parity; XLA is deterministic by default")
+define_flag("FLAGS_use_autotune", False, "measure + cache kernel block configs (reference: phi/kernels/autotune switch_autotune)")
+define_flag("FLAGS_autotune_cache_file", os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "autotune.json"), "persistent autotune cache path")

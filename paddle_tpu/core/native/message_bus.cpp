@@ -65,6 +65,7 @@ struct Peer {
 
 struct Bus {
   int rank = 0;
+  std::atomic<bool> closing{false};  // wakes bus_recv waiters before destroy
   std::mutex mu;  // guards mailboxes/routes/peers maps (not mailbox queues)
   std::map<int64_t, std::unique_ptr<Mailbox>> mailboxes;
   std::map<int64_t, int> routes;           // actor id -> rank
@@ -273,14 +274,16 @@ int bus_recv(void* h, int64_t actor_id, int64_t* src, int* type,
     mb = it->second.get();
   }
   std::unique_lock<std::mutex> lk(mb->mu);
+  auto ready = [&] { return !mb->q.empty() || bus->closing.load(); };
   if (mb->q.empty()) {
     if (timeout_ms < 0) {
-      mb->cv.wait(lk, [&] { return !mb->q.empty(); });
+      mb->cv.wait(lk, ready);
     } else if (!mb->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                                [&] { return !mb->q.empty(); })) {
+                                ready)) {
       return -1;
     }
   }
+  if (mb->q.empty()) return -1;  // woken by close, not by a message
   Msg& m = mb->q.front();
   int n = static_cast<int>(m.payload.size());
   if (n > cap) {
@@ -294,8 +297,21 @@ int bus_recv(void* h, int64_t actor_id, int64_t* src, int* type,
   return n;
 }
 
+void bus_wake_all(void* h) {
+  // unblock every bus_recv waiter (they see -1); call before joining the
+  // interceptor threads so destroy never frees state under a live waiter
+  auto* bus = static_cast<Bus*>(h);
+  bus->closing.store(true);
+  std::lock_guard<std::mutex> g(bus->mu);
+  for (auto& kv : bus->mailboxes) {
+    std::lock_guard<std::mutex> m(kv.second->mu);
+    kv.second->cv.notify_all();
+  }
+}
+
 void bus_destroy(void* h) {
   auto* bus = static_cast<Bus*>(h);
+  bus_wake_all(h);
   bus->stop.store(true);
   if (bus->listen_fd >= 0) ::shutdown(bus->listen_fd, SHUT_RDWR);
   if (bus->listen_fd >= 0) ::close(bus->listen_fd);

@@ -31,3 +31,9 @@ from . import launch  # noqa: F401,E402
 from .api_completion import *  # noqa: F401,F403,E402
 from . import io  # noqa: F401,E402
 from .api_completion import ParallelMode  # noqa: F401,E402
+from .dataset import InMemoryDataset, QueueDataset, SlotDesc  # noqa: F401,E402
+from .index_dataset import TreeIndex  # noqa: F401,E402
+from . import transpiler  # noqa: F401,E402
+from .transpiler import (  # noqa: F401,E402
+    DistributeTranspiler, DistributeTranspilerConfig)
+from . import fleet_executor  # noqa: F401,E402

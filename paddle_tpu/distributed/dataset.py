@@ -1,0 +1,188 @@
+"""Industrial dataset pipeline: InMemoryDataset / QueueDataset.
+
+Reference analog: the data_feed/data_set family
+(paddle/fluid/framework/data_feed.cc proto-configured slot parsers,
+data_set.cc in-memory records with trainer-wide global shuffle) surfaced as
+paddle.distributed.{InMemoryDataset,QueueDataset}.
+
+TPU-native shape: records are parsed host-side into slot arrays (dense float
+slots, sparse id slots), batches come out as numpy dicts ready for
+device_put/sharding; the global shuffle redistributes records across trainer
+ranks by hash over the job's TCPStore (the reference moves them over brpc).
+"""
+from __future__ import annotations
+
+import hashlib
+import pickle
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SlotDesc", "InMemoryDataset", "QueueDataset"]
+
+
+class SlotDesc:
+    """One input slot: dense (fixed-dim floats) or sparse (variable id list)."""
+
+    def __init__(self, name: str, is_sparse: bool = False, dim: int = 1,
+                 dtype: str = "float32"):
+        self.name = name
+        self.is_sparse = is_sparse
+        self.dim = dim
+        self.dtype = dtype
+
+
+def _default_parse(line: str, slots: Sequence[SlotDesc]) -> Optional[tuple]:
+    """Default line format: whitespace groups `name:v1,v2,...` in any order.
+    Dense slots need exactly `dim` floats; sparse slots take any id count."""
+    parts: Dict[str, str] = {}
+    for tok in line.split():
+        if ":" not in tok:
+            return None
+        k, v = tok.split(":", 1)
+        parts[k] = v
+    rec = []
+    for s in slots:
+        raw = parts.get(s.name)
+        if raw is None:
+            return None
+        vals = raw.split(",")
+        if s.is_sparse:
+            rec.append(np.asarray([int(x) for x in vals], np.int64))
+        else:
+            if len(vals) != s.dim:
+                return None
+            rec.append(np.asarray([float(x) for x in vals], s.dtype))
+    return tuple(rec)
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._slots: List[SlotDesc] = []
+        self._files: List[str] = []
+        self._batch_size = 1
+        self._parse: Callable = _default_parse
+        self._drop_last = False
+
+    def init(self, batch_size: int = 1, thread_num: int = 1,
+             use_var: Optional[Sequence] = None, **kwargs):
+        """reference DatasetBase.init; use_var: SlotDesc list (or objects with
+        .name) declaring the slot schema."""
+        self._batch_size = batch_size
+        if use_var:
+            self._slots = [v if isinstance(v, SlotDesc)
+                           else SlotDesc(getattr(v, "name", str(v)))
+                           for v in use_var]
+        return self
+
+    def set_filelist(self, files: Sequence[str]):
+        self._files = list(files)
+
+    def set_parse_func(self, fn: Callable):
+        """Custom line parser: fn(line, slots) -> tuple of np arrays or None."""
+        self._parse = fn
+
+    def _iter_records(self) -> Iterator[tuple]:
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = self._parse(line, self._slots)
+                    if rec is not None:
+                        yield rec
+
+    def _batchify(self, records: Sequence[tuple]) -> Iterator[Dict[str, Any]]:
+        bs = self._batch_size
+        for i in range(0, len(records), bs):
+            chunk = records[i:i + bs]
+            if len(chunk) < bs and self._drop_last:
+                break
+            out: Dict[str, Any] = {}
+            for j, s in enumerate(self._slots):
+                cols = [r[j] for r in chunk]
+                if s.is_sparse:
+                    lens = np.asarray([len(c) for c in cols], np.int64)
+                    width = max(1, int(lens.max()) if len(lens) else 1)
+                    ids = np.zeros((len(cols), width), np.int64)
+                    for r, c in enumerate(cols):
+                        ids[r, :len(c)] = c
+                    out[s.name] = ids
+                    out[s.name + "@len"] = lens
+                else:
+                    out[s.name] = np.stack(cols)
+            yield out
+
+
+class InMemoryDataset(_DatasetBase):
+    """reference InMemoryDataset: load -> (shuffle) -> batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List[tuple] = []
+
+    def load_into_memory(self):
+        self._records = list(self._iter_records())
+
+    def get_memory_data_size(self) -> int:
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        random.Random(seed).shuffle(self._records)
+
+    def global_shuffle(self, store=None, rank: int = 0, world: int = 1,
+                       seed: int = 0, prefix: str = "ds"):
+        """Redistribute records across ranks by hash, then shuffle locally
+        (reference data_set.cc GlobalShuffle over trainers).
+
+        `store` is any TCPStore-like KV (set/get/add/wait); with world==1 this
+        degrades to a seeded local shuffle."""
+        if world <= 1 or store is None:
+            self.local_shuffle(seed)
+            return
+        # generation counter: each rank's Nth shuffle call gets generation N,
+        # so repeated shuffles (same seed every epoch) can never read a peer's
+        # stale partition from the previous round
+        gen = store.add(f"{prefix}/shuf/gen/{rank}", 1)
+        # partition my records by destination rank (content hash => stable
+        # placement no matter which rank loaded the record)
+        outgoing: List[List[tuple]] = [[] for _ in range(world)]
+        for rec in self._records:
+            h = hashlib.md5(pickle.dumps(rec) + str(seed).encode()).digest()
+            outgoing[int.from_bytes(h[:4], "little") % world].append(rec)
+        for dst in range(world):
+            store.set(f"{prefix}/shuf/{gen}/{rank}->{dst}",
+                      pickle.dumps(outgoing[dst]))
+        mine: List[tuple] = []
+        for src in range(world):
+            key = f"{prefix}/shuf/{gen}/{src}->{rank}"
+            store.wait([key], timeout=300)
+            mine.extend(pickle.loads(store.get(key)))
+            store.delete_key(key)
+        self._records = mine
+        self.local_shuffle(seed + rank)
+
+    def get_shuffle_data_size(self) -> int:
+        return len(self._records)
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        return self._batchify(self._records)
+
+
+class QueueDataset(_DatasetBase):
+    """reference QueueDataset: streaming, one pass, no memory residency."""
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        buf: List[tuple] = []
+        for rec in self._iter_records():
+            buf.append(rec)
+            if len(buf) == self._batch_size:
+                yield from self._batchify(buf)
+                buf = []
+        if buf and not self._drop_last:
+            yield from self._batchify(buf)

@@ -12,6 +12,7 @@ from ..env import _maybe_init_multihost, get_hcg
 from ..topology import AXES, CommunicateTopology, HybridCommunicateGroup
 from .strategy import DistributedStrategy
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
 from .meta_optimizers import HybridParallelOptimizer, DygraphShardingOptimizer
 from .recompute import recompute  # noqa: F401
 

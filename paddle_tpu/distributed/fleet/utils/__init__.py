@@ -1,0 +1,4 @@
+"""fleet.utils namespace (reference: python/paddle/distributed/fleet/utils)."""
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]

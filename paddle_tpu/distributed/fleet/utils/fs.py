@@ -1,0 +1,171 @@
+"""Filesystem abstraction for checkpoint/data staging.
+
+Reference analog: the POSIX/HDFS fs + shell helpers
+(paddle/fluid/framework/io/{fs,shell}.cc) surfaced as
+paddle.distributed.fleet.utils.{LocalFS, HDFSClient}. Checkpoint writers and
+dataset file lists go through this seam so jobs can point at either a local
+disk or an HDFS namespace.
+
+LocalFS is the real implementation; HDFSClient shells out to the `hadoop`
+binary when present (same contract as the reference, which drives
+`hadoop fs -...` through shell.cc) and raises a clear error otherwise.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    """Interface (reference fs.py FS abstract base)."""
+
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        """Returns (dirs, files) directly under path."""
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite: bool = False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok: bool = True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        with open(path, "a"):
+            os.utime(path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def list_dirs(self, path) -> List[str]:
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient(FS):
+    """`hadoop fs` CLI wrapper (reference HDFSClient drives the same CLI via
+    shell.cc). configs: {"fs.default.name": ..., "hadoop.job.ugi": ...}."""
+
+    def __init__(self, hadoop_home: Optional[str] = None,
+                 configs: Optional[dict] = None, time_out: int = 300):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        self._pre = []
+        for k, v in (configs or {}).items():
+            self._pre += ["-D", f"{k}={v}"]
+        self._timeout = time_out
+
+    def _run(self, *args) -> str:
+        if not self._hadoop:
+            raise RuntimeError(
+                "no hadoop binary available; HDFSClient needs a Hadoop "
+                "install (use LocalFS for local paths)")
+        cmd = [self._hadoop, "fs"] + self._pre + list(args)
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=self._timeout)
+        if out.returncode != 0:
+            raise RuntimeError(f"hadoop {' '.join(args)} failed: "
+                               f"{out.stderr[-500:]}")
+        return out.stdout
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for line in self._run("-ls", path).splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path) -> bool:
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_file(self, path) -> bool:
+        try:
+            self._run("-test", "-f", path)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, path) -> bool:
+        return self.is_exist(path) and not self.is_file(path)
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, src, dst, overwrite: bool = False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def need_upload_download(self) -> bool:
+        return True

@@ -364,10 +364,32 @@ class Carrier:
         self.results = {}
         for icp in self._interceptors:
             icp.start()
+        # join ALL threads before raising anything: an early raise would let
+        # the caller destroy the bus under still-blocked native recv waiters.
+        # On the first observed failure, wake every waiter so siblings exit
+        # promptly instead of running out their own timeouts.
+        import time as _time
+        deadline = _time.monotonic() + self.timeout_ms / 1000.0 + 10
+        pending = list(self._interceptors)
+        woken = False
+        while pending and _time.monotonic() < deadline:
+            nxt = []
+            for icp in pending:
+                icp.join(timeout=0.05)
+                if icp.is_alive():
+                    nxt.append(icp)
+                elif icp.error is not None and not woken:
+                    self.bus.wake_all()
+                    woken = True
+            pending = nxt
+        if pending:
+            self.bus.wake_all()
+            for icp in pending:
+                icp.join(timeout=5)
+        hung = [icp.node.name for icp in pending if icp.is_alive()]
+        if hung:
+            raise TimeoutError(f"interceptors hung: {hung}")
         for icp in self._interceptors:
-            icp.join(timeout=self.timeout_ms / 1000.0 + 5)
-            if icp.is_alive():
-                raise TimeoutError(f"interceptor {icp.node.name} hung")
             if icp.error is not None:
                 raise RuntimeError(
                     f"interceptor {icp.node.name} failed") from icp.error

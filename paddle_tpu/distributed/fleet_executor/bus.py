@@ -37,6 +37,7 @@ def _lib():
                              ctypes.POINTER(ctypes.c_int64),
                              ctypes.POINTER(ctypes.c_int),
                              ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.bus_wake_all.argtypes = [ctypes.c_void_p]
     lib.bus_destroy.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -93,6 +94,12 @@ class MessageBus:
                 cap = src.value  # exact required size reported by the bus
                 continue
             return src.value, typ.value, buf.raw[:n]
+
+    def wake_all(self):
+        """Unblock every recv() waiter (they observe a timeout); precedes
+        thread joins on teardown so destroy never races a live waiter."""
+        if self._h:
+            self._lib.bus_wake_all(self._h)
 
     def close(self):
         if self._h:

@@ -1,0 +1,137 @@
+"""Tree index for tree-based retrieval recsys (TDM-style).
+
+Reference analog: python/paddle/fluid/distributed/index_dataset/ — a TreeIndex
+over a protobuf-serialized complete tree where items sit at leaves; training
+samples per-layer positives (the item's ancestors) plus random same-layer
+negatives (layerwise sampler), and serving beam-searches down the tree.
+
+Here the tree is built directly from item ids (complete `branch`-ary tree,
+breadth-first codes: root=0, children of c = c*branch+1 .. c*branch+branch),
+with the same query surface: layer codes, travel (ancestor) paths, children,
+and the layer-wise negative sampler.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["TreeIndex"]
+
+
+class TreeIndex:
+    def __init__(self, item_ids: Sequence[int], branch: int = 2):
+        if branch < 2:
+            raise ValueError("branch must be >= 2")
+        self._branch = branch
+        items = list(item_ids)
+        if not items:
+            raise ValueError("tree needs at least one item")
+        # height: smallest h with branch^h >= len(items); leaves on one level
+        h = 0
+        while branch ** h < len(items):
+            h += 1
+        self._height = h
+        first_leaf = (branch ** h - 1) // (branch - 1)
+        self._leaf_base = first_leaf
+        self._item_code: Dict[int, int] = {
+            it: first_leaf + i for i, it in enumerate(items)}
+        self._code_item: Dict[int, int] = {
+            c: it for it, c in self._item_code.items()}
+        self._total = first_leaf + len(items)
+
+    # ------------------------------------------------------------- queries
+
+    def height(self) -> int:
+        """Levels counting the leaf level (root = level 0)."""
+        return self._height + 1
+
+    def branch(self) -> int:
+        return self._branch
+
+    def total_node_nums(self) -> int:
+        return self._total
+
+    def get_all_leafs(self) -> List[int]:
+        return sorted(self._code_item)
+
+    def get_nodes(self, codes: Sequence[int]) -> List[Optional[int]]:
+        """Item id at each code (None for internal nodes)."""
+        return [self._code_item.get(int(c)) for c in codes]
+
+    def get_layer_codes(self, level: int) -> List[int]:
+        b = self._branch
+        first = (b ** level - 1) // (b - 1)
+        last = (b ** (level + 1) - 1) // (b - 1)
+        return [c for c in range(first, min(last, self._total))]
+
+    def get_travel_codes(self, item_id: int, start_level: int = 0) -> List[int]:
+        """Ancestor path leaf -> start_level (reference get_travel_codes)."""
+        code = self._item_code[int(item_id)]
+        path = []
+        level = self._height
+        while level >= start_level:
+            path.append(code)
+            code = (code - 1) // self._branch
+            level -= 1
+        return path
+
+    def get_ancestor_codes(self, item_ids: Sequence[int],
+                           level: int) -> List[int]:
+        out = []
+        for it in item_ids:
+            code = self._item_code[int(it)]
+            for _ in range(self._height - level):
+                code = (code - 1) // self._branch
+            out.append(code)
+        return out
+
+    def get_children_codes(self, code: int, level: int) -> List[int]:
+        b = self._branch
+        kids = [code * b + i for i in range(1, b + 1)]
+        return [c for c in kids if c < self._total]
+
+    def get_pi_relation(self, item_ids: Sequence[int],
+                        level: int) -> Dict[int, int]:
+        return {int(it): anc for it, anc in
+                zip(item_ids, self.get_ancestor_codes(item_ids, level))}
+
+    # ------------------------------------------------------------ sampling
+
+    def init_layerwise_sampler(self, layer_sample_counts: Sequence[int],
+                               start_sample_layer: int = 1, seed: int = 0):
+        if len(layer_sample_counts) != self._height - start_sample_layer + 1:
+            raise ValueError(
+                f"need one sample count per layer in "
+                f"[{start_sample_layer}, {self._height}] "
+                f"({self._height - start_sample_layer + 1} layers)")
+        self._sample_counts = list(layer_sample_counts)
+        self._start_layer = start_sample_layer
+        self._rng = random.Random(seed)
+
+    def sample(self, item_ids: Sequence[int]
+               ) -> List[Tuple[int, int, int]]:
+        """Per item, per layer: the positive ancestor + N random same-layer
+        negatives. Returns (code, item_id, label) rows (reference layerwise
+        sampler output feeding the per-layer classifier)."""
+        if not hasattr(self, "_sample_counts"):
+            raise RuntimeError("call init_layerwise_sampler first")
+        rows: List[Tuple[int, int, int]] = []
+        for it in item_ids:
+            path = self.get_travel_codes(int(it), self._start_layer)
+            # path is leaf..start_layer; iterate shallow->deep to line up with
+            # _sample_counts[0] = start_sample_layer
+            for i, code in enumerate(reversed(path)):
+                level = self._start_layer + i
+                rows.append((code, int(it), 1))
+                layer = self.get_layer_codes(level)
+                n = min(self._sample_counts[i],
+                        max(0, len(layer) - 1))
+                picked = 0
+                while picked < n:
+                    neg = self._rng.choice(layer)
+                    if neg != code:
+                        rows.append((neg, int(it), 0))
+                        picked += 1
+        return rows

@@ -21,7 +21,37 @@ import numpy as np
 
 from ..tcp_store import TCPStore
 
-__all__ = ["SparseTable", "PSServer", "PSClient"]
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient"]
+
+
+class DenseTable:
+    """Whole-parameter dense table (reference memory_dense_table): holds one
+    flat fp32 vector; push applies the server-side optimizer (SGD) to it —
+    the trainer sends raw/accumulated gradients (sync / geo-SGD)."""
+
+    def __init__(self, shape, lr: float = 1.0,
+                 init: Optional[np.ndarray] = None, seed: int = 0):
+        self.shape = tuple(shape)
+        n = int(np.prod(self.shape))
+        if init is not None:
+            self._value = np.asarray(init, np.float32).ravel().copy()
+        else:
+            self._value = (np.random.RandomState(seed)
+                           .normal(0, 0.01, n).astype(np.float32))
+        self.lr = lr
+        self._mu = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._mu:
+            return self._value.copy()
+
+    def push(self, grad: np.ndarray):
+        with self._mu:
+            self._value -= self.lr * np.asarray(grad, np.float32).ravel()
+
+    def set(self, value: np.ndarray):
+        with self._mu:
+            self._value = np.asarray(value, np.float32).ravel().copy()
 
 
 class SparseTable:
@@ -122,6 +152,14 @@ class PSServer:
                 ids, grads = payload
                 t.push(ids, grads)
                 result = True
+            elif op == "pull_dense":
+                result = t.pull()
+            elif op == "push_dense":
+                t.push(payload)
+                result = True
+            elif op == "set_dense":
+                t.set(payload)
+                result = True
             elif op == "size":
                 result = t.size()
             elif op == "save":
@@ -169,6 +207,15 @@ class PSClient:
         return self._call("push", table,
                           ([int(i) for i in ids], np.asarray(grads,
                                                              np.float32)))
+
+    def pull_dense(self, table: str) -> np.ndarray:
+        return self._call("pull_dense", table, None)
+
+    def push_dense(self, table: str, grad: np.ndarray):
+        return self._call("push_dense", table, np.asarray(grad, np.float32))
+
+    def set_dense(self, table: str, value: np.ndarray):
+        return self._call("set_dense", table, np.asarray(value, np.float32))
 
     def table_size(self, table: str) -> int:
         return self._call("size", table, None)

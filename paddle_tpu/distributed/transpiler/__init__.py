@@ -1,0 +1,124 @@
+"""DistributeTranspiler: parameter-server program rewriting (legacy PS mode).
+
+Reference analog: python/paddle/distributed/transpiler/distribute_transpiler.py
+— rewrites a training program so each trainer sends grads to / recvs params
+from parameter servers (dense blocks sliced across pservers, optionally
+geo-SGD async), and get_pserver_program builds each server's half.
+
+TPU-native redesign: there is no program surgery — the model stays a Layer and
+trains on-device; the transpiler's real job (partition parameters over server
+endpoints + give both sides their runtime) maps to table assignments over the
+native-TCPStore PS (distributed/ps). Sync mode pulls before forward and pushes
+after backward every step; geo mode pushes accumulated deltas every K steps
+(reference geo-SGD).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DistributeTranspilerConfig", "DistributeTranspiler"]
+
+
+class DistributeTranspilerConfig:
+    """reference DistributeTranspilerConfig (slice/geo knobs)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.min_block_size = 8192
+        self.mode = "sync"          # "sync" | "geo"
+        self.geo_sgd_need_push_nums = 100
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._assign: Dict[str, int] = {}     # param name -> pserver index
+        self._endpoints: List[str] = []
+        self._model = None
+        self._trainers = 1
+        self._trainer_id = 0
+
+    def transpile(self, trainer_id: int, program=None, pservers: str = "",
+                  trainers: int = 1, sync_mode: bool = True):
+        """`program` is the model Layer (the trace IS the program here);
+        pservers: comma-separated host:port list."""
+        self._trainer_id = trainer_id
+        self._model = program
+        self._trainers = trainers
+        self._endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        if not self._endpoints:
+            raise ValueError("transpile needs at least one pserver endpoint")
+        if not sync_mode:
+            self.config.mode = "geo"
+        # greedy size-balanced assignment (reference slice_var_up splits big
+        # vars; table-granularity assignment keeps each param whole — the
+        # TCPStore transport has no block-slicing benefit)
+        sizes = [(name, int(np.prod(p.shape)))
+                 for name, p in program.named_parameters()]
+        load = [0] * len(self._endpoints)
+        for name, sz in sorted(sizes, key=lambda kv: -kv[1]):
+            i = load.index(min(load))
+            self._assign[name] = i
+            load[i] += sz
+        return self
+
+    # ------------------------------------------------------------- pserver
+
+    def get_pserver_program(self, endpoint: str):
+        """Table specs this endpoint serves: {param name: shape} — feed into
+        ps.DenseTable/PSServer (reference returns the server ProgramDesc)."""
+        idx = self._endpoints.index(endpoint)
+        return {name: tuple(p.shape)
+                for name, p in self._model.named_parameters()
+                if self._assign[name] == idx}
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint), None  # (main, startup)
+
+    # ------------------------------------------------------------- trainer
+
+    def get_trainer_program(self) -> "TrainerProgram":
+        return TrainerProgram(self)
+
+
+class TrainerProgram:
+    """Trainer-side runtime: pull params from their pservers before forward,
+    push grads after backward (reference send/recv op insertion)."""
+
+    def __init__(self, t: DistributeTranspiler):
+        from ..ps import PSClient
+        self._t = t
+        self._clients = []
+        for ep in t._endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._clients.append(PSClient(host, int(port)))
+        self._geo_acc: Dict[str, np.ndarray] = {}
+        self._step = 0
+
+    def pull_params(self):
+        model, t = self._t._model, self._t
+        for name, p in model.named_parameters():
+            cli = self._clients[t._assign[name]]
+            flat = cli.pull_dense(name)
+            p.set_value(flat.reshape(tuple(p.shape)).astype(str(p.dtype)))
+
+    def push_grads(self, lr: float = 1.0):
+        """Sync mode: push raw grads (server applies its optimizer). Geo mode:
+        accumulate locally, push deltas every geo_sgd_need_push_nums steps."""
+        model, t = self._t._model, self._t
+        cfg = t.config
+        self._step += 1
+        for name, p in model.named_parameters():
+            if p.grad is None:
+                continue
+            g = np.asarray(p.grad.numpy(), np.float32).ravel()
+            if cfg.mode == "geo":
+                acc = self._geo_acc.setdefault(name, np.zeros_like(g))
+                acc += g
+                if self._step % cfg.geo_sgd_need_push_nums == 0:
+                    self._clients[t._assign[name]].push_dense(name, acc * lr)
+                    acc[:] = 0
+            else:
+                self._clients[t._assign[name]].push_dense(name, g * lr)

@@ -1,0 +1,94 @@
+"""Model-file encryption (AES-128-CTR over the native cipher).
+
+Reference analog: paddle/fluid/framework/io/crypto/ — CipherFactory/AesCipher
++ CipherUtils key helpers, used to encrypt saved model/param files at rest.
+The block cipher is native C++ (core/native/crypto.cpp); this module adds the
+file format (magic + iv + ciphertext), key utilities, and the Cipher surface.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from ..core.native import load_library
+
+__all__ = ["Cipher", "CipherFactory", "CipherUtils"]
+
+_MAGIC = b"PTPUENC1"
+
+
+def _lib():
+    lib = load_library("crypto")
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.aes128_encrypt_block.argtypes = [u8p, u8p, u8p]
+    lib.aes128_ctr_crypt.restype = ctypes.c_int
+    lib.aes128_ctr_crypt.argtypes = [u8p, u8p, u8p, u8p, ctypes.c_long]
+    return lib
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+
+
+def _ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    lib = _lib()
+    inp = _buf(data)
+    out = (ctypes.c_uint8 * len(data))()
+    lib.aes128_ctr_crypt(_buf(key), _buf(iv), inp, out, len(data))
+    return bytes(out)
+
+
+class CipherUtils:
+    """reference CipherUtils: key generation + key file helpers."""
+
+    @staticmethod
+    def gen_key(length: int = 128) -> bytes:
+        if length not in (128,):
+            raise ValueError("AES-128 key: length must be 128 bits")
+        return os.urandom(length // 8)
+
+    @staticmethod
+    def gen_key_to_file(length: int, path: str) -> bytes:
+        key = CipherUtils.gen_key(length)
+        with open(path, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class Cipher:
+    """AES-128-CTR cipher (reference AesCipher via CipherFactory)."""
+
+    def __init__(self):
+        pass
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        if len(key) != 16:
+            raise ValueError("AES-128 needs a 16-byte key")
+        iv = os.urandom(16)
+        return _MAGIC + iv + _ctr(key, iv, plaintext)
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        if not ciphertext.startswith(_MAGIC):
+            raise ValueError("not a paddle_tpu encrypted blob (bad magic)")
+        iv = ciphertext[len(_MAGIC):len(_MAGIC) + 16]
+        return _ctr(key, iv, ciphertext[len(_MAGIC) + 16:])
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, path: str):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class CipherFactory:
+    @staticmethod
+    def create_cipher(config_file: Optional[str] = None) -> Cipher:
+        return Cipher()

@@ -1,3 +1,5 @@
 """incubate namespace (reference: python/paddle/incubate)."""
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
+from . import multiprocessing  # noqa: F401

@@ -1,0 +1,35 @@
+"""paddle.incubate.autotune parity surface.
+
+Reference analog: python/paddle/incubate/autotune.py set_config — a dict (or
+JSON file) with "kernel"/"layout"/"dataloader" sections; "kernel.enable"
+switches measured algorithm selection (phi/kernels/autotune/switch_autotune.cc).
+
+TPU mapping: the tunable kernels are Pallas block configs
+(paddle_tpu.kernels.autotune); layout autotune is XLA's job (accepted as a
+no-op toggle); dataloader tuning maps to DataLoader's own knobs.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional, Union
+
+from ..kernels import autotune as _kernel_autotune
+
+__all__ = ["set_config"]
+
+
+def set_config(config: Optional[Union[dict, str]] = None):
+    """Enable/disable autotune. None enables everything (reference default)."""
+    if config is None:
+        _kernel_autotune.enable()
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if "kernel" in config:  # only touch sections the config names
+        if config["kernel"].get("enable", False):
+            _kernel_autotune.enable()
+        else:
+            _kernel_autotune.disable()
+    # "layout" / "dataloader" sections: XLA picks layouts; DataLoader knobs
+    # are explicit ctor args — accepted for porting convenience.

@@ -1,0 +1,87 @@
+"""Cross-process tensor sharing.
+
+Reference analog: python/paddle/incubate/multiprocessing/reductions.py — a
+ForkingPickler reducer set so Tensors travel between processes through shared
+memory (file descriptors / cuda IPC) instead of byte serialization.
+
+TPU shape: device arrays are owned by the runtime (no IPC handles to HBM), so
+sharing means host staging: the reducer snapshots the tensor into a named
+POSIX shared-memory segment; the receiving process attaches, wraps it as
+numpy, and re-wraps as a Tensor. Large DataLoader workers and PS-style host
+pipelines get zero-serialization handoff; the pickle stream carries only the
+segment name + dtype/shape.
+"""
+from __future__ import annotations
+
+import atexit
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+from typing import List
+
+import numpy as np
+
+__all__ = ["init_reductions", "set_keepalive"]
+
+# Producer-side keepalive: segments must outlive the pickle until the consumer
+# attaches. Consumers copy out on rebuild, so a bounded window suffices — the
+# oldest segments are reclaimed once the ring fills (long-running producers
+# would otherwise pin one /dev/shm segment per tensor forever); the rest are
+# freed at exit. Raise the window via set_keepalive() if consumers attach late.
+_KEEPALIVE = 64
+_SEGMENTS: List[shared_memory.SharedMemory] = []
+
+
+def set_keepalive(n: int):
+    global _KEEPALIVE
+    _KEEPALIVE = max(1, int(n))
+
+
+def _release(seg: shared_memory.SharedMemory):
+    try:
+        seg.close()
+        seg.unlink()
+    except Exception:
+        pass
+
+
+def _remember(seg: shared_memory.SharedMemory):
+    _SEGMENTS.append(seg)
+    while len(_SEGMENTS) > _KEEPALIVE:
+        _release(_SEGMENTS.pop(0))
+
+
+def _cleanup():
+    for seg in _SEGMENTS:
+        _release(seg)
+    _SEGMENTS.clear()
+
+
+atexit.register(_cleanup)
+
+
+def _rebuild_tensor(shm_name: str, shape, dtype_str: str, stop_gradient: bool):
+    from ...core.tensor import Tensor
+    seg = shared_memory.SharedMemory(name=shm_name)
+    try:
+        view = np.ndarray(shape, dtype=np.dtype(dtype_str), buffer=seg.buf)
+        arr = np.array(view)  # own the data; segment may be unlinked after
+    finally:
+        seg.close()
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t):
+    arr = np.asarray(t.numpy())
+    seg = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    _remember(seg)
+    np.ndarray(arr.shape, dtype=arr.dtype, buffer=seg.buf)[...] = arr
+    return (_rebuild_tensor,
+            (seg.name, arr.shape, arr.dtype.str, bool(t.stop_gradient)))
+
+
+def init_reductions():
+    """Register the Tensor reducer (reference init_reductions). Idempotent."""
+    from ...core.tensor import Tensor
+    ForkingPickler.register(Tensor, _reduce_tensor)

@@ -1,0 +1,140 @@
+"""Kernel autotune: measured config selection with a persistent cache.
+
+Reference analog: phi/kernels/autotune/ — cache.h:76 keys algorithm choices by
+op + shape signature, switch_autotune.cc turns measurement on/off, and the
+gpu_timer measures candidate algorithms; the Python switch is
+paddle.incubate.autotune.set_config.
+
+TPU-native: the tunable knobs are Pallas grid/block parameters (a CUDA-algo
+pick has no analog — XLA owns op lowering), so the cache maps
+(kernel, shape-signature) -> block config. Candidates are measured on the real
+device with compile excluded (warmup first), and results persist as JSON so a
+job's first run pays the search once per shape family.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ...core.flags import flag  # FLAGS_use_autotune / _cache_file live in core
+
+__all__ = ["AutotuneCache", "autotune_pick", "enable", "disable", "status"]
+
+_LOCK = threading.Lock()
+
+
+class AutotuneCache:
+    """(kernel, key) -> chosen config, persisted as JSON."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._mem: Dict[str, Any] = {}
+        self._loaded = False
+        self.hits = 0
+        self.misses = 0
+
+    def _ensure_loaded(self):
+        if self._loaded:
+            return
+        self._loaded = True
+        path = self._path or flag("FLAGS_autotune_cache_file")
+        try:
+            with open(path) as f:
+                self._mem = json.load(f)
+        except (OSError, ValueError):
+            self._mem = {}
+
+    @staticmethod
+    def _k(kernel: str, key: Sequence) -> str:
+        return kernel + "|" + ",".join(str(x) for x in key)
+
+    def get(self, kernel: str, key: Sequence):
+        with _LOCK:
+            self._ensure_loaded()
+            got = self._mem.get(self._k(kernel, key))
+            if got is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return got
+
+    def put(self, kernel: str, key: Sequence, config):
+        with _LOCK:
+            self._ensure_loaded()
+            self._mem[self._k(kernel, key)] = config
+            path = self._path or flag("FLAGS_autotune_cache_file")
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._mem, f)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # cache is an optimization; never fail the op
+
+    def clear(self):
+        with _LOCK:
+            self._mem = {}
+            self._loaded = True
+
+
+_CACHE = AutotuneCache()
+
+
+def cache() -> AutotuneCache:
+    return _CACHE
+
+
+def autotune_pick(kernel: str, key: Sequence,
+                  candidates: List[Tuple],
+                  measure: Callable[[Tuple], Callable[[], Any]],
+                  warmup: int = 1, iters: int = 3) -> Tuple:
+    """Return the fastest candidate for (kernel, key), consulting the cache.
+
+    `measure(config)` returns a zero-arg callable that runs the kernel to
+    completion (caller blocks on the result); its first `warmup` calls are
+    excluded (compile time). Failing candidates (e.g. VMEM overflow) are
+    skipped. With a single candidate or autotune disabled the caller should
+    not get here — this function always measures on a miss.
+    """
+    cached = _CACHE.get(kernel, key)
+    if cached is not None:
+        return tuple(cached)
+    best, best_t = None, float("inf")
+    for cand in candidates:
+        try:
+            fn = measure(cand)
+            for _ in range(warmup):
+                fn()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue  # candidate doesn't lower / out of VMEM — skip
+        if dt < best_t:
+            best, best_t = cand, dt
+    if best is None:
+        raise RuntimeError(f"autotune: every candidate failed for {kernel} "
+                           f"key={tuple(key)}")
+    _CACHE.put(kernel, key, list(best))
+    return best
+
+
+def enable():
+    from ...core.flags import set_flags
+    set_flags({"FLAGS_use_autotune": True})
+
+
+def disable():
+    from ...core.flags import set_flags
+    set_flags({"FLAGS_use_autotune": False})
+
+
+def status() -> Dict[str, Any]:
+    """reference autotune status (cache hit/miss counters)."""
+    return {"use_autotune": flag("FLAGS_use_autotune"),
+            "cache_hits": _CACHE.hits, "cache_misses": _CACHE.misses}

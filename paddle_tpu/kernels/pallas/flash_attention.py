@@ -405,11 +405,55 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, dropout_rate, interpret,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _tuned_blocks(bh, lq, lk, d, dtype, causal, sm_scale, dropout_rate):
+    """Pick (block_q, block_k) via the measured autotune cache
+    (kernels/autotune; reference: phi/kernels/autotune switch + cache).
+
+    Measurement synthesizes sample arrays from the shape signature, so it
+    works at trace time too (the flagship path hits this inside jit, where
+    the real operands are tracers). Forward-kernel time is the selection
+    metric; bwd shares the config through the custom_vjp's nondiff args."""
+    from ..autotune import autotune_pick
+    import numpy as np
+
+    key = (bh, lq, lk, d, str(dtype), int(causal), int(dropout_rate > 0))
+    # per-axis candidates, deduped through the same clamp the kernel applies
+    # (a 128-long axis collapses every size to one real kernel)
+    sizes_q = [s for s in (256, 512, 1024) if s <= lq] or [256]
+    sizes_k = [s for s in (256, 512, 1024) if s <= lk] or [256]
+    cands = sorted({_norm_blocks(bq, bk, lq, lk)
+                    for bq in sizes_q for bk in sizes_k})
+    if len(cands) == 1:
+        return cands[0]  # nothing to measure
+    sample = [None]  # lazily allocated once, only on a cache miss
+
+    def measure(cand):
+        if sample[0] is None:
+            rs = np.random.RandomState(0)
+            qm = jnp.asarray(rs.randn(bh, lq, d), dtype)
+            km = jnp.asarray(rs.randn(bh, lk, d), dtype)
+            vm = jnp.asarray(rs.randn(bh, lk, d), dtype)
+            sample[0] = (qm, km, vm, jnp.asarray([0], jnp.int32))
+        qm, km, vm, sd = sample[0]
+        bq, bk = cand
+
+        def run():
+            out = _flash(qm, km, vm, sd, causal, sm_scale, bq, bk,
+                         float(dropout_rate), False)
+            jax.block_until_ready(out)
+        return run
+
+    return autotune_pick("flash_attention", key, cands, measure)
+
+
 def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
                          dropout_rate=0.0, seed=0,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         block_q=None, block_k=None,
                          interpret=False):
-    """Flash attention on [B, L, H, D] arrays (jax.Array or Tensor-like .value())."""
+    """Flash attention on [B, L, H, D] arrays (jax.Array or Tensor-like .value()).
+
+    block_q/block_k default to the autotuned choice when FLAGS_use_autotune is
+    on (persistent measured cache), else DEFAULT_BLOCK_Q/K."""
     unwrap = lambda t: t.value() if hasattr(t, "value") else t
     q, k, v = unwrap(q), unwrap(k), unwrap(v)
     b, lq, h, d = q.shape
@@ -426,6 +470,14 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
     kr = to_bhld(k, lk)
     vr = to_bhld(v, lk)
     seed_arr = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
+    if block_q is None or block_k is None:
+        from ...core.flags import flag
+        tb = None
+        if flag("FLAGS_use_autotune") and not interpret:
+            tb = _tuned_blocks(b * h, lq, lk, d, q.dtype, bool(causal),
+                               float(sm_scale), float(dropout_rate))
+        block_q = block_q or (tb[0] if tb else DEFAULT_BLOCK_Q)
+        block_k = block_k or (tb[1] if tb else DEFAULT_BLOCK_K)
     out = _flash(qr, kr, vr, seed_arr, bool(causal), float(sm_scale),
                  block_q, block_k, float(dropout_rate), bool(interpret))
     return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)

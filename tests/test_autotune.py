@@ -1,0 +1,102 @@
+"""Autotune cache tests (reference: phi/kernels/autotune/cache.h + the
+switch_autotune on/off contract; Python surface incubate/autotune.py)."""
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels.autotune import (
+    AutotuneCache, autotune_pick, cache, disable, enable, status)
+
+
+def test_cache_roundtrip_and_persistence(tmp_path):
+    path = str(tmp_path / "at.json")
+    c = AutotuneCache(path)
+    assert c.get("k", (1, 2)) is None
+    c.put("k", (1, 2), [512, 256])
+    assert c.get("k", (1, 2)) == [512, 256]
+    # fresh instance reads the persisted file
+    c2 = AutotuneCache(path)
+    assert c2.get("k", (1, 2)) == [512, 256]
+    assert c2.get("k", (9, 9)) is None
+
+
+def test_pick_selects_fastest_and_caches(tmp_path, monkeypatch):
+    import paddle_tpu.kernels.autotune as at
+    monkeypatch.setattr(at, "_CACHE", AutotuneCache(str(tmp_path / "a.json")))
+
+    calls = []
+
+    def measure(cand):
+        def run():
+            calls.append(cand)
+            time.sleep(0.001 if cand == (2, 2) else 0.02)
+        return run
+
+    best = autotune_pick("toy", (8, 128), [(1, 1), (2, 2)], measure,
+                         warmup=1, iters=1)
+    assert best == (2, 2)
+    n_calls = len(calls)
+    # second call: pure cache hit, no measurement
+    best2 = autotune_pick("toy", (8, 128), [(1, 1), (2, 2)], measure)
+    assert best2 == (2, 2) and len(calls) == n_calls
+
+
+def test_pick_skips_failing_candidates(tmp_path, monkeypatch):
+    import paddle_tpu.kernels.autotune as at
+    monkeypatch.setattr(at, "_CACHE", AutotuneCache(str(tmp_path / "b.json")))
+
+    def measure(cand):
+        if cand == (1, 1):
+            raise RuntimeError("VMEM overflow")  # at build time
+        return lambda: None
+
+    assert autotune_pick("toy2", (), [(1, 1), (4, 4)], measure) == (4, 4)
+
+    def all_fail(cand):
+        raise RuntimeError("nope")
+
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        autotune_pick("toy3", (), [(1, 1)], all_fail)
+
+
+def test_switch_and_status():
+    enable()
+    assert status()["use_autotune"] is True
+    disable()
+    assert status()["use_autotune"] is False
+
+
+def test_incubate_set_config():
+    import paddle_tpu.incubate.autotune as iat
+    iat.set_config({"kernel": {"enable": True}})
+    assert status()["use_autotune"] is True
+    iat.set_config({"kernel": {"enable": False}})
+    assert status()["use_autotune"] is False
+    iat.set_config(None)
+    assert status()["use_autotune"] is True
+    disable()
+
+
+def test_flash_defaults_untouched_when_disabled():
+    """With autotune off, the flash kernel resolves to its default blocks and
+    still runs (interpret mode on CPU)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.kernels.pallas.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention_blhd,
+        _reference_attention)
+    disable()
+    rs = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rs.randn(1, 256, 2, 64), jnp.float32)
+               for _ in range(3))
+    out = flash_attention_blhd(q, k, v, causal=True, interpret=True)
+    b, l, h, d = q.shape
+    ref = _reference_attention(
+        jnp.swapaxes(q, 1, 2).reshape(b * h, l, d),
+        jnp.swapaxes(k, 1, 2).reshape(b * h, l, d),
+        jnp.swapaxes(v, 1, 2).reshape(b * h, l, d),
+        causal=True, sm_scale=1.0 / np.sqrt(d))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(jnp.swapaxes(
+            ref.reshape(b, h, l, d), 1, 2)), rtol=2e-4, atol=2e-4)
