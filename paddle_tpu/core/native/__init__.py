@@ -21,22 +21,32 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def build_shared(src: str, out: str, extra_flags=()) -> str:
+    """Compile one translation unit to a shared library if stale; returns the
+    .so path. Shared by load_library and out-of-tree builders (inference C
+    ABI) so the stale-check/tmp-replace/error-tail logic lives once."""
+    if not os.path.exists(out) or \
+            os.path.getmtime(out) < os.path.getmtime(src):
+        # extra_flags go AFTER the source so -l libraries resolve the
+        # object's undefined symbols (linker scans left to right)
+        cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                src, "-o", out + ".tmp"] + list(extra_flags))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build of {os.path.basename(src)} failed:\n"
+                f"{proc.stderr[-2000:]}")
+        os.replace(out + ".tmp", out)
+    return out
+
+
 def load_library(name: str) -> ctypes.CDLL:
     """Compile <name>.cpp in this directory to _<name>.so (if stale) and load."""
     with _LOCK:
         if name in _CACHE:
             return _CACHE[name]
         src = os.path.join(_DIR, f"{name}.cpp")
-        out = os.path.join(_DIR, f"_{name}.so")
-        if not os.path.exists(out) or \
-                os.path.getmtime(out) < os.path.getmtime(src):
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                   src, "-o", out + ".tmp"]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise NativeBuildError(
-                    f"native build of {name} failed:\n{proc.stderr[-2000:]}")
-            os.replace(out + ".tmp", out)
+        out = build_shared(src, os.path.join(_DIR, f"_{name}.so"))
         lib = ctypes.CDLL(out)
         _CACHE[name] = lib
         return lib

@@ -1,0 +1,29 @@
+"""Build helper for the C inference ABI (reference: inference/capi_exp).
+
+`build_capi_library()` compiles paddle_inference_c.cpp against the running
+interpreter's headers/libs and returns the .so path; C/Go/Rust hosts dlopen
+that library — they need no Python of their own (the library embeds it).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+
+def build_capi_library() -> str:
+    from ...core.native import build_shared
+    src = os.path.join(_DIR, "paddle_inference_c.cpp")
+    out = os.path.join(_DIR, "libpaddle_inference_c.so")
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    with _LOCK:
+        return build_shared(src, out, extra_flags=[
+            f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+            f"-lpython{ver}", "-ldl", "-lm"])

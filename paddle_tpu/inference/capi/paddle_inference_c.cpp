@@ -1,0 +1,258 @@
+// Stable C ABI for the inference engine, usable from any language with FFI.
+//
+// Reference analog: paddle/fluid/inference/capi_exp/ (PD_Config /
+// PD_Predictor / PD_Tensor C surface over AnalysisPredictor, consumed by the
+// C and Go clients). Here the predictor runs XLA executables owned by the
+// Python runtime, so this library embeds CPython on first use and drives the
+// flat helper functions in paddle_tpu/inference/capi_bridge.py — the host
+// program needs no Python of its own, it just links/dlopens this library.
+//
+// Env knobs read at init:
+//   PADDLE_TPU_ROOT      repo/site root to add to sys.path (default /root/repo)
+//   PADDLE_TPU_PLATFORM  force a jax platform (e.g. "cpu") before first use
+//
+// Thread safety: every call takes the GIL (PyGILState_Ensure); predictors may
+// be cloned for concurrent serving like the reference's PD_PredictorClone.
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+PyObject* g_bridge = nullptr;
+
+bool ensure_python() {
+  if (g_bridge) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves this thread holding the GIL; release it so the
+    // PyGILState_Ensure/Release pairs below (and calls from OTHER host
+    // threads — clones exist for concurrent serving) can acquire it
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  const char* root = std::getenv("PADDLE_TPU_ROOT");
+  std::string boot =
+      "import os, sys\n"
+      "sys.path.insert(0, os.environ.get('PADDLE_TPU_ROOT', '/root/repo'))\n"
+      "_plat = os.environ.get('PADDLE_TPU_PLATFORM')\n"
+      "if _plat:\n"
+      "    import jax\n"
+      "    jax.config.update('jax_platforms', _plat)\n";
+  (void)root;
+  if (PyRun_SimpleString(boot.c_str()) != 0) {
+    PyGILState_Release(gil);
+    return false;
+  }
+  g_bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  if (!g_bridge) PyErr_Print();
+  PyGILState_Release(gil);
+  return g_bridge != nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct PD_Config {
+  std::string prefix;
+};
+
+struct PD_Predictor {
+  long pid;
+};
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigSetModel(PD_Config* c, const char* prog, const char* params) {
+  c->prefix = prog ? prog : "";
+  // strip the reference's .pdmodel suffix if given
+  const std::string suf = ".pdmodel";
+  if (c->prefix.size() > suf.size() &&
+      c->prefix.compare(c->prefix.size() - suf.size(), suf.size(), suf) == 0)
+    c->prefix.resize(c->prefix.size() - suf.size());
+  (void)params;
+}
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(g_bridge, "create_predictor", "s",
+                                    c->prefix.c_str());
+  PD_Predictor* p = nullptr;
+  if (r) {
+    p = new PD_Predictor{PyLong_AsLong(r)};
+    Py_DECREF(r);
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return p;
+}
+
+PD_Predictor* PD_PredictorClone(PD_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(g_bridge, "clone_predictor", "l", p->pid);
+  PD_Predictor* out = nullptr;
+  if (r) {
+    out = new PD_Predictor{PyLong_AsLong(r)};
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(g_bridge, "destroy_predictor", "l", p->pid);
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+// Writes newline-separated names into buf; returns needed length.
+static int names_into(const char* fn, long pid, char* buf, int cap) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int need = -1;
+  PyObject* r = PyObject_CallMethod(g_bridge, fn, "l", pid);
+  if (r) {
+    const char* s = PyUnicode_AsUTF8(r);
+    need = static_cast<int>(std::strlen(s));
+    if (buf && cap > need) std::memcpy(buf, s, need + 1);
+    Py_DECREF(r);
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return need;
+}
+
+int PD_PredictorGetInputNames(PD_Predictor* p, char* buf, int cap) {
+  return names_into("get_input_names", p->pid, buf, cap);
+}
+
+int PD_PredictorGetOutputNames(PD_Predictor* p, char* buf, int cap) {
+  return names_into("get_output_names", p->pid, buf, cap);
+}
+
+// dtype: "float32", "int32", "int64", ... (numpy names); shape int64[ndim]
+int PD_PredictorSetInput(PD_Predictor* p, const char* name, const void* data,
+                         const long long* shape, int ndim, const char* dtype) {
+  // complete itemsize table; unknown dtypes are rejected (a wrong guess
+  // would read out of bounds from the caller's buffer)
+  Py_ssize_t itemsize;
+  if (std::strcmp(dtype, "float64") == 0 || std::strcmp(dtype, "int64") == 0 ||
+      std::strcmp(dtype, "uint64") == 0)
+    itemsize = 8;
+  else if (std::strcmp(dtype, "float32") == 0 ||
+           std::strcmp(dtype, "int32") == 0 ||
+           std::strcmp(dtype, "uint32") == 0)
+    itemsize = 4;
+  else if (std::strcmp(dtype, "float16") == 0 ||
+           std::strcmp(dtype, "bfloat16") == 0 ||
+           std::strcmp(dtype, "int16") == 0 ||
+           std::strcmp(dtype, "uint16") == 0)
+    itemsize = 2;
+  else if (std::strcmp(dtype, "int8") == 0 || std::strcmp(dtype, "uint8") == 0 ||
+           std::strcmp(dtype, "bool") == 0)
+    itemsize = 1;
+  else
+    return -2;  // unknown dtype
+  PyGILState_STATE gil = PyGILState_Ensure();
+  long long n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(n * itemsize));
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* r = PyObject_CallMethod(g_bridge, "set_input", "lsOOs", p->pid,
+                                    name, bytes, shp, dtype);
+  Py_DECREF(bytes);
+  Py_DECREF(shp);
+  int ok = r != nullptr;
+  if (!r) PyErr_Print();
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return ok ? 0 : -1;
+}
+
+// Returns the number of outputs, or -1.
+int PD_PredictorRun(PD_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(g_bridge, "run", "l", p->pid);
+  int n = -1;
+  if (r) {
+    n = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+// shape_out: int64[cap]; returns ndim, or -1.
+int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, long long* shape_out,
+                               int cap) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int nd = -1;
+  PyObject* r = PyObject_CallMethod(g_bridge, "get_output_shape", "li",
+                                    p->pid, idx);
+  if (r) {
+    nd = static_cast<int>(PyTuple_Size(r));
+    for (int i = 0; i < nd && i < cap; ++i)
+      shape_out[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(r, i));
+    Py_DECREF(r);
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return nd;
+}
+
+// Copies raw output bytes; returns byte count (call with null buf to size).
+long long PD_PredictorGetOutputData(PD_Predictor* p, int idx, void* buf,
+                                    long long cap) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  long long n = -1;
+  PyObject* r = PyObject_CallMethod(g_bridge, "get_output_bytes", "li",
+                                    p->pid, idx);
+  if (r) {
+    char* data = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(r, &data, &len);
+    n = len;
+    if (buf && cap >= len) std::memcpy(buf, data, static_cast<size_t>(len));
+    Py_DECREF(r);
+  } else {
+    PyErr_Print();
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+int PD_PredictorGetOutputDtype(PD_Predictor* p, int idx, char* buf, int cap) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int need = -1;
+  PyObject* r = PyObject_CallMethod(g_bridge, "get_output_dtype", "li",
+                                    p->pid, idx);
+  if (r) {
+    const char* s = PyUnicode_AsUTF8(r);
+    need = static_cast<int>(std::strlen(s));
+    if (buf && cap > need) std::memcpy(buf, s, need + 1);
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return need;
+}
+
+const char* PD_GetVersion() { return "paddle_tpu-inference-c 1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+"""Python half of the C inference API.
+
+Reference analog: paddle/fluid/inference/capi_exp/ — the stable C ABI over
+AnalysisPredictor. The native library (capi/paddle_inference_c.cpp) embeds
+CPython and calls ONLY the flat functions in this module with scalar/bytes
+arguments, so the C side never touches numpy or object internals.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_PREDICTORS: Dict[int, object] = {}
+_NEXT = [1]
+_INPUTS: Dict[int, Dict[str, np.ndarray]] = {}
+_OUTPUTS: Dict[int, List[np.ndarray]] = {}
+
+
+def create_predictor(model_prefix: str) -> int:
+    from . import Config, Predictor
+    pred = Predictor(Config(model_prefix))
+    pid = _NEXT[0]
+    _NEXT[0] += 1
+    _PREDICTORS[pid] = pred
+    _INPUTS[pid] = {}
+    _OUTPUTS[pid] = []
+    return pid
+
+
+def clone_predictor(pid: int) -> int:
+    pred = _PREDICTORS[pid].clone()
+    new = _NEXT[0]
+    _NEXT[0] += 1
+    _PREDICTORS[new] = pred
+    _INPUTS[new] = {}
+    _OUTPUTS[new] = []
+    return new
+
+
+def destroy_predictor(pid: int):
+    _PREDICTORS.pop(pid, None)
+    _INPUTS.pop(pid, None)
+    _OUTPUTS.pop(pid, None)
+
+
+def get_input_names(pid: int) -> str:
+    return "\n".join(_PREDICTORS[pid].get_input_names())
+
+
+def get_output_names(pid: int) -> str:
+    return "\n".join(_PREDICTORS[pid].get_output_names())
+
+
+def set_input(pid: int, name: str, data: bytes, shape: tuple,
+              dtype: str) -> None:
+    arr = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
+    _INPUTS[pid][name] = arr
+
+
+def run(pid: int) -> int:
+    pred = _PREDICTORS[pid]
+    names = pred.get_input_names()
+    feed = [_INPUTS[pid][n] for n in names]
+    _OUTPUTS[pid] = [np.ascontiguousarray(o) for o in pred.run(feed)]
+    return len(_OUTPUTS[pid])
+
+
+def get_output_shape(pid: int, idx: int) -> tuple:
+    return tuple(int(d) for d in _OUTPUTS[pid][idx].shape)
+
+
+def get_output_dtype(pid: int, idx: int) -> str:
+    return str(_OUTPUTS[pid][idx].dtype)
+
+
+def get_output_bytes(pid: int, idx: int) -> bytes:
+    return _OUTPUTS[pid][idx].tobytes()

@@ -1,0 +1,149 @@
+"""C inference API test: a real C program (no Python) dlopens the library,
+feeds a saved model, and its output must match the in-process predictor.
+
+Reference pattern: the capi_exp tests drive PD_Predictor* through the C ABI
+against a saved model.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi")
+    prefix = str(d / "net")
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                               paddle.nn.Linear(16, 4))
+    net.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 8).astype("float32"))
+    ref = net(x).numpy()
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([-1, 8], "float32")])
+    return prefix, ref
+
+
+def test_capi_via_ctypes(saved_model):
+    """Drive the C ABI in-process through ctypes (fast sanity layer)."""
+    from paddle_tpu.inference.capi import build_capi_library
+    prefix, ref = saved_model
+    lib = ctypes.CDLL(build_capi_library())
+    lib.PD_ConfigCreate.restype = ctypes.c_void_p
+    lib.PD_ConfigSetModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p]
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorSetInput.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_char_p]
+    lib.PD_PredictorRun.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorGetOutputShape.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int]
+    lib.PD_PredictorGetOutputData.restype = ctypes.c_longlong
+    lib.PD_PredictorGetOutputData.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong]
+
+    cfg = lib.PD_ConfigCreate()
+    lib.PD_ConfigSetModel(cfg, prefix.encode(), None)
+    pred = lib.PD_PredictorCreate(cfg)
+    assert pred
+
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    shape = (ctypes.c_longlong * 2)(3, 8)
+    rc = lib.PD_PredictorSetInput(pred, b"input_0",
+                                  x.ctypes.data_as(ctypes.c_void_p), shape, 2,
+                                  b"float32")
+    assert rc == 0
+    n_out = lib.PD_PredictorRun(pred)
+    assert n_out == 1
+    oshape = (ctypes.c_longlong * 8)()
+    nd = lib.PD_PredictorGetOutputShape(pred, 0, oshape, 8)
+    assert nd == 2 and list(oshape[:2]) == [3, 4]
+    out = np.empty((3, 4), np.float32)
+    n = lib.PD_PredictorGetOutputData(pred, 0,
+                                      out.ctypes.data_as(ctypes.c_void_p),
+                                      out.nbytes)
+    assert n == out.nbytes
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+_C_PROGRAM = r"""
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* (*fcfg_create)(void);
+typedef void (*fcfg_set)(void*, const char*, const char*);
+typedef void* (*fpred_create)(void*);
+typedef int (*fset_input)(void*, const char*, const void*,
+                          const long long*, int, const char*);
+typedef int (*frun)(void*);
+typedef long long (*fget_data)(void*, int, void*, long long);
+
+int main(int argc, char** argv) {
+  void* h = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!h) { fprintf(stderr, "dlopen: %s\n", dlerror()); return 2; }
+  fcfg_create cfg_create = (fcfg_create)dlsym(h, "PD_ConfigCreate");
+  fcfg_set cfg_set = (fcfg_set)dlsym(h, "PD_ConfigSetModel");
+  fpred_create pred_create = (fpred_create)dlsym(h, "PD_PredictorCreate");
+  fset_input set_input = (fset_input)dlsym(h, "PD_PredictorSetInput");
+  frun run = (frun)dlsym(h, "PD_PredictorRun");
+  fget_data get_data = (fget_data)dlsym(h, "PD_PredictorGetOutputData");
+  if (!cfg_create || !pred_create) { fprintf(stderr, "dlsym failed\n"); return 2; }
+
+  void* cfg = cfg_create();
+  cfg_set(cfg, argv[2], NULL);
+  void* pred = pred_create(cfg);
+  if (!pred) { fprintf(stderr, "predictor create failed\n"); return 3; }
+
+  float x[3 * 8];
+  FILE* f = fopen(argv[3], "rb");
+  if (fread(x, sizeof(float), 24, f) != 24) return 4;
+  fclose(f);
+  long long shape[2] = {3, 8};
+  if (set_input(pred, "input_0", x, shape, 2, "float32") != 0) return 5;
+  if (run(pred) != 1) return 6;
+  float out[3 * 4];
+  if (get_data(pred, 0, out, sizeof(out)) != (long long)sizeof(out)) return 7;
+  for (int i = 0; i < 12; ++i) printf("%.6f\n", out[i]);
+  return 0;
+}
+"""
+
+
+def test_capi_from_pure_c_program(saved_model, tmp_path):
+    """The full story: compile a C program, no Python linkage, dlopen the lib."""
+    from paddle_tpu.inference.capi import build_capi_library
+    prefix, ref = saved_model
+    libpath = build_capi_library()
+
+    csrc = tmp_path / "main.c"
+    csrc.write_text(textwrap.dedent(_C_PROGRAM))
+    exe = str(tmp_path / "capi_demo")
+    subprocess.run(["gcc", str(csrc), "-o", exe, "-ldl"], check=True)
+
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    xfile = str(tmp_path / "x.bin")
+    x.tofile(xfile)
+
+    env = dict(os.environ)
+    env["PADDLE_TPU_ROOT"] = REPO
+    env["PADDLE_TPU_PLATFORM"] = "cpu"   # deterministic vs the CPU-forced suite
+    proc = subprocess.run([exe, libpath, prefix, xfile], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    got = np.asarray([float(v) for v in proc.stdout.split()],
+                     np.float32).reshape(3, 4)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
