@@ -11,7 +11,7 @@ import multiprocessing as mp
 import os
 import pickle
 from enum import IntEnum
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional
 
 import numpy as np
 
@@ -23,9 +23,9 @@ from .env import init_parallel_env
 __all__ = ["spawn", "gather", "scatter_object_list", "broadcast_object_list",
            "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
            "alltoall_single", "ParallelMode", "destroy_process_group",
-           "isend", "irecv", "is_available", "get_backend", "QueueDataset",
-           "InMemoryDataset", "CountFilterEntry", "ShowClickEntry",
-           "ProbabilityEntry"]
+           "isend", "irecv", "is_available", "get_backend",
+           "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry",
+           "P2POp", "batch_isend_irecv"]
 
 
 class ParallelMode(IntEnum):
@@ -212,6 +212,30 @@ def irecv(tensor, src: int = 0, group=None):
     return _CompletedTask()
 
 
+class P2POp:
+    """One batched p2p descriptor (reference communication/batch_isend_irecv
+    P2POp: op is paddle.distributed.isend/irecv)."""
+
+    def __init__(self, op, tensor, peer: int = 0, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a batch of sends/recvs; returns task handles (reference
+    batch_isend_irecv). Sends run before recvs so paired exchanges in one
+    batch can't deadlock in the single-controller mailbox model."""
+    if not p2p_op_list:
+        return []
+    ordered = ([p for p in p2p_op_list if p.op is isend]
+               + [p for p in p2p_op_list if p.op is irecv])
+    return [p.op(p.tensor, p.peer, group=p.group) for p in ordered]
+
+
 # --------------------------------------------------------- PS dataset configs
 
 class _Entry:
@@ -234,69 +258,3 @@ class ShowClickEntry(_Entry):
 class ProbabilityEntry(_Entry):
     def __init__(self, probability: float = 1.0):
         super().__init__(probability=probability)
-
-
-class InMemoryDataset:
-    """Minimal in-memory PS dataset: load text files, global shuffle, iterate
-    (reference fleet/dataset/dataset.py InMemoryDataset over data_set.cc)."""
-
-    def __init__(self):
-        self._records: List[str] = []
-        self._batch = 1
-        self._parse = None
-
-    def init(self, batch_size: int = 1, use_var=None, pipe_command=None,
-             parse_fn=None, **kw):
-        self._batch = batch_size
-        self._parse = parse_fn
-
-    set_batch_size = init
-
-    def set_filelist(self, filelist: Sequence[str]):
-        self._files = list(filelist)
-
-    def load_into_memory(self):
-        self._records = []
-        for path in getattr(self, "_files", []):
-            with open(path) as f:
-                self._records.extend(line.rstrip("\n") for line in f)
-
-    def global_shuffle(self, fleet=None, thread_num: int = 1, seed: int = 0):
-        rs = np.random.RandomState(seed)
-        rs.shuffle(self._records)
-
-    def get_memory_data_size(self, fleet=None) -> int:
-        return len(self._records)
-
-    def release_memory(self):
-        self._records = []
-
-    def __iter__(self):
-        buf = []
-        for rec in self._records:
-            buf.append(self._parse(rec) if self._parse else rec)
-            if len(buf) == self._batch:
-                yield buf
-                buf = []
-        if buf:
-            yield buf
-
-
-class QueueDataset(InMemoryDataset):
-    """Streaming variant (reference QueueDataset): iterates files directly."""
-
-    def load_into_memory(self):
-        pass  # streaming: records read at iteration time
-
-    def __iter__(self):
-        buf = []
-        for path in getattr(self, "_files", []):
-            with open(path) as f:
-                for line in f:
-                    rec = line.rstrip("\n")
-                    buf.append(self._parse(rec) if self._parse else rec)
-                    if len(buf) == self._batch:
-                        yield buf
-                        buf = []
-        if buf:
-            yield buf

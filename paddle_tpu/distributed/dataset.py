@@ -66,14 +66,17 @@ class _DatasetBase:
         self._drop_last = False
 
     def init(self, batch_size: int = 1, thread_num: int = 1,
-             use_var: Optional[Sequence] = None, **kwargs):
+             use_var: Optional[Sequence] = None, parse_fn=None, **kwargs):
         """reference DatasetBase.init; use_var: SlotDesc list (or objects with
-        .name) declaring the slot schema."""
+        .name) declaring the slot schema. parse_fn (line -> record tuple)
+        overrides the default slot parser (reference pipe_command analog)."""
         self._batch_size = batch_size
         if use_var:
             self._slots = [v if isinstance(v, SlotDesc)
                            else SlotDesc(getattr(v, "name", str(v)))
                            for v in use_var]
+        if parse_fn is not None:
+            self._parse = lambda line, _slots: parse_fn(line)
         return self
 
     def set_filelist(self, files: Sequence[str]):
@@ -135,8 +138,9 @@ class InMemoryDataset(_DatasetBase):
     def local_shuffle(self, seed: Optional[int] = None):
         random.Random(seed).shuffle(self._records)
 
-    def global_shuffle(self, store=None, rank: int = 0, world: int = 1,
-                       seed: int = 0, prefix: str = "ds"):
+    def global_shuffle(self, fleet=None, thread_num: int = 12, store=None,
+                       rank: int = 0, world: int = 1, seed: int = 0,
+                       prefix: str = "ds"):
         """Redistribute records across ranks by hash, then shuffle locally
         (reference data_set.cc GlobalShuffle over trainers).
 

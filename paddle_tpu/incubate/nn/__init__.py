@@ -17,6 +17,7 @@ from typing import Optional
 
 from ... import nn
 from ...nn import functional as F
+from .memory_efficient_attention import memory_efficient_attention  # noqa: F401,E501
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedMultiTransformer"]

@@ -1,0 +1,44 @@
+"""memory_efficient_attention (xformers-style surface).
+
+Reference analog: python/paddle/incubate/nn/memory_efficient_attention.py —
+the cutlass-backed fmha wrapper with (query, key, value, attn_bias, p, scale,
+training) semantics in [B, L, H, D] layout.
+
+TPU-native: the same memory property (no [L, L] matrix in HBM) comes from the
+Pallas flash kernel when the shapes qualify; additive-bias / small-shape
+calls use the XLA softmax chain which the compiler schedules flash-like.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ...nn import functional as F
+
+__all__ = ["memory_efficient_attention"]
+
+
+class LowerTriangularMask:
+    """Marker for causal masking (reference attn_bias type)."""
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale: Optional[float] = None, training=True):
+    causal = (isinstance(attn_bias, LowerTriangularMask)
+              or isinstance(attn_bias, type) and
+              issubclass(attn_bias, LowerTriangularMask)
+              or (isinstance(attn_bias, str) and attn_bias == "causal"))
+    if causal:
+        attn_bias = None
+    if scale is not None:
+        # fold a custom scale into q (flash path takes scale from head_dim)
+        query = query * (scale * math.sqrt(query.shape[-1]))
+    if attn_bias is None:
+        return F.flash_attention(query, key, value, dropout=p, causal=causal,
+                                 training=training)
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p,
+        is_causal=False, training=training)
+
+
+memory_efficient_attention.LowerTriangularMask = LowerTriangularMask

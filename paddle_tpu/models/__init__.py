@@ -7,5 +7,8 @@ those layers. Here they are first-class since they are the benchmark configs: GP
 """
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_tiny  # noqa: F401
 from .bert import BertConfig, BertModel, BertForPreTraining, bert_base, bert_tiny  # noqa: F401
+from .ernie import (ErnieConfig, ErnieModel,  # noqa: F401
+                    ErnieForSequenceClassification, ErnieForMaskedLM,
+                    ernie_tiny)
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM, llama_tiny,  # noqa: F401
                     llama_7b, shard_llama_tp)

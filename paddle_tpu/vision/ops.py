@@ -1,0 +1,555 @@
+"""Detection / vision operators.
+
+Reference analog: python/paddle/vision/ops.py (yolo_box, prior_box,
+box_coder, roi_align/roi_pool, deform_conv2d, nms,
+distribute_fpn_proposals) over the CUDA kernels in fluid/operators/detection.
+
+TPU-native split: the dense, differentiable math (roi_align sampling,
+box decoding, anchors, deformable conv) is jnp — it jits, shards, and gets
+gradients through the dispatch tape; the inherently data-dependent,
+variable-length post-processing (greedy NMS, FPN level grouping, roi_pool's
+integer bin walk) runs host-side on numpy, which is where serving pipelines
+run it anyway (XLA cannot express their dynamic output shapes without
+padding contracts).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..ops._helpers import _op
+from .. import nn
+
+__all__ = ["nms", "roi_align", "RoIAlign", "roi_pool", "RoIPool",
+           "box_coder", "yolo_box", "prior_box", "deform_conv2d",
+           "DeformConv2D", "distribute_fpn_proposals"]
+
+
+def _np(t):
+    return t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+
+
+# --------------------------------------------------------------------- nms
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None):
+    """Greedy hard NMS (reference vision/ops.py nms). boxes [N,4] xyxy.
+    Without scores: boxes are pre-sorted. With categories: per-class NMS.
+    Returns kept indices (Tensor int64), score-descending."""
+    b = _np(boxes).astype(np.float64)
+    n = b.shape[0]
+    if scores is not None:
+        s = _np(scores).astype(np.float64)
+        order = np.argsort(-s, kind="stable")
+    else:
+        order = np.arange(n)
+
+    def greedy(idxs):
+        keep = []
+        suppressed = np.zeros(len(idxs), bool)
+        x1, y1, x2, y2 = (b[idxs, i] for i in range(4))
+        area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        for i in range(len(idxs)):
+            if suppressed[i]:
+                continue
+            keep.append(idxs[i])
+            xx1 = np.maximum(x1[i], x1[i + 1:])
+            yy1 = np.maximum(y1[i], y1[i + 1:])
+            xx2 = np.minimum(x2[i], x2[i + 1:])
+            yy2 = np.minimum(y2[i], y2[i + 1:])
+            inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+            union = area[i] + area[i + 1:] - inter
+            iou = np.where(union > 0, inter / union, 0.0)
+            suppressed[i + 1:] |= iou > iou_threshold
+        return keep
+
+    if category_idxs is None:
+        keep = greedy(order)
+    else:
+        cats = _np(category_idxs)
+        keep = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            c_idxs = order[cats[order] == c]
+            keep.extend(greedy(c_idxs))
+        if scores is not None:
+            keep.sort(key=lambda i: -s[i])
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(np.asarray(keep, np.int64))
+
+
+# --------------------------------------------------------------- roi_align
+
+def _roi_align_fwd(x, boxes, boxes_num, output_size=(1, 1), spatial_scale=1.0,
+                   sampling_ratio=-1, aligned=True):
+    """x [N,C,H,W]; boxes [R,4] xyxy in input-image coords; boxes_num [N]
+    maps rois to batch images. Exact bilinear average like the reference
+    kernel (phi/kernels roi_align): each output bin averages sampling_ratio²
+    (or adaptive) bilinear samples."""
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    offset = 0.5 if aligned else 0.0
+    # roi -> image index from boxes_num prefix sums
+    img_of_roi = jnp.repeat(jnp.arange(n), boxes_num,
+                            total_repeat_length=r)
+
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    if sampling_ratio > 0:
+        sh = sw = sampling_ratio
+    else:
+        # adaptive: ceil(roi/bin) is data-dependent; reference uses per-roi
+        # adaptive counts — a static 2x2 grid is the jit-stable equivalent
+        # (matches the reference exactly when rois are smaller than 2 bins)
+        sh = sw = 2
+
+    iy = (jnp.arange(sh) + 0.5) / sh      # fractions within a bin
+    ix = (jnp.arange(sw) + 0.5) / sw
+    py = jnp.arange(ph)
+    px = jnp.arange(pw)
+    # sample y coords: [R, ph, sh]
+    ys = y1[:, None, None] + (py[None, :, None] + iy[None, None, :]) * \
+        bin_h[:, None, None]
+    xs = x1[:, None, None] + (px[None, :, None] + ix[None, None, :]) * \
+        bin_w[:, None, None]
+
+    def bilinear(img, yy, xx):
+        # img [C,H,W]; yy [ph,sh]; xx [pw,sw] -> [C, ph, pw, sh, sw]
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, h - 1)
+        x1_ = jnp.minimum(x0 + 1, w - 1)
+        wy1 = yy - y0
+        wx1 = xx - x0
+        wy0 = 1.0 - wy1
+        wx0 = 1.0 - wx1
+
+        def gat(yi, xi):
+            # [C, ph, sh, pw, sw]
+            return img[:, yi, :][:, :, :, xi]
+        v = (gat(y0, x0) * (wy0[None, :, :, None, None] *
+                            wx0[None, None, None, :, :])
+             + gat(y0, x1_) * (wy0[None, :, :, None, None] *
+                               wx1[None, None, None, :, :])
+             + gat(y1_, x0) * (wy1[None, :, :, None, None] *
+                               wx0[None, None, None, :, :])
+             + gat(y1_, x1_) * (wy1[None, :, :, None, None] *
+                                wx1[None, None, None, :, :]))
+        return v.mean(axis=(2, 4))        # average samples -> [C, ph, pw]
+
+    def per_roi(ri):
+        img = x[img_of_roi[ri]]
+        return bilinear(img, ys[ri], xs[ri])
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+register_op("roi_align", _roi_align_fwd, nondiff_inputs=(1, 2))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _op("roi_align", x, boxes, boxes_num,
+               output_size=tuple(output_size),
+               spatial_scale=float(spatial_scale),
+               sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+# ---------------------------------------------------------------- roi_pool
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI bins (legacy Fast-RCNN pooling). Host-side: the integer
+    bin walk has data-dependent windows XLA can't tile."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xv = _np(x)
+    bx = _np(boxes)
+    bn = _np(boxes_num)
+    n, c, h, w = xv.shape
+    img_of_roi = np.repeat(np.arange(n), bn)
+    out = np.zeros((bx.shape[0], c, ph, pw), xv.dtype)
+    for ri in range(bx.shape[0]):
+        img = xv[img_of_roi[ri]]
+        x1, y1, x2, y2 = np.round(bx[ri] * spatial_scale).astype(int)
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            ys = y1 + int(np.floor(i * rh / ph))
+            ye = y1 + int(np.ceil((i + 1) * rh / ph))
+            ys, ye = np.clip([ys, ye], 0, h)
+            for j in range(pw):
+                xs = x1 + int(np.floor(j * rw / pw))
+                xe = x1 + int(np.ceil((j + 1) * rw / pw))
+                xs, xe = np.clip([xs, xe], 0, w)
+                if ye > ys and xe > xs:
+                    out[ri, :, i, j] = img[:, ys:ye, xs:xe].max(axis=(1, 2))
+    return Tensor(out)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+# --------------------------------------------------------------- box_coder
+
+def _box_coder_fwd(prior_box, target_box, *rest, code_type="encode_center_size",
+                   box_normalized=True, has_var=False, axis=0):
+    pv = rest[0] if has_var else None
+    if pv is not None and pv.ndim == 1:
+        # the common SSD form: one 4-float variance shared by every prior
+        pv = jnp.broadcast_to(pv[None, :], (prior_box.shape[0], 4))
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    phh = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + phh * 0.5
+    if code_type == "encode_center_size":
+        # target [M,4] vs priors [N,4] -> [M,N,4]
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / phh[None, :]
+        ow = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        oh = jnp.log(jnp.maximum(th[:, None] / phh[None, :], 1e-10))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pv is not None:
+            out = out / pv[None, :, :]
+        return out
+    # decode_center_size: target [N, M, 4] deltas against priors along `axis`
+    t = target_box
+    if pv is not None:
+        t = t * (pv[None, :, :] if axis == 0 else pv[:, None, :])
+    pw_ = pw[None, :, None] if axis == 0 else pw[:, None, None]
+    ph_ = phh[None, :, None] if axis == 0 else phh[:, None, None]
+    pcx_ = pcx[None, :] if axis == 0 else pcx[:, None]
+    pcy_ = pcy[None, :] if axis == 0 else pcy[:, None]
+    cx = t[..., 0] * pw_[..., 0] + pcx_
+    cy = t[..., 1] * ph_[..., 0] + pcy_
+    bw = jnp.exp(t[..., 2]) * pw_[..., 0]
+    bh = jnp.exp(t[..., 3]) * ph_[..., 0]
+    return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                      cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], axis=-1)
+
+
+register_op("box_coder", _box_coder_fwd)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    args = [prior_box, target_box]
+    has_var = prior_box_var is not None and not np.isscalar(prior_box_var)
+    if has_var:
+        if isinstance(prior_box_var, (list, tuple)):
+            prior_box_var = np.asarray(prior_box_var, np.float32)
+        args.append(prior_box_var)
+    return _op("box_coder", *args, code_type=code_type,
+               box_normalized=bool(box_normalized), has_var=has_var,
+               axis=int(axis))
+
+
+# ---------------------------------------------------------------- yolo_box
+
+def _yolo_box_fwd(x, img_size, *, anchors, class_num, conf_thresh,
+                  downsample_ratio, clip_bbox=True, scale_x_y=1.0):
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    b = scale_x_y * jax.nn.sigmoid(x[:, :, 0:2]) - 0.5 * (scale_x_y - 1.0)
+    cx = (b[:, :, 0] + gx[None, None, None, :]) / w
+    cy = (b[:, :, 1] + gy[None, None, :, None]) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    im_h = img_size[:, 0].astype(jnp.float32)
+    im_w = img_size[:, 1].astype(jnp.float32)
+    x1 = (cx - bw * 0.5) * im_w[:, None, None, None]
+    y1 = (cy - bh * 0.5) * im_h[:, None, None, None]
+    x2 = (cx + bw * 0.5) * im_w[:, None, None, None]
+    y2 = (cy + bh * 0.5) * im_h[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, im_w[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0.0, im_h[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0.0, im_w[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0.0, im_h[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, na * h * w, 4)
+    mask = (conf > conf_thresh).reshape(n, na * h * w, 1)
+    boxes = jnp.where(mask, boxes, 0.0)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(n, na * h * w, class_num)
+    scores = jnp.where(mask, scores, 0.0)
+    return boxes, scores
+
+
+register_op("yolo_box", _yolo_box_fwd, nondiff_inputs=(1,))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    return _op("yolo_box", x, img_size, anchors=tuple(anchors),
+               class_num=int(class_num), conf_thresh=float(conf_thresh),
+               downsample_ratio=int(downsample_ratio),
+               clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+# --------------------------------------------------------------- prior_box
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD anchor generator (pure math; eager numpy — anchors are built once)."""
+    fh, fw = _np(input).shape[2:]
+    ih, iw = _np(image).shape[2:]
+    ratios = [1.0]
+    for ar in aspect_ratios:
+        if abs(ar - 1.0) > 1e-6:
+            ratios.append(ar)
+            if flip:
+                ratios.append(1.0 / ar)
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        big = math.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, big, big))
+                    for ar in ratios:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * math.sqrt(ar),
+                                     ms / math.sqrt(ar)))
+                else:
+                    for ar in ratios:
+                        cell.append((cx, cy, ms * math.sqrt(ar),
+                                     ms / math.sqrt(ar)))
+                    if max_sizes:
+                        big = math.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, big, big))
+            boxes.extend(cell)
+    out = np.asarray(boxes, np.float32)
+    cx, cy, bw, bh = out[:, 0], out[:, 1], out[:, 2], out[:, 3]
+    out = np.stack([(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                    (cx + bw / 2) / iw, (cy + bh / 2) / ih], axis=1)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    out = out.reshape(fh, fw, -1, 4)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(out), Tensor(var)
+
+
+# ----------------------------------------------------------- deform_conv2d
+
+def _deform_conv2d_fwd(x, offset, weight, *rest, stride=(1, 1),
+                       padding=(0, 0), dilation=(1, 1), deformable_groups=1,
+                       groups=1, has_mask=False, has_bias=False):
+    """Deformable conv v1/v2: bilinear-sample the input at kernel positions
+    shifted by learned offsets, then contract with the weights — the gather
+    formulation maps the reference's CUDA im2col+offset kernel onto XLA."""
+    mask = rest[0] if has_mask else None
+    bias = rest[-1] if has_bias else None
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    hp, wp = h + 2 * ph, w + 2 * pw
+
+    # offset [N, dg*2*kh*kw, oh, ow]
+    off = offset.reshape(n, deformable_groups, 2, kh * kw, oh, ow)
+    oy = off[:, :, 0].reshape(n, deformable_groups, kh, kw, oh, ow)
+    ox = off[:, :, 1].reshape(n, deformable_groups, kh, kw, oh, ow)
+    # sample coords [N, dg, kh, kw, oh, ow]
+    y_grid = (jnp.arange(oh) * sh)[:, None] + (jnp.arange(kh) * dh)[None, :]
+    x_grid = (jnp.arange(ow) * sw)[:, None] + (jnp.arange(kw) * dw)[None, :]
+    yy = y_grid.T[None, None, :, None, :, None] + oy    # [n,dg,kh,kw,oh,ow]
+    xx = x_grid.T[None, None, None, :, None, :] + ox
+
+    yy = jnp.clip(yy, -1.0, hp * 1.0)
+    xx = jnp.clip(xx, -1.0, wp * 1.0)
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    wy1 = yy - y0
+    wx1 = xx - x0
+
+    def sample(yi, xi):
+        inside = (yi >= 0) & (yi < hp) & (xi >= 0) & (xi < wp)
+        yc = jnp.clip(yi, 0, hp - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, wp - 1).astype(jnp.int32)
+        # gather per batch & deformable group over channels of that group
+        cg = cin // deformable_groups
+
+        def per_n(xn, ycn, xcn, ins):
+            # xn [cin, hp, wp]; ycn [dg,kh,kw,oh,ow]
+            def per_g(g):
+                ch = jax.lax.dynamic_slice_in_dim(xn, g * cg, cg, axis=0)
+                flat = ch.reshape(cg, hp * wp)
+                idx = (ycn[g] * wp + xcn[g]).reshape(-1)
+                v = flat[:, idx].reshape((cg,) + ycn[g].shape)
+                return v * ins[g][None]
+            return jnp.concatenate([per_g(g)
+                                    for g in range(deformable_groups)], 0)
+        return jax.vmap(per_n)(xp, yc, xc,
+                               inside.astype(x.dtype))
+
+    v00 = sample(y0, x0)
+    v01 = sample(y0, x0 + 1)
+    v10 = sample(y0 + 1, x0)
+    v11 = sample(y0 + 1, x0 + 1)
+    wy1 = wy1.repeat(cin // deformable_groups, axis=1)
+    wx1 = wx1.repeat(cin // deformable_groups, axis=1)
+    val = (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+           + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+    if mask is not None:  # v2 modulation [N, dg*kh*kw, oh, ow]
+        m = mask.reshape(n, deformable_groups, kh, kw, oh, ow)
+        m = m.repeat(cin // deformable_groups, axis=1)
+        val = val * m
+    # val [n, cin, kh, kw, oh, ow] -> conv contraction, per weight group
+    v6 = val.reshape(n, cin, kh, kw, oh, ow)
+    cg_in = cin // groups
+    cg_out = cout // groups
+    outs = [jnp.einsum("nckhij,ockh->noij",
+                       v6[:, g * cg_in:(g + 1) * cg_in],
+                       weight[g * cg_out:(g + 1) * cg_out])
+            for g in range(groups)]
+    out = outs[0] if groups == 1 else jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+register_op("deform_conv2d", _deform_conv2d_fwd)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    pair = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return _op("deform_conv2d", *args, stride=pair(stride),
+               padding=pair(padding), dilation=pair(dilation),
+               deformable_groups=int(deformable_groups), groups=int(groups),
+               has_mask=mask is not None, has_bias=bias is not None)
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        bound = 1.0 / math.sqrt(in_channels * k[0] * k[1])
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            default_initializer=nn.initializer.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+# ------------------------------------------------- distribute_fpn_proposals
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign each RoI to an FPN level by scale (host-side grouping;
+    reference distribute_fpn_proposals_op). Returns (multi_rois list,
+    restore_ind, rois_num_per_level list)."""
+    rois = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    if rois_num is not None:
+        rn = _np(rois_num).ravel().astype(int)
+        img_of_roi = np.repeat(np.arange(len(rn)), rn)
+        n_img = len(rn)
+    else:
+        img_of_roi = np.zeros(len(rois), int)
+        n_img = 1
+    multi, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        # image-major within the level so per-image counts stay contiguous
+        idx = np.nonzero(lvl == L)[0]
+        idx = idx[np.argsort(img_of_roi[idx], kind="stable")]
+        multi.append(Tensor(rois[idx]))
+        per_img = np.bincount(img_of_roi[idx], minlength=n_img)
+        nums.append(Tensor(per_img.astype(np.int32)))
+        order.extend(idx.tolist())
+    restore = np.empty(len(rois), np.int64)
+    restore[np.asarray(order, int)] = np.arange(len(rois))
+    return multi, Tensor(restore.reshape(-1, 1)), nums

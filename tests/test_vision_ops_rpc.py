@@ -1,0 +1,319 @@
+"""Detection ops (numpy oracles), distributed.rpc (2 processes), ERNIE,
+memory_efficient_attention, batch_isend_irecv.
+
+Reference test pattern (SURVEY.md §4): OpTest-style numpy references per op;
+rpc tested across real processes like test_dist_base.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+# ------------------------------------------------------------ detection ops
+
+def test_nms_matches_greedy_oracle():
+    rs = np.random.RandomState(0)
+    n = 40
+    xy = rs.rand(n, 2) * 60
+    wh = rs.rand(n, 2) * 30 + 1
+    boxes = np.concatenate([xy, xy + wh], 1).astype("float32")
+    scores = rs.rand(n).astype("float32")
+
+    def oracle(thr):
+        order = np.argsort(-scores, kind="stable")
+        keep, supp = [], set()
+        for ii, i in enumerate(order):
+            if i in supp:
+                continue
+            keep.append(i)
+            for j in order[ii + 1:]:
+                xx1 = max(boxes[i, 0], boxes[j, 0])
+                yy1 = max(boxes[i, 1], boxes[j, 1])
+                xx2 = min(boxes[i, 2], boxes[j, 2])
+                yy2 = min(boxes[i, 3], boxes[j, 3])
+                inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+                a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+                a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+                if inter / (a1 + a2 - inter) > thr:
+                    supp.add(j)
+        return keep
+
+    for thr in (0.3, 0.5):
+        got = vops.nms(paddle.to_tensor(boxes), thr,
+                       scores=paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(got, oracle(thr))
+
+
+def test_nms_categories_and_topk():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                        [21, 21, 31, 31]], "float32")
+    scores = np.asarray([0.9, 0.8, 0.95, 0.7], "float32")
+    cats = np.asarray([0, 0, 1, 1])
+    got = vops.nms(paddle.to_tensor(boxes), 0.5,
+                   scores=paddle.to_tensor(scores),
+                   category_idxs=paddle.to_tensor(cats), categories=[0, 1],
+                   top_k=2).numpy()
+    np.testing.assert_array_equal(got, [2, 0])  # best per class, score order
+
+
+def test_roi_align_uniform_image():
+    """On a constant image every bin must average to the constant — exact."""
+    x = np.full((1, 3, 16, 16), 7.0, "float32")
+    boxes = np.asarray([[2.0, 2.0, 10.0, 10.0]], "float32")
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.asarray([1], "int32")),
+                         output_size=4, spatial_scale=1.0, sampling_ratio=2)
+    assert tuple(out.shape) == (1, 3, 4, 4)
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-6)
+
+
+def test_roi_align_linear_ramp_bilinear_exact():
+    """Bilinear sampling of a linear ramp reproduces the ramp exactly at the
+    sample centers — analytic oracle."""
+    h = w = 16
+    ramp = np.arange(w, dtype="float32")[None, None, None, :].repeat(h, 2)
+    boxes = np.asarray([[1.0, 1.0, 9.0, 9.0]], "float32")
+    ph = pw = 2
+    out = vops.roi_align(paddle.to_tensor(ramp), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.asarray([1], "int32")),
+                         output_size=(ph, pw), spatial_scale=1.0,
+                         sampling_ratio=2, aligned=True).numpy()
+    # expected: mean of sample x-coords per bin (value == x coordinate)
+    x1, x2 = 0.5, 8.5            # aligned: -0.5 offset
+    bin_w = (x2 - x1) / pw
+    for j in range(pw):
+        xs = [x1 + (j + (i + 0.5) / 2) * bin_w for i in range(2)]
+        np.testing.assert_allclose(out[0, 0, :, j], np.mean(xs), rtol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 2, 3] = 5.0
+    x[0, 0, 6, 6] = 9.0
+    out = vops.roi_pool(paddle.to_tensor(x),
+                        paddle.to_tensor(np.asarray([[0, 0, 7, 7]], "float32")),
+                        paddle.to_tensor(np.asarray([1], "int32")),
+                        output_size=2).numpy()
+    assert out[0, 0, 0, 0] == 5.0 and out[0, 0, 1, 1] == 9.0
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rs = np.random.RandomState(1)
+    priors = np.sort(rs.rand(5, 4) * 50, axis=-1).astype("float32")
+    targets = np.sort(rs.rand(3, 4) * 50, axis=-1).astype("float32")
+    enc = vops.box_coder(paddle.to_tensor(priors), None,
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size").numpy()
+    assert enc.shape == (3, 5, 4)
+    dec = vops.box_coder(paddle.to_tensor(priors), None,
+                         paddle.to_tensor(enc),
+                         code_type="decode_center_size", axis=0).numpy()
+    for m in range(3):
+        for n in range(5):
+            np.testing.assert_allclose(dec[m, n], targets[m], rtol=1e-4,
+                                       atol=1e-3)
+
+
+def test_yolo_box_decodes_center_cell():
+    n, na, cls, h, w = 1, 2, 3, 4, 4
+    x = np.zeros((n, na * (5 + cls), h, w), "float32")
+    img = np.asarray([[128, 128]], "int32")
+    boxes, scores = vops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                  anchors=[10, 13, 16, 30], class_num=cls,
+                                  conf_thresh=0.0, downsample_ratio=32)
+    assert tuple(boxes.shape) == (1, na * h * w, 4)
+    assert tuple(scores.shape) == (1, na * h * w, cls)
+    b = boxes.numpy()[0, 0]        # anchor 0, cell (0,0): center (.5/4, .5/4)
+    cx, cy = 0.5 / 4 * 128, 0.5 / 4 * 128
+    bw, bh = 10 / (32 * 4) * 128, 13 / (32 * 4) * 128
+    np.testing.assert_allclose(b, [cx - bw / 2, cy - bh / 2,
+                                   cx + bw / 2, cy + bh / 2], rtol=1e-5)
+
+
+def test_prior_box_counts_and_range():
+    feat = np.zeros((1, 8, 4, 4), "float32")
+    image = np.zeros((1, 3, 64, 64), "float32")
+    boxes, var = vops.prior_box(paddle.to_tensor(feat),
+                                paddle.to_tensor(image),
+                                min_sizes=[16.0], max_sizes=[32.0],
+                                aspect_ratios=[2.0], flip=True, clip=True)
+    # per cell: 1 (min) + ar 2.0 + ar 0.5 + 1 (max) = 4
+    assert tuple(boxes.shape) == (4, 4, 4, 4)
+    assert tuple(var.shape) == (4, 4, 4, 4)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    """With zero offsets (and no mask) deformable conv IS a plain conv."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 8, 8).astype("float32")
+    wgt = rs.randn(6, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 9, 6, 6), "float32")
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(wgt)).numpy()
+    import paddle_tpu.nn.functional as F
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wgt)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_distribute_fpn_proposals_partitions():
+    rois = np.asarray([[0, 0, 10, 10],      # small -> low level
+                       [0, 0, 300, 300],    # big -> high level
+                       [0, 0, 60, 60]], "float32")
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    total = sum(int(n.numpy().sum()) for n in nums)
+    assert total == 3 and len(multi) == 4
+    # restore maps concatenated-by-level order back to the original
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]], 0)
+    np.testing.assert_allclose(cat[restore.numpy().ravel()], rois)
+
+
+def test_distribute_fpn_proposals_batched_rois_num():
+    """rois_num keeps per-image grouping per level (the nums feed roi_align's
+    boxes_num downstream)."""
+    rois = np.asarray([[0, 0, 10, 10],       # img0 small
+                       [0, 0, 300, 300],     # img0 big
+                       [0, 0, 12, 12],       # img1 small
+                       [0, 0, 11, 11]], "float32")
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.asarray([2, 2], "int32")))
+    lvl2 = nums[0].numpy()                   # small boxes level
+    np.testing.assert_array_equal(lvl2, [1, 2])   # img0: 1, img1: 2
+    # 300x300: floor(log2(300/224)) = 0 -> stays at refer_level 4 (img0)
+    np.testing.assert_array_equal(nums[2].numpy(), [1, 0])
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]], 0)
+    np.testing.assert_allclose(cat[restore.numpy().ravel()], rois)
+
+
+def test_box_coder_list_variance_and_mea_bias_tensor():
+    rs = np.random.RandomState(0)
+    priors = np.sort(rs.rand(4, 4) * 40, -1).astype("float32")
+    targets = np.sort(rs.rand(2, 4) * 40, -1).astype("float32")
+    enc = vops.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                         paddle.to_tensor(targets)).numpy()
+    enc_novar = vops.box_coder(paddle.to_tensor(priors), None,
+                               paddle.to_tensor(targets)).numpy()
+    np.testing.assert_allclose(enc[..., :2], enc_novar[..., :2] / 0.1,
+                               rtol=1e-5)
+    # memory_efficient_attention with a real bias tensor must not crash
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+    q = paddle.to_tensor(rs.randn(1, 8, 2, 16).astype("float32"))
+    bias = paddle.to_tensor(np.zeros((1, 2, 8, 8), "float32"))
+    out = memory_efficient_attention(q, q, q, attn_bias=bias, training=False)
+    assert tuple(out.shape) == (1, 8, 2, 16)
+
+
+# ----------------------------------------------------------------- p2p API
+
+def test_batch_isend_irecv_pairs():
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()
+    g = dist.new_group(list(range(2)))
+    world = np.stack([np.full(3, 1.0), np.full(3, 2.0)]).astype("float32")
+    t = paddle.to_tensor(world)
+    out = paddle.to_tensor(np.zeros_like(world))
+    ops_ = [dist.P2POp(dist.isend, t, 1, group=g),
+            dist.P2POp(dist.irecv, out, 0, group=g)]
+    tasks = dist.batch_isend_irecv(ops_)
+    for task in tasks:
+        task.wait()
+    np.testing.assert_allclose(out.numpy(), world)
+
+
+# -------------------------------------------------------------------- ERNIE
+
+def test_ernie_forward_and_mlm_loss():
+    from paddle_tpu.models import ErnieForMaskedLM, ernie_tiny
+    paddle.seed(0)
+    cfg = ernie_tiny()
+    model = ErnieForMaskedLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)).astype("int64"))
+    task = paddle.to_tensor(np.zeros((2, 16), "int64"))
+    labels_np = np.full((2, 16), -100, "int64")
+    labels_np[:, 3:6] = 7
+    logits, loss = model(ids, task_type_ids=task,
+                         labels=paddle.to_tensor(labels_np))
+    assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+    assert np.isfinite(float(loss))
+    loss.backward()
+    task_emb = model.ernie.embeddings.task_type_embeddings.weight
+    assert task_emb.grad is not None  # the ERNIE delta actually trains
+
+
+# --------------------------------------------- memory_efficient_attention
+
+def test_memory_efficient_attention_matches_sdpa():
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    q, k, v = (paddle.to_tensor(rs.randn(2, 32, 2, 16).astype("float32"))
+               for _ in range(3))
+    out = memory_efficient_attention(q, k, v, p=0.0, training=False)
+    ref = F.scaled_dot_product_attention(q, k, v, dropout_p=0.0,
+                                         training=False)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------- rpc
+
+_RPC_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu.distributed.rpc as rpc
+
+    def mul(a, b):
+        return a * b
+
+    def whoami():
+        return rpc.get_worker_info().name
+
+    rank = int(sys.argv[1])
+    rpc.init_rpc(name=f"worker{{rank}}", rank=rank, world_size=2,
+                 master_endpoint="127.0.0.1:{port}")
+    if rank == 0:
+        assert rpc.rpc_sync("worker1", mul, args=(6, 7)) == 42
+        fut = rpc.rpc_async("worker1", whoami)
+        assert fut.result(60) == "worker1"
+        infos = rpc.get_all_worker_infos()
+        assert [w.name for w in infos] == ["worker0", "worker1"]
+        try:
+            rpc.rpc_sync("worker1", mul, args=("x", None))
+        except TypeError:
+            print("REMOTE_EXC_OK")
+        print("RPC_OK")
+    else:
+        # worker1 also calls back into worker0 (full duplex)
+        assert rpc.rpc_sync("worker0", mul, args=(3, 5)) == 15
+    rpc.shutdown()
+""")
+
+
+def test_rpc_two_processes(tmp_path):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = _RPC_WORKER.format(repo=repo, port=port)
+    procs = [subprocess.Popen([sys.executable, "-c", prog, str(r)],
+                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                              text=True) for r in (0, 1)]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    assert procs[0].returncode == 0, outs[0][-2000:]
+    assert procs[1].returncode == 0, outs[1][-2000:]
+    assert "RPC_OK" in outs[0] and "REMOTE_EXC_OK" in outs[0]
