@@ -103,6 +103,11 @@ class _DatasetBase:
             chunk = records[i:i + bs]
             if len(chunk) < bs and self._drop_last:
                 break
+            if not self._slots:
+                # schemaless (custom parse_fn without use_var): hand the raw
+                # parsed records through as a list batch
+                yield list(chunk)
+                continue
             out: Dict[str, Any] = {}
             for j, s in enumerate(self._slots):
                 cols = [r[j] for r in chunk]

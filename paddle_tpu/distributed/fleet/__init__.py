@@ -6,6 +6,7 @@ distributed_model / distributed_optimizer) dispatching wrappers by parallel mode
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..env import _maybe_init_multihost, get_hcg
@@ -131,6 +132,68 @@ def barrier_worker():
     barrier()
 
 
+# ------------------------------------------------------------- PS-mode roles
+# Env contract set by `launch --run_mode ps` (reference fleet PS mode:
+# fleet.init(role) -> is_server()/init_server()/run_server() on pservers,
+# trainer path otherwise).
+
+_PS_SERVER = {"instance": None}
+
+
+def is_server() -> bool:
+    return os.environ.get("PADDLE_ROLE") == "PSERVER"
+
+
+def is_worker() -> bool:
+    """reference fleet.is_worker() — trainer role in a PS job (and the only
+    role in collective jobs)."""
+    return os.environ.get("PADDLE_ROLE", "TRAINER") == "TRAINER"
+
+
+def server_endpoints() -> list:
+    eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return [e for e in eps.split(",") if e]
+
+
+def init_server(model=None, tables=None, lr: float = 1.0, seed: int = 0):
+    """Build this pserver's tables and bind its PADDLE_PORT.
+
+    Tables come either from `tables` ({name: SparseTable/DenseTable/shape})
+    or from a model's parameters (DenseTable per param, seeded from the
+    model's init so every role starts from identical weights)."""
+    from ..ps import DenseTable, PSServer
+    built = {}
+    if tables:
+        for name, t in tables.items():
+            built[name] = t if not isinstance(t, (tuple, list)) \
+                else DenseTable(t, lr=lr, seed=seed)
+    if model is not None:
+        for name, p in model.named_parameters():
+            built[name] = DenseTable(tuple(p.shape), lr=lr,
+                                     init=p.numpy().ravel())
+    port = int(os.environ.get("PADDLE_PORT", "0"))
+    _PS_SERVER["instance"] = PSServer(built, port=port)
+    return _PS_SERVER["instance"]
+
+
+def run_server():
+    """Serve until terminated (reference fleet.run_server blocks)."""
+    import signal
+    import threading
+    srv = _PS_SERVER["instance"]
+    if srv is None:
+        raise RuntimeError("call fleet.init_server() first")
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    srv.stop()
+
+
+def stop_worker():
+    pass  # trainer-side PS teardown: clients hold no server-side state
+
+
 class Fleet:
     """Object form of the fleet facade (reference fleet.Fleet — the module
     functions above are the default instance's methods)."""
@@ -152,6 +215,21 @@ class Fleet:
 
     def is_first_worker(self):
         return is_first_worker()
+
+    def is_server(self):
+        return is_server()
+
+    def is_worker(self):
+        return is_worker()
+
+    def init_server(self, *args, **kwargs):
+        return init_server(*args, **kwargs)
+
+    def run_server(self):
+        return run_server()
+
+    def stop_worker(self):
+        return stop_worker()
 
     @property
     def util(self):

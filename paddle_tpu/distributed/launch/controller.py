@@ -42,6 +42,9 @@ class LaunchContext:
     log_dir: Optional[str] = None
     devices: Optional[str] = None
     max_restart: int = 0
+    run_mode: str = "collective"           # "collective" | "ps"
+    server_num: int = 0
+    trainer_num: int = 0
     envs: Dict[str, str] = field(default_factory=dict)
 
 
@@ -164,7 +167,67 @@ class PodController:
             if f:
                 f.close()
 
+    # --------------------------------------------------------------- ps mode
+
+    def _run_ps(self) -> int:
+        """PS job: server processes (PADDLE_ROLE=PSERVER at a known port each)
+        + trainer processes that see PADDLE_PSERVERS_IP_PORT_LIST. The job
+        finishes when every trainer exits; servers are then torn down
+        (reference launch/controllers/ps.py semantics)."""
+        ctx = self.ctx
+        n_srv = ctx.server_num or 1
+        n_trn = ctx.trainer_num or 1
+        if ctx.nnodes > 1:
+            raise ValueError("--run_mode ps currently launches single-node "
+                             "jobs (multi-node PS rides --servers lists)")
+        ports = [free_port() for _ in range(n_srv)]
+        ep_list = ",".join(f"127.0.0.1:{p}" for p in ports)
+        servers: List[subprocess.Popen] = []
+        trainers: List[subprocess.Popen] = []
+        self.logs = []
+
+        def spawn(role, idx, extra):
+            env = dict(os.environ)
+            env.update(ctx.envs)
+            env.update({"PADDLE_ROLE": role, "PADDLE_JOB_ID": ctx.job_id,
+                        "PADDLE_PSERVERS_IP_PORT_LIST": ep_list,
+                        "PADDLE_TRAINERS_NUM": str(n_trn)})
+            env.update(extra)
+            log = None
+            if ctx.log_dir:
+                os.makedirs(ctx.log_dir, exist_ok=True)
+                log = open(os.path.join(ctx.log_dir,
+                                        f"{role.lower()}log.{idx}"), "ab")
+            self.logs.append(log)
+            return subprocess.Popen([sys.executable] + ctx.script, env=env,
+                                    stdout=log or None, stderr=log or None)
+
+        for i, port in enumerate(ports):
+            servers.append(spawn("PSERVER", i, {"PADDLE_PSERVER_ID": str(i),
+                                                "PADDLE_PORT": str(port)}))
+        for i in range(n_trn):
+            trainers.append(spawn("TRAINER", i, {"PADDLE_TRAINER_ID": str(i)}))
+        self.procs = servers + trainers
+        try:
+            # poll both roles: a dead pserver fails the job immediately
+            # instead of letting trainers hang against a vanished endpoint
+            while True:
+                for s in servers:
+                    if s.poll() not in (None, 0):
+                        return s.poll()
+                codes = [t.poll() for t in trainers]
+                bad = [c for c in codes if c not in (None, 0)]
+                if bad:
+                    return bad[0]
+                if all(c == 0 for c in codes):
+                    return 0
+                time.sleep(0.3)
+        finally:
+            self._terminate()  # also closes self.logs
+
     def run(self) -> int:
+        if self.ctx.run_mode == "ps":
+            return self._run_ps()
         if self.ctx.max_restart > 0 and self.ctx.nnodes > 1:
             # a local-pod restart would re-register a dead incarnation with the
             # still-live jax coordinator and hang the fleet; whole-job restart

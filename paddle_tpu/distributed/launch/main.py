@@ -32,6 +32,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="visible device selector, exported as PADDLE_DEVICES")
     p.add_argument("--max_restart", type=int, default=0,
                    help="restart the pod up to N times on failure (elastic L1)")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"],
+                   help="job kind (reference launch run_mode): ps spawns "
+                        "parameter servers + trainers")
+    p.add_argument("--server_num", type=int, default=0,
+                   help="ps mode: parameter-server process count")
+    p.add_argument("--trainer_num", type=int, default=0,
+                   help="ps mode: trainer process count")
     p.add_argument("script", nargs=argparse.REMAINDER,
                    help="training script (or -m module) and its args")
     return p
@@ -49,7 +57,9 @@ def launch(argv: Optional[List[str]] = None) -> int:
                         nproc_per_node=args.nproc_per_node, master=args.master,
                         node_rank=args.node_rank, job_id=args.job_id,
                         log_dir=args.log_dir, devices=args.devices,
-                        max_restart=args.max_restart)
+                        max_restart=args.max_restart, run_mode=args.run_mode,
+                        server_num=args.server_num,
+                        trainer_num=args.trainer_num)
     return PodController(ctx).run()
 
 

@@ -172,14 +172,18 @@ def init_rpc(name: str, rank: Optional[int] = None,
             bus.open_mailbox(_ACTOR(w.rank))
         else:
             bus.connect(w.rank, w.ip, w.port)
-    _AGENT = _Agent(name, rank, world_size, store, bus, workers)
-    # barrier: everyone connected before anyone issues calls
+    agent = _Agent(name, rank, world_size, store, bus, workers)
+    # barrier: everyone connected before anyone issues calls. The global is
+    # only published on success — a timed-out init tears the agent down so a
+    # retry isn't blocked by a half-initialized world.
     store.add("rpc/ready", 1)
     deadline = time.time() + 300
     while int(store.add("rpc/ready", 0)) < world_size:
         if time.time() > deadline:
+            agent.shutdown()
             raise TimeoutError("rpc init barrier timed out")
         time.sleep(0.02)
+    _AGENT = agent
 
 
 def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = -1):

@@ -191,7 +191,11 @@ class TrainStep:
             return (loss, tuple(new_params), tuple(new_masters),
                     tuple(new_states), new_buffers)
 
-        donate = (1, 2, 3) if self._donate else ()
+        # donate params too: __call__ re-reads p.value() fresh each step and
+        # immediately replaces p._data with the step's output, so the input
+        # buffers are dead after dispatch — donating them lets XLA alias
+        # new_params onto them (saves a params-sized allocation + copy)
+        donate = (0, 1, 2, 3) if self._donate else ()
         self._compiled = jax.jit(step_fn, donate_argnums=donate)
 
     # ------------------------------------------------------------------ call

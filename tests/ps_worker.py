@@ -1,0 +1,58 @@
+"""PS-mode worker used by test_launch_ps.py: one script, role-branched
+(reference fleet PS pattern: is_server -> init_server/run_server; trainer ->
+transpiled pull/push loop)."""
+import json
+import os
+import sys
+
+
+def build_model(paddle):
+    paddle.seed(0)
+    return paddle.nn.Sequential(paddle.nn.Linear(6, 12), paddle.nn.ReLU(),
+                                paddle.nn.Linear(12, 1))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import DistributeTranspiler
+
+    out_dir = sys.argv[1]
+    model = build_model(paddle)
+
+    if fleet.is_server():
+        fleet.init_server(model=model, lr=0.2)
+        fleet.run_server()
+        return
+
+    eps = ",".join(fleet.server_endpoints())
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=model, pservers=eps,
+                trainers=int(os.environ["PADDLE_TRAINERS_NUM"]))
+    prog = t.get_trainer_program()
+
+    rs = np.random.RandomState(100 + tid)
+    xs = rs.randn(32, 6).astype("float32")
+    ys = (xs.sum(1, keepdims=True) > 0).astype("float32")
+    losses = []
+    for _ in range(6):
+        prog.pull_params()
+        loss = paddle.nn.functional.mse_loss(
+            model(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+        loss.backward()
+        prog.push_grads()
+        for _, p in model.named_parameters():
+            p.clear_grad()
+        losses.append(float(loss))
+    with open(os.path.join(out_dir, f"ps_loss_{tid}.json"), "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

@@ -1,0 +1,36 @@
+"""PS-mode launcher test (reference test_fleet_launch_ps.sh pattern): one
+launcher invocation spawns pservers + trainers; trainers pull/push against
+the shared dense tables and their losses decrease."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "ps_worker.py")
+
+
+def test_launch_ps_mode(tmp_path):
+    out = str(tmp_path)
+    rc = subprocess.call(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "--server_num", "1", "--trainer_num", "2",
+         "--log_dir", os.path.join(out, "logs"), WORKER, out],
+        cwd=REPO, timeout=300)
+    assert rc == 0, _logs(os.path.join(out, "logs"))
+    for tid in range(2):
+        path = os.path.join(out, f"ps_loss_{tid}.json")
+        assert os.path.exists(path), _logs(os.path.join(out, "logs"))
+        losses = json.load(open(path))
+        assert losses[-1] < losses[0], (tid, losses)
+
+
+def _logs(d):
+    chunks = []
+    if os.path.isdir(d):
+        for name in sorted(os.listdir(d)):
+            with open(os.path.join(d, name), errors="replace") as f:
+                chunks.append(f"--- {name} ---\n{f.read()[-1500:]}")
+    return "\n".join(chunks) or "no logs"
