@@ -300,14 +300,27 @@ def _pad_len(x, L, axis=1):
     return jnp.pad(x, widths)
 
 
+def _kv_map(n_heads: int, kv_heads: int):
+    """Flat (b*h) q index -> flat (b*h_kv) K/V index for grouped-query
+    attention: `group` consecutive q heads read the same KV head. Identity
+    when MHA (kv_heads == n_heads)."""
+    if kv_heads == n_heads:
+        return lambda b: b
+    group = n_heads // kv_heads
+    return lambda b: (b // n_heads) * kv_heads + (b % n_heads) // group
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
                                              "block_k", "dropout_rate",
-                                             "interpret"))
+                                             "interpret", "n_heads",
+                                             "kv_heads"))
 def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-               dropout_rate=0.0, interpret=False):
-    # q,k,v: [BH, Lq, D] / [BH, Lk, D]; any lengths — padded to block multiples
+               dropout_rate=0.0, interpret=False, n_heads=1, kv_heads=1):
+    # q: [B*H, Lq, D]; k,v: [B*Hkv, Lk, D] (GQA when Hkv < H; the index map
+    # folds q heads onto their KV head — repeated KV never materializes)
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
+    kvm = _kv_map(n_heads, kv_heads)
     block_q, block_k = _norm_blocks(block_q, block_k, q_len, kv_len)
     q_pad = _round_up(q_len, block_q)
     kv_pad = _round_up(kv_len, block_k)
@@ -326,8 +339,10 @@ def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
-                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d),
+                             lambda b, i, j, *_: (kvm(b), j, 0)),
+                pl.BlockSpec((None, block_k, d),
+                             lambda b, i, j, *_: (kvm(b), j, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
@@ -352,11 +367,13 @@ def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
                                              "block_k", "dropout_rate",
-                                             "interpret"))
+                                             "interpret", "n_heads",
+                                             "kv_heads"))
 def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
-               dropout_rate=0.0, interpret=False):
+               dropout_rate=0.0, interpret=False, n_heads=1, kv_heads=1):
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
+    kvm = _kv_map(n_heads, kv_heads)
     block_q, block_k = _norm_blocks(block_q, block_k, q_len, kv_len)
     q_pad = _round_up(q_len, block_q)
     kv_pad = _round_up(kv_len, block_k)
@@ -385,8 +402,10 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
             grid=(bh, q_pad // block_q, kv_pad // block_k),
             in_specs=[
                 pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
-                pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d),
+                             lambda b, i, j, *_: (kvm(b), j, 0)),
+                pl.BlockSpec((None, block_k, d),
+                             lambda b, i, j, *_: (kvm(b), j, 0)),
                 pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
                 pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
                 pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
@@ -400,6 +419,9 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
         interpret=interpret,
     )(seed, qp, kp, vp, gp, lsep, delta)
 
+    # dk/dv are computed PER Q-HEAD (distinct grid rows may share a KV head
+    # under GQA; parallel grid dims cannot accumulate into a shared output
+    # block) and group-summed below in XLA.
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -407,8 +429,10 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
             grid=(bh, kv_pad // block_k, q_pad // block_q),
             in_specs=[
                 pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
-                pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
-                pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d),
+                             lambda b, j, i, *_: (kvm(b), j, 0)),
+                pl.BlockSpec((None, block_k, d),
+                             lambda b, j, i, *_: (kvm(b), j, 0)),
                 pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
                 pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
                 pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
@@ -420,12 +444,24 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
             scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                             pltpu.VMEM((block_k, d), jnp.float32)],
         ),
-        out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
-                   jax.ShapeDtypeStruct(vp.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh,) + kp.shape[1:], k.dtype),
+                   jax.ShapeDtypeStruct((bh,) + vp.shape[1:], v.dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, qp, kp, vp, gp, lsep, delta)
+
+    if kv_heads != n_heads:
+        group = n_heads // kv_heads
+        b_sz = bh // n_heads
+        # fp32 group reduction: bf16 accumulation over `group` per-head grads
+        # would compound rounding the kernels avoid everywhere else
+        dk = dk.reshape(b_sz, kv_heads, group, kv_pad, d) \
+            .astype(jnp.float32).sum(2) \
+            .reshape(b_sz * kv_heads, kv_pad, d).astype(k.dtype)
+        dv = dv.reshape(b_sz, kv_heads, group, kv_pad, d) \
+            .astype(jnp.float32).sum(2) \
+            .reshape(b_sz * kv_heads, kv_pad, d).astype(v.dtype)
 
     return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
 
@@ -445,26 +481,27 @@ def _reference_attention(q, k, v, causal, sm_scale):
     return jnp.einsum("bqk,bkd->bqd", p, vf).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k, dropout_rate,
-           interpret):
+           interpret, n_heads=1, kv_heads=1):
     out, _ = _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-                        dropout_rate, interpret)
+                        dropout_rate, interpret, n_heads, kv_heads)
     return out
 
 
 def _flash_vjp_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-                   dropout_rate, interpret):
+                   dropout_rate, interpret, n_heads, kv_heads):
     out, lse = _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-                          dropout_rate, interpret)
+                          dropout_rate, interpret, n_heads, kv_heads)
     return out, (q, k, v, out, lse, seed)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, dropout_rate, interpret,
-                   res, g):
+                   n_heads, kv_heads, res, g):
     q, k, v, out, lse, seed = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, seed, causal, sm_scale,
-                            block_q, block_k, dropout_rate, interpret)
+                            block_q, block_k, dropout_rate, interpret,
+                            n_heads, kv_heads)
     return dq, dk, dv, None
 
 
@@ -524,6 +561,10 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
     q, k, v = unwrap(q), unwrap(k), unwrap(v)
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    hkv = k.shape[2]
+    if h % hkv != 0 or v.shape[2] != hkv:
+        raise ValueError(f"GQA needs kv heads dividing q heads and matching "
+                         f"k/v; got q:{h} k:{k.shape[2]} v:{v.shape[2]}")
     if interpret and dropout_rate > 0.0:
         raise NotImplementedError(
             "in-kernel dropout uses the TPU hardware PRNG (pltpu.prng_*), which "
@@ -531,10 +572,10 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
             "dropout_rate=0.0 / the XLA sdpa path for CPU testing")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    to_bhld = lambda t, L: jnp.swapaxes(t, 1, 2).reshape(b * h, L, d)
-    qr = to_bhld(q, lq)
-    kr = to_bhld(k, lk)
-    vr = to_bhld(v, lk)
+    to_flat = lambda t, L, hh: jnp.swapaxes(t, 1, 2).reshape(b * hh, L, d)
+    qr = to_flat(q, lq, h)
+    kr = to_flat(k, lk, hkv)
+    vr = to_flat(v, lk, hkv)
     seed_arr = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
     if block_q is None or block_k is None:
         from ...core.flags import flag
@@ -545,5 +586,6 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
         block_q = block_q or (tb[0] if tb else DEFAULT_BLOCK_Q)
         block_k = block_k or (tb[1] if tb else DEFAULT_BLOCK_K)
     out = _flash(qr, kr, vr, seed_arr, bool(causal), float(sm_scale),
-                 block_q, block_k, float(dropout_rate), bool(interpret))
+                 block_q, block_k, float(dropout_rate), bool(interpret),
+                 h, hkv)
     return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)

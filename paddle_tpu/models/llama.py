@@ -107,10 +107,9 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(x).reshape([b, s, self.num_kv, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv, self.head_dim])
         q, k = _op("rope", q, k, theta=self.theta)
-        if self.num_kv != self.num_heads:
-            rep = self.num_heads // self.num_kv
-            k = ops.repeat_interleave(k, rep, axis=2)
-            v = ops.repeat_interleave(v, rep, axis=2)
+        # GQA is handled below the functional API: the Pallas kernel folds q
+        # heads onto their KV head in its index map (repeated K/V never
+        # materializes in HBM); the XLA sdpa fallback expands heads itself
         from ..nn.functional.attention import flash_path_available
         if self.use_flash and flash_path_available(s, self.head_dim, x):
             out = F.flash_attention(q, k, v, causal=True,

@@ -23,6 +23,12 @@ __all__ = ["scaled_dot_product_attention", "flash_attention"]
 def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
               has_dropkey=False, dropout_p=0.0):
     # q,k,v: [B, L, H, D] (paddle flash_attn layout); rest = [attn_mask][prng_key]
+    if k.shape[2] != q.shape[2]:
+        # GQA: expand KV heads (the Pallas path folds them in its index map;
+        # the XLA fallback materializes — same public semantics either way)
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qt = jnp.swapaxes(q, 1, 2)  # [B,H,L,D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
