@@ -67,7 +67,18 @@ def get_hybrid_communicate_group() -> HybridCommunicateGroup:
 
 
 def distributed_model(model):
-    """Wrap by parallel mode (reference fleet/model.py:30)."""
+    """Wrap by parallel mode (reference fleet/model.py:30). Strategy toggles
+    that transform the MODEL apply first: sync_batch_norm converts BN layers
+    (reference distributed_strategy.proto sync_batch_norm -> convert pass);
+    amp with use_pure_fp16 decorates to the O2 master-weight scheme."""
+    strategy = _fleet_state.get("strategy")
+    if strategy is not None and getattr(strategy, "sync_batch_norm", False):
+        from ...nn import SyncBatchNorm
+        model = SyncBatchNorm.convert_sync_batchnorm(model)
+    if strategy is not None and getattr(strategy, "amp", False) and \
+            strategy.amp_configs.get("use_pure_fp16", False):
+        from ...amp import decorate
+        model = decorate(models=model, level="O2")
     hcg = get_hcg()
     if hcg is None:
         init()

@@ -177,9 +177,21 @@ class GPTAttention(nn.Layer):
 
     def forward(self, x, attn_mask=None):
         b, s, h = x.shape
+        drop = self.dropout_p if self.training else 0.0
+        from ..kernels.pallas.flash_attention import packed_layout_supported
+        from ..nn.functional.attention import flash_path_available
+        if (self.use_flash and attn_mask is None
+                and packed_layout_supported(self.head_dim)
+                and flash_path_available(s, self.head_dim, x)):
+            # packed path: the fused projection feeds the kernel directly and
+            # the context comes back [b, s, h] — no head split/merge relayout
+            qkv = self.qkv_proj(x)
+            out = F.flash_attention_qkv_packed(qkv, self.num_heads,
+                                               dropout=drop, causal=True,
+                                               training=self.training)
+            return self.out_proj(out)
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(2)          # each [b, s, heads, head_dim]
-        drop = self.dropout_p if self.training else 0.0
         if self.use_flash and attn_mask is None:
             # Pallas flash kernel on real TPUs (auto-detected, in-kernel
             # dropout); XLA sdpa otherwise

@@ -4,5 +4,6 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
-from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .attention import (scaled_dot_product_attention, flash_attention,  # noqa: F401
+                        flash_attention_qkv_packed)
 from .extended import *  # noqa: F401,F403

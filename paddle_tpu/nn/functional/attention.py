@@ -17,7 +17,8 @@ from ...core.dispatch import register_op
 from ...core.tensor import Tensor
 from ...ops._helpers import _op
 
-__all__ = ["scaled_dot_product_attention", "flash_attention"]
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attention_qkv_packed"]
 
 
 def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
@@ -68,6 +69,32 @@ def _flash_attn_pallas_fwd(q, k, v, *rest, causal=False, dropout_rate=0.0):
 # custom_vjp supplies the gradient under the generic jit(vjp) backward. The
 # dropout seed (input 3, when present) is a nondiff program-state input.
 register_op("flash_attn_pallas", _flash_attn_pallas_fwd, nondiff_inputs=(3,))
+
+
+def _flash_attn_packed_fwd(qkv, *rest, num_heads, causal=True,
+                           dropout_rate=0.0):
+    from ...kernels.pallas.flash_attention import flash_attention_qkv_packed
+    seed = rest[0] if rest else 0
+    return flash_attention_qkv_packed(qkv, num_heads, causal=causal,
+                                      dropout_rate=dropout_rate, seed=seed)
+
+
+register_op("flash_attn_qkv_packed", _flash_attn_packed_fwd,
+            nondiff_inputs=(1,))
+
+
+def flash_attention_qkv_packed(qkv, num_heads, dropout=0.0, causal=True,
+                               training=True):
+    """Flash attention on the fused projection output [B, L, 3*H*D] -> the
+    pre-packed [B, L, H*D] context (zero layout copies; head_dim % 128 == 0).
+    The hot path for MXU-aligned decoder blocks."""
+    drop = float(dropout) if training else 0.0
+    args = [qkv]
+    if drop > 0.0:
+        seed = jax.random.key_data(rng.split_key()).ravel()[0].astype(jnp.int32)
+        args.append(Tensor(seed))
+    return _op("flash_attn_qkv_packed", *args, num_heads=int(num_heads),
+               causal=bool(causal), dropout_rate=drop)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,

@@ -689,3 +689,28 @@ def test_recompute_traced_with_dropout_rng_threading():
     assert all(np.isfinite(losses)), losses
     assert len(set(round(l, 7) for l in losses)) > 1, \
         f"dropout mask frozen across steps (RNG not threaded): {losses}"
+
+
+def test_strategy_sync_bn_and_amp_toggles():
+    """DistributedStrategy.sync_batch_norm converts BN layers and strategy.amp
+    (use_pure_fp16) decorates params to bf16 inside fleet.distributed_model
+    (reference: sync_batch_norm pass + AMP meta-optimizer toggles)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    strategy = DistributedStrategy()
+    strategy.sync_batch_norm = True
+    strategy.amp = True
+    strategy.amp_configs["use_pure_fp16"] = True
+    fleet.init(is_collective=True, strategy=strategy)
+
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3),
+                               paddle.nn.BatchNorm2D(8), paddle.nn.ReLU())
+    wrapped = fleet.distributed_model(net)
+    inner = wrapped._layers if hasattr(wrapped, "_layers") else wrapped
+    kinds = [type(l).__name__ for l in inner]
+    assert "SyncBatchNorm" in kinds and "BatchNorm2D" not in kinds, kinds
+    conv = inner[0]
+    assert str(np.dtype(conv.weight.dtype)) == "bfloat16"
