@@ -71,6 +71,13 @@ from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 DataParallel = distributed.DataParallel
 
 

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from ..core.autograd import GradNode, run_backward
 from ..core.dispatch import is_grad_enabled, no_grad
 from ..core.tensor import Tensor
+from .functional import jvp, vjp, Jacobian, Hessian  # noqa: F401
 
 
 def backward(tensors: List[Tensor], grad_tensors=None, retain_graph=False):

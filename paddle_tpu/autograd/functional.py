@@ -1,0 +1,157 @@
+"""Functional autodiff: jvp / vjp / Jacobian / Hessian.
+
+Reference analog: python/paddle/incubate/autograd/functional.py — forward-
+and reverse-mode products plus lazily-indexed Jacobian/Hessian objects built
+on the prim/primrule transforms. Here the transforms ARE jax's (jvp/vjp/
+jacfwd/jacrev); the bridge re-plays the user's Tensor function inside a
+dispatch trace so the same model code works under functional AD.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian"]
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+def _pure(func: Callable, n_in: int):
+    """Wrap a Tensor->Tensor function as a pure array function (trace-context
+    replay, like TrainStep's run_model)."""
+
+    def fn(*arrays):
+        ctx = dispatch.TraceContext()
+        dispatch.push_trace(ctx)
+        try:
+            outs = func(*[Tensor(a) for a in arrays[:n_in]])
+            outs_t = _as_tuple(outs)
+            vals = tuple(o.value() if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs_t)
+            return vals if len(vals) > 1 else vals[0]
+        finally:
+            dispatch.pop_trace()
+            ctx.restore()
+    return fn
+
+
+def _values(xs):
+    return tuple(x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+                 for x in _as_tuple(xs))
+
+
+def _wrap(vals):
+    if isinstance(vals, tuple):
+        out = tuple(Tensor(v) for v in vals)
+        return out if len(out) > 1 else out[0]
+    return Tensor(vals)
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v). v defaults to ones like xs
+    (reference jvp)."""
+    xv = _values(xs)
+    vv = _values(v) if v is not None else tuple(jnp.ones_like(a) for a in xv)
+    out, tangent = jax.jvp(_pure(func, len(xv)), xv, vv)
+    return _wrap(out), _wrap(tangent)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), v^T @ J as grads w.r.t. xs). v
+    defaults to ones like the output (reference vjp)."""
+    xv = _values(xs)
+    out, pull = jax.vjp(_pure(func, len(xv)), *xv)
+    if v is None:
+        cot = (jax.tree_util.tree_map(jnp.ones_like, out)
+               if isinstance(out, tuple) else jnp.ones_like(out))
+    else:
+        cv = _values(v)
+        cot = cv if isinstance(out, tuple) else cv[0]
+    grads = pull(cot)
+    g = tuple(Tensor(x) for x in grads)
+    return _wrap(out), (g if len(g) > 1 else g[0])
+
+
+class Jacobian:
+    """Lazily evaluated full Jacobian with [:] / [i, j] indexing (reference
+    incubate.autograd.Jacobian). For output shape [M...] and input [N...] the
+    matrix view is [prod(M), prod(N)]."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        xv = _values(xs)
+        if len(xv) != 1:
+            raise ValueError("Jacobian takes a single input tensor "
+                             "(pack multiple inputs yourself)")
+        self._mat = None
+        self._func = _pure(func, 1)
+        self._x = xv[0]
+        self._batched = is_batched
+
+    def _compute(self):
+        if self._mat is None:
+            if self._batched:
+                # per-sample semantics (reference batched Jacobian): vmap a
+                # single-row jacobian instead of the B^2-sized cross product
+                jac = jax.vmap(lambda xi: jax.jacrev(self._func)(
+                    xi[None])[0])(self._x)
+                b = jac.shape[0]
+                self._mat = jac.reshape(b, -1, int(jnp.size(self._x) // b))
+            else:
+                jac = jax.jacrev(self._func)(self._x)
+                n = int(jnp.size(self._x))
+                self._mat = jnp.reshape(jac, (int(jnp.size(jac)) // n, n))
+        return self._mat
+
+    @property
+    def shape(self):
+        return tuple(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])
+
+    def __repr__(self):
+        return f"Jacobian(shape={self.shape})"
+
+
+class Hessian:
+    """Full Hessian of a scalar function (reference incubate.autograd.Hessian):
+    [prod(N), prod(N)] with [:] indexing."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        xv = _values(xs)
+        if len(xv) != 1:
+            raise ValueError("Hessian takes a single input tensor")
+        self._func = _pure(func, 1)
+        self._x = xv[0]
+        self._batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is None:
+            scalar = lambda a: jnp.reshape(self._func(a), ())
+            if self._batched:
+                # per-sample Hessians [B, N, N] (reference batched semantics)
+                h = jax.vmap(lambda xi: jax.hessian(
+                    lambda a: scalar(a[None]))(xi))(self._x)
+                b = h.shape[0]
+                n = int(jnp.size(self._x)) // b
+                self._mat = h.reshape(b, n, n)
+            else:
+                h = jax.hessian(scalar)(self._x)
+                n = int(jnp.size(self._x))
+                self._mat = jnp.reshape(h, (n, n))
+        return self._mat
+
+    @property
+    def shape(self):
+        return tuple(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])

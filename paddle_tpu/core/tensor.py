@@ -21,6 +21,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtypes
+
+def _complex_transfer_ok(arr) -> bool:
+    """TPU runtimes in this fleet cannot transfer complex buffers host-ward
+    (and a failed attempt wedges the device queue, so no try/except probe);
+    CPU always can."""
+    try:
+        return next(iter(arr.devices())).platform == "cpu"
+    except Exception:
+        return True
 from .device import Place, get_default_place
 
 
@@ -69,6 +78,13 @@ class Tensor:
         return self._data
 
     def numpy(self) -> np.ndarray:
+        if jnp.iscomplexobj(self._data) and \
+                not _complex_transfer_ok(self._data):
+            # this TPU runtime can't transfer complex buffers host-ward;
+            # split on device, recombine on host
+            re = np.asarray(jnp.real(self._data))
+            im = np.asarray(jnp.imag(self._data))
+            return re + 1j * im
         return np.asarray(self._data)
 
     def item(self, *args):

@@ -1,0 +1,71 @@
+"""paddle.hub — model loading through a repo's hubconf.py protocol.
+
+Reference analog: python/paddle/hub.py — list/help/load resolve a `hubconf.py`
+inside a local directory or a downloaded github/gitee archive; every public
+callable in hubconf is an entrypoint.
+
+TPU build: the local source is fully supported; remote sources raise a clear
+error (training fleets run with no egress — vendor the repo and point
+source='local' at it, which is also what the reference does in airgapped
+runs).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["list", "help", "load"]
+
+_builtins_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access; this fleet runs "
+            "with no egress — clone the repo and use source='local'")
+    return _load_hubconf(os.path.expanduser(repo_dir))
+
+
+def list(repo_dir: str, source: str = "local",
+         force_reload: bool = False) -> List[str]:
+    """Entrypoint names (public callables in hubconf.py)."""
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if not n.startswith("_") and callable(getattr(mod, n))]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> Optional[str]:
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r}; available: "
+                         f"{list(repo_dir, source)}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r}; available: "
+                         f"{list(repo_dir, source)}")
+    return fn(**kwargs)

@@ -1,0 +1,3 @@
+"""incubate.autograd surface (reference python/paddle/incubate/autograd):
+functional AD re-exported from paddle.autograd.functional."""
+from ..autograd.functional import Hessian, Jacobian, jvp, vjp  # noqa: F401

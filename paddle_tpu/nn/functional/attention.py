@@ -87,8 +87,19 @@ def flash_attention_qkv_packed(qkv, num_heads, dropout=0.0, causal=True,
                                training=True):
     """Flash attention on the fused projection output [B, L, 3*H*D] -> the
     pre-packed [B, L, H*D] context (zero layout copies; head_dim % 128 == 0).
-    The hot path for MXU-aligned decoder blocks."""
+    The hot path for MXU-aligned decoder blocks. Off-TPU (no Mosaic), falls
+    back to splitting heads through scaled_dot_product_attention."""
     drop = float(dropout) if training else 0.0
+    shape = qkv.shape
+    d = shape[-1] // (3 * num_heads)
+    if not flash_path_available(shape[1], d, qkv):
+        b, L = shape[0], shape[1]
+        unwrap = qkv.value() if hasattr(qkv, "value") else qkv
+        q, k, v = (Tensor(unwrap[:, :, i * num_heads * d:(i + 1) * num_heads * d]
+                          .reshape(b, L, num_heads, d)) for i in range(3))
+        out = scaled_dot_product_attention(q, k, v, dropout_p=drop,
+                                           is_causal=causal, training=training)
+        return out.reshape([b, L, num_heads * d])
     args = [qkv]
     if drop > 0.0:
         seed = jax.random.key_data(rng.split_key()).ravel()[0].astype(jnp.int32)

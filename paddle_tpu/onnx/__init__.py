@@ -1,0 +1,27 @@
+"""paddle.onnx — ONNX export surface.
+
+Reference analog: python/paddle/onnx/export.py, which delegates to the
+external paddle2onnx converter. This environment ships no onnx runtime or
+converter, so `export` raises with the working alternative: `paddle.jit.save`
+emits a portable serialized StableHLO program (the TPU-native interchange
+format), loadable by `paddle.jit.load` / served via paddle.inference.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+        import paddle2onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "ONNX export needs the external onnx/paddle2onnx packages, which "
+            "are not part of this TPU image. Use paddle.jit.save(layer, path, "
+            "input_spec=...) — the .pdmodel holds serialized StableHLO, the "
+            "portable interchange format for XLA-compiled programs."
+        ) from e
+    raise NotImplementedError(
+        "paddle2onnx present but the converter bridge is not wired; "
+        "use paddle.jit.save (StableHLO) for interchange")

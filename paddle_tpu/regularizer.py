@@ -1,0 +1,41 @@
+"""paddle.regularizer — per-parameter weight decay declarations.
+
+Reference analog: python/paddle/regularizer.py (L1Decay/L2Decay objects
+attached through ParamAttr or the optimizer's weight_decay argument; the
+optimizer applies them when a param declares no override).
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __call__(self, param):
+        """Gradient contribution d(penalty)/d(param) (eager use)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """penalty = coeff * sum(|param|) -> grad += coeff * sign(param)."""
+
+    def __call__(self, param):
+        from .ops import sign
+        return sign(param) * self._coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    """penalty = coeff * 0.5 * sum(param^2) -> grad += coeff * param
+    (the decoupled form AdamW applies directly to the weights)."""
+
+    def __call__(self, param):
+        return param * self._coeff

@@ -1,0 +1,169 @@
+"""paddle.signal — STFT / ISTFT.
+
+Reference analog: python/paddle/signal.py (frame/overlap_add in C++ kernels,
+stft/istft composed in Python). Here framing is a strided gather and the DFT
+is a REAL basis matmul (cos/sin matrices on the MXU) rather than jnp.fft:
+the TPU runtime in this fleet implements complex construction/real/imag but
+not the fft custom-calls or complex host transfers, and an [n_bins, n_fft]
+matmul at typical window sizes is MXU-trivial anyway. Everything jits and
+differentiates.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import register_op
+from .ops._helpers import _op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_fwd(x, *, frame_length, hop_length, axis=-1):
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame supports the last axis")
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])        # [num, frame]
+    frames = jnp.take(x, idx, axis=-1)                      # [..., num, frame]
+    return jnp.swapaxes(frames, -1, -2)                     # [..., frame, num]
+
+
+register_op("signal_frame", _frame_fwd)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    return _op("signal_frame", x, frame_length=int(frame_length),
+               hop_length=int(hop_length), axis=int(axis))
+
+
+def _overlap_add_fwd(x, *, hop_length, axis=-1):
+    # x [..., frame_length, num_frames] -> [..., (num-1)*hop + frame]
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add supports the last axis")
+    frame_length, num = x.shape[-2], x.shape[-1]
+    out_len = (num - 1) * hop_length + frame_length
+    starts = hop_length * jnp.arange(num)
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]   # [frame, num]
+    flat = x.reshape(x.shape[:-2] + (frame_length * num,))
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    return out.at[..., idx.reshape(-1)].add(flat)
+
+
+register_op("signal_overlap_add", _overlap_add_fwd)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    return _op("signal_overlap_add", x, hop_length=int(hop_length),
+               axis=int(axis))
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """[B, T] (or [T]) -> complex [B, n_fft//2+1, num_frames] (onesided)."""
+    from .core.tensor import Tensor
+    import numpy as np
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    xv = x.value() if hasattr(x, "value") else jnp.asarray(x)
+    squeeze = xv.ndim == 1
+    if squeeze:
+        xv = xv[None]
+    if center:
+        pad = n_fft // 2
+        xv = jnp.pad(xv, ((0, 0), (pad, pad)), mode=pad_mode)
+    if window is None:
+        win = jnp.ones((win_length,), xv.dtype)
+    else:
+        win = window.value() if hasattr(window, "value") else jnp.asarray(window)
+    if win_length < n_fft:   # center-pad the window to n_fft (reference)
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    frames = _frame_fwd(xv, frame_length=n_fft, hop_length=hop_length)
+    frames = frames * win[None, :, None]
+    n_bins = n_fft // 2 + 1 if onesided else n_fft
+    k = np.arange(n_bins)[:, None]
+    n = np.arange(n_fft)[None, :]
+    ang = 2.0 * np.pi * k * n / n_fft
+    w_re = jnp.asarray(np.cos(ang), frames.dtype)
+    w_im = jnp.asarray(-np.sin(ang), frames.dtype)
+    re = jnp.einsum("kn,bnf->bkf", w_re, frames)
+    im = jnp.einsum("kn,bnf->bkf", w_im, frames)
+    if normalized:
+        re = re / np.sqrt(n_fft)
+        im = im / np.sqrt(n_fft)
+    spec = jax.lax.complex(re.astype(jnp.float32), im.astype(jnp.float32))
+    if squeeze:
+        spec = spec[0]
+    return Tensor(spec)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse of stft with window-envelope normalization (NOLA)."""
+    from .core.tensor import Tensor
+    import numpy as np
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xv = x.value() if hasattr(x, "value") else jnp.asarray(x)
+    squeeze = xv.ndim == 2
+    if squeeze:
+        xv = xv[None]
+    if normalized:
+        xv = xv * np.sqrt(n_fft)
+    if not onesided:
+        raise NotImplementedError(
+            "istft supports onesided spectra (real signals) on TPU")
+    if return_complex:
+        raise NotImplementedError(
+            "return_complex conflicts with onesided real reconstruction "
+            "(reference raises the same way)")
+    re = jnp.real(xv).astype(jnp.float32)
+    im = jnp.imag(xv).astype(jnp.float32)
+    n_bins = xv.shape[-2]
+    assert n_bins == n_fft // 2 + 1, "spectrum/n_fft mismatch"
+    # inverse real DFT basis: x_n = sum_k c_k (re_k cos - im_k sin) / N,
+    # c = 1 for DC and Nyquist, 2 for interior bins (conjugate symmetry)
+    k = np.arange(n_bins)[None, :]
+    n = np.arange(n_fft)[:, None]
+    c = np.where((k == 0) | (k == n_fft // 2), 1.0, 2.0)
+    ang = 2.0 * np.pi * k * n / n_fft
+    a_re = jnp.asarray(c * np.cos(ang) / n_fft, jnp.float32)
+    a_im = jnp.asarray(-c * np.sin(ang) / n_fft, jnp.float32)
+    frames = (jnp.einsum("nk,bkf->bnf", a_re, re)
+              + jnp.einsum("nk,bkf->bnf", a_im, im))
+    if window is None:
+        win = jnp.ones((win_length,), frames.dtype)
+    else:
+        win = window.value() if hasattr(window, "value") else jnp.asarray(window)
+        win = win.astype(frames.dtype)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    sig = _overlap_add_fwd(frames * win[None, :, None], hop_length=hop_length)
+    env = _overlap_add_fwd(
+        jnp.broadcast_to((win * win)[None, :, None],
+                         frames.shape).astype(frames.dtype),
+        hop_length=hop_length)
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        pad = n_fft // 2
+        sig = sig[..., pad:sig.shape[-1] - pad]
+    if length is not None:
+        if sig.shape[-1] < length:   # frames don't cover the tail: zero-pad
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1)
+                          + [(0, length - sig.shape[-1])])
+        sig = sig[..., :length]
+    if squeeze:
+        sig = sig[0]
+    return Tensor(sig)

@@ -1,0 +1,22 @@
+"""paddle.sysconfig — include/lib dirs for building against the framework.
+
+Reference analog: python/paddle/sysconfig.py (get_include/get_lib for custom
+op builds). Here the native seam is the ctypes C ABI: include exposes the
+package root (headers are the documented C signatures in
+inference/capi/paddle_inference_c.cpp), lib the built shared objects.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return _ROOT
+
+
+def get_lib() -> str:
+    return os.path.join(_ROOT, "core", "native")

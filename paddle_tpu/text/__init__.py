@@ -1,0 +1,174 @@
+"""paddle.text — sequence decoding + text dataset surface.
+
+Reference analog: python/paddle/text/ (viterbi_decode / ViterbiDecoder and
+the classic datasets: Imdb, Imikolov, Movielens, UCIHousing, WMT14/16,
+Conll05). The decoder is the real algorithm (a lax.scan over the lattice);
+datasets load from local files (this fleet has no egress — pass data_file).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._helpers import _op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
+           "Movielens", "UCIHousing", "WMT14", "WMT16", "Conll05st"]
+
+
+def _viterbi_fwd(potentials, transitions, lengths, *, include_bos_eos_tag=True):
+    """potentials [B, L, T], transitions [T(+2), T(+2)], lengths [B] ->
+    (scores [B], paths [B, L]). With bos/eos tags the last two transition
+    rows/cols are the virtual start/stop states (reference CRF convention)."""
+    b, L, t = potentials.shape
+    if include_bos_eos_tag:
+        bos, eos = t, t + 1
+        start = transitions[bos, :t][None, :]      # [1, T]
+        stop = transitions[:t, eos][None, :]
+    else:
+        start = jnp.zeros((1, t), potentials.dtype)
+        stop = jnp.zeros((1, t), potentials.dtype)
+    trans = transitions[:t, :t]
+
+    alpha0 = potentials[:, 0] + start              # [B, T]
+
+    def step(carry, i):
+        alpha, _ = carry, None
+        # scores[b, prev, cur] = alpha[b, prev] + trans[prev, cur]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)     # [B, T]
+        alpha_new = jnp.max(scores, axis=1) + potentials[:, i]
+        # positions past a sequence's length keep their alpha (masked)
+        live = (i < lengths)[:, None]
+        alpha_new = jnp.where(live, alpha_new, alpha)
+        return alpha_new, best_prev
+
+    alpha, backps = jax.lax.scan(step, alpha0, jnp.arange(1, L))
+    final = alpha + stop
+    best_last = jnp.argmax(final, axis=-1)         # [B]
+    scores = jnp.max(final, axis=-1)
+
+    def backtrack(carry, bp_i):
+        # bp_i: (backpointer [B, T], step index i) walking backwards
+        tag, i = carry
+        bp, idx = bp_i
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        live = (idx < lengths)
+        prev = jnp.where(live, prev, tag)
+        return (prev, idx), tag
+
+    (first, _), rev = jax.lax.scan(
+        backtrack, (best_last, jnp.int32(L - 1)),
+        (backps[::-1], jnp.arange(L - 1, 0, -1)))
+    paths = jnp.concatenate([first[None], rev[::-1]], axis=0).T   # [B, L]
+    return scores, paths.astype(jnp.int64)
+
+
+register_op("viterbi_decode", _viterbi_fwd, nondiff_inputs=(2,), no_jit=False)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    return _op("viterbi_decode", potentials, transition_params, lengths,
+               include_bos_eos_tag=bool(include_bos_eos_tag))
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)
+
+
+class _LocalTextDataset:
+    """Shared shape of the classic datasets: local file, line records.
+    Downloads are disabled on the fleet — pass `data_file`."""
+
+    def __init__(self, mode: str = "train", data_file: Optional[str] = None):
+        if data_file is None:
+            raise RuntimeError(
+                f"{type(self).__name__}: automatic download is unavailable "
+                "(no egress); pass data_file= pointing at a local copy")
+        self.mode = mode
+        self._records: List = []
+        self._load(data_file)
+
+    def _load(self, path):
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    self._records.append(self._parse(line))
+
+    def _parse(self, line):
+        return line
+
+    def __len__(self):
+        return len(self._records)
+
+    def __getitem__(self, i):
+        return self._records[i]
+
+
+class Imdb(_LocalTextDataset):
+    """label<TAB>text sentiment records."""
+
+    def _parse(self, line):
+        label, _, text = line.partition("\t")
+        return text, np.int64(int(label)) if label.strip().isdigit() else 0
+
+
+class Imikolov(_LocalTextDataset):
+    """n-gram language-model corpus: whitespace tokens per line."""
+
+    def _parse(self, line):
+        return line.split()
+
+
+class Movielens(_LocalTextDataset):
+    """user::movie::rating[::ts] records."""
+
+    def _parse(self, line):
+        parts = line.split("::")
+        return (int(parts[0]), int(parts[1]), float(parts[2]))
+
+
+class UCIHousing(_LocalTextDataset):
+    """13 features + price per line."""
+
+    def _parse(self, line):
+        vals = [float(v) for v in line.split()]
+        return (np.asarray(vals[:-1], np.float32),
+                np.asarray(vals[-1:], np.float32))
+
+
+class WMT14(_LocalTextDataset):
+    """src<TAB>tgt parallel pairs."""
+
+    def _parse(self, line):
+        src, _, tgt = line.partition("\t")
+        return src.split(), tgt.split()
+
+
+class WMT16(WMT14):
+    pass
+
+
+class Conll05st(_LocalTextDataset):
+    """token<SPACE>label per line; sentences separated by blank lines are
+    flattened to (token, label) records."""
+
+    def _parse(self, line):
+        tok, _, lab = line.partition(" ")
+        return tok, lab

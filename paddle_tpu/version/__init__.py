@@ -1,0 +1,29 @@
+"""paddle.version — build metadata (reference: generated version module)."""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "unknown"
+with_gpu = "OFF"          # reference field names; this build targets TPU
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+tpu = "ON"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print(f"tpu: {tpu}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,142 @@
+"""Round-2 namespace additions: hub, signal (stft/istft), text (viterbi),
+regularizer, sysconfig/version, functional autodiff (jvp/vjp/Jacobian/Hessian).
+
+Reference test pattern: numpy/scipy-free analytic oracles per surface."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_hub_local_protocol(tmp_path):
+    repo = tmp_path / "model_repo"
+    repo.mkdir()
+    (repo / "hubconf.py").write_text(
+        "def small_net(width=4):\n"
+        "    \"\"\"A tiny Linear.\"\"\"\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(2, width)\n")
+    assert paddle.hub.list(str(repo)) == ["small_net"]
+    assert "tiny Linear" in paddle.hub.help(str(repo), "small_net")
+    net = paddle.hub.load(str(repo), "small_net", width=6)
+    assert tuple(net.weight.shape) == (2, 6)
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.hub.list("user/repo", source="github")
+
+
+def test_stft_istft_roundtrip_and_parseval():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 400).astype("float32")
+    n_fft, hop = 128, 32
+    win = paddle.to_tensor(np.hanning(n_fft).astype("float32"))
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                              window=win)
+    assert tuple(spec.shape)[0] == 2 and tuple(spec.shape)[1] == n_fft // 2 + 1
+    # cross-check one frame against numpy rfft
+    frames = spec.numpy()
+    ref0 = np.fft.rfft(x[0, :n_fft] * np.hanning(n_fft))
+    # stft centers: frame at index n_fft//(2*hop) starts at sample 0
+    k = n_fft // 2 // hop
+    np.testing.assert_allclose(frames[0, :, k], ref0, rtol=1e-3, atol=1e-3)
+    # istft round-trip (interior samples; edges lose window coverage)
+    rec = paddle.signal.istft(spec, n_fft, hop_length=hop, window=win,
+                              length=400).numpy()
+    assert rec.shape == (2, 400)
+    # compare the fully-covered interior (the last partial frame's tail and
+    # the window-starved edges are reconstruction boundary effects)
+    np.testing.assert_allclose(rec[:, hop * 2:320],
+                               x[:, hop * 2:320], rtol=2e-3, atol=2e-3)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rs = np.random.RandomState(0)
+    B, L, T = 2, 5, 3
+    pots = rs.randn(B, L, T).astype("float32")
+    trans = rs.randn(T + 2, T + 2).astype("float32")
+    lengths = np.asarray([5, 5], "int64")
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pots), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths))
+    import itertools
+    bos, eos = T, T + 1
+    for b in range(B):
+        best, best_path = -1e30, None
+        for seq in itertools.product(range(T), repeat=L):
+            s = trans[bos, seq[0]] + pots[b, 0, seq[0]]
+            for i in range(1, L):
+                s += trans[seq[i - 1], seq[i]] + pots[b, i, seq[i]]
+            s += trans[seq[-1], eos]
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(float(scores.numpy()[b]), best, rtol=1e-5)
+        np.testing.assert_array_equal(paths.numpy()[b], best_path)
+
+
+def test_text_datasets_local(tmp_path):
+    p = tmp_path / "housing.txt"
+    rows = np.random.RandomState(0).rand(5, 14)
+    p.write_text("\n".join(" ".join(f"{v:.4f}" for v in r) for r in rows))
+    ds = paddle.text.UCIHousing(data_file=str(p))
+    assert len(ds) == 5
+    feat, price = ds[0]
+    assert feat.shape == (13,) and price.shape == (1,)
+    with pytest.raises(RuntimeError, match="egress|download"):
+        paddle.text.Imdb()
+
+
+def test_regularizer_objects():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    p = paddle.to_tensor(np.asarray([[1.0, -2.0]], "float32"))
+    np.testing.assert_allclose(L2Decay(0.5)(p).numpy(), [[0.5, -1.0]])
+    np.testing.assert_allclose(L1Decay(0.5)(p).numpy(), [[0.5, -0.5]])
+
+
+def test_sysconfig_version():
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.version.tpu == "ON"
+
+
+def test_onnx_export_points_to_stablehlo():
+    with pytest.raises(RuntimeError, match="StableHLO"):
+        paddle.onnx.export(paddle.nn.Linear(2, 2), "/tmp/x")
+
+
+# ------------------------------------------------------ functional autodiff
+
+def test_jvp_vjp_linear_map():
+    w = np.asarray([[1.0, 2.0], [3.0, 4.0]], "float32")
+
+    def f(x):
+        return paddle.matmul(x, paddle.to_tensor(w))
+
+    x = paddle.to_tensor(np.asarray([[1.0, 1.0]], "float32"))
+    v = paddle.to_tensor(np.asarray([[1.0, 0.0]], "float32"))
+    out, tangent = paddle.autograd.jvp(f, x, v)
+    np.testing.assert_allclose(out.numpy(), [[4.0, 6.0]])
+    np.testing.assert_allclose(tangent.numpy(), [[1.0, 2.0]])  # first row of W
+
+    out2, grad = paddle.autograd.vjp(f, x, v)
+    np.testing.assert_allclose(grad.numpy(), [[1.0, 3.0]])     # W @ v
+
+
+def test_jacobian_and_hessian():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], "float32"))
+    H = paddle.autograd.Hessian(f, x)
+    np.testing.assert_allclose(H[:].numpy(), 2 * np.eye(3), atol=1e-6)
+
+    def g(x):
+        return x * paddle.to_tensor(np.asarray([2.0, 3.0], "float32"))
+
+    J = paddle.autograd.Jacobian(g, paddle.to_tensor(
+        np.asarray([1.0, 1.0], "float32")))
+    np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 3.0]), atol=1e-6)
+    assert J.shape == (2, 2)
+    from paddle_tpu.incubate import autograd as iag
+    assert iag.jvp is paddle.autograd.jvp
