@@ -89,14 +89,24 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
          kd) = per
         y = ln(carry, l1w_, l1b_)
         qkv = y @ qw_ + qb_                      # [B,S,3H]
-        q, k, v = (t.reshape(b, s, num_heads, hd)
-                   for t in jnp.split(qkv, 3, axis=-1))
-        if use_flash:
-            from ..kernels.pallas.flash_attention import flash_attention_blhd
+        from ..kernels.pallas.flash_attention import (
+            flash_attention_blhd, flash_attention_qkv_packed,
+            packed_layout_supported)
+        if use_flash and packed_layout_supported(hd):
+            # fused-projection kernel: no head split/merge inside the scan
+            att = flash_attention_qkv_packed(
+                qkv, num_heads, causal=True, dropout_rate=attn_dropout,
+                seed=kd[0].astype(jnp.int32)).reshape(b, s, num_heads, hd)
+            q = k = v = None
+        elif use_flash:
+            q, k, v = (t.reshape(b, s, num_heads, hd)
+                       for t in jnp.split(qkv, 3, axis=-1))
             att = flash_attention_blhd(q, k, v, causal=True,
                                        dropout_rate=attn_dropout,
                                        seed=kd[0].astype(jnp.int32))
         else:
+            q, k, v = (t.reshape(b, s, num_heads, hd)
+                       for t in jnp.split(qkv, 3, axis=-1))
             qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
             logits = (jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
                       * (1.0 / math.sqrt(hd))).astype(jnp.float32)

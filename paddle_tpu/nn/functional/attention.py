@@ -92,7 +92,9 @@ def flash_attention_qkv_packed(qkv, num_heads, dropout=0.0, causal=True,
     drop = float(dropout) if training else 0.0
     shape = qkv.shape
     d = shape[-1] // (3 * num_heads)
-    if not flash_path_available(shape[1], d, qkv):
+    from ...kernels.pallas.flash_attention import packed_layout_supported
+    if not (flash_path_available(shape[1], d, qkv)
+            and packed_layout_supported(d)):
         b, L = shape[0], shape[1]
         unwrap = qkv.value() if hasattr(qkv, "value") else qkv
         q, k, v = (Tensor(unwrap[:, :, i * num_heads * d:(i + 1) * num_heads * d]

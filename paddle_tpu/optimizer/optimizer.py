@@ -50,6 +50,11 @@ class Optimizer:
         if isinstance(weight_decay, (float, int)) or weight_decay is None:
             self._weight_decay = float(weight_decay or 0.0)
         else:  # L2Decay-style object with a coeff
+            if type(weight_decay).__name__ == "L1Decay":
+                raise NotImplementedError(
+                    "optimizers apply decoupled L2 weight decay; add an L1 "
+                    "penalty to the loss (or regularizer(param) to grads) "
+                    "manually")
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay, "coeff", 0.0)))
         self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}

@@ -1,8 +1,11 @@
-"""paddle.regularizer — per-parameter weight decay declarations.
+"""paddle.regularizer — weight decay declarations.
 
-Reference analog: python/paddle/regularizer.py (L1Decay/L2Decay objects
-attached through ParamAttr or the optimizer's weight_decay argument; the
-optimizer applies them when a param declares no override).
+Reference analog: python/paddle/regularizer.py. Integration here: L2Decay
+passed as an optimizer's weight_decay contributes its coeff to the decoupled
+decay the update rule applies; L1Decay is a callable penalty-gradient for
+manual use (optimizers raise if handed one — their compiled update is
+decoupled-L2 only); ParamAttr-attached regularizers ride along for porting
+but are likewise manual.
 """
 from __future__ import annotations
 

@@ -136,7 +136,10 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     # c = 1 for DC and Nyquist, 2 for interior bins (conjugate symmetry)
     k = np.arange(n_bins)[None, :]
     n = np.arange(n_fft)[:, None]
-    c = np.where((k == 0) | (k == n_fft // 2), 1.0, 2.0)
+    # conjugate-symmetry weights: DC once; Nyquist once ONLY when it exists
+    # (even n_fft) — for odd n_fft bin n_fft//2 is interior and counts twice
+    nyq = (k == n_fft // 2) if n_fft % 2 == 0 else np.zeros_like(k, bool)
+    c = np.where((k == 0) | nyq, 1.0, 2.0)
     ang = 2.0 * np.pi * k * n / n_fft
     a_re = jnp.asarray(c * np.cos(ang) / n_fft, jnp.float32)
     a_im = jnp.asarray(-c * np.sin(ang) / n_fft, jnp.float32)

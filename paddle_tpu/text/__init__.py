@@ -67,7 +67,9 @@ def _viterbi_fwd(potentials, transitions, lengths, *, include_bos_eos_tag=True):
         backtrack, (best_last, jnp.int32(L - 1)),
         (backps[::-1], jnp.arange(L - 1, 0, -1)))
     paths = jnp.concatenate([first[None], rev[::-1]], axis=0).T   # [B, L]
-    return scores, paths.astype(jnp.int64)
+    # int32: jax truncates int64 under the default x64-disabled config (an
+    # explicit int64 cast would warn on every call and deliver int32 anyway)
+    return scores, paths.astype(jnp.int32)
 
 
 register_op("viterbi_decode", _viterbi_fwd, nondiff_inputs=(2,), no_jit=False)
