@@ -1,0 +1,41 @@
+"""paddle._C_ops — best-effort shim for code that calls the raw C-op bindings.
+
+Reference analog: python/paddle/_C_ops.py re-exports the generated Python-C
+functions (eager_api_* from libpaddle); user/framework code occasionally calls
+them directly (`paddle._C_ops.matmul(x, y, False, False)`).
+
+Here ops are registry entries, not C bindings, so this module forwards
+attribute lookups to the public functional surface by name. Signatures match
+the KEYWORD forms; positional attr-packs from the legacy C interface differ
+per op, so unknown names raise with the nearest matches listed rather than
+guessing.
+"""
+from __future__ import annotations
+
+import difflib
+from typing import Any
+
+__all__: list = []
+
+
+def _candidates():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.dispatch import _REGISTRY
+    return paddle, F, _REGISTRY
+
+
+def __getattr__(name: str) -> Any:
+    if name.startswith("__"):
+        raise AttributeError(name)
+    paddle, F, registry = _candidates()
+    target = getattr(paddle, name, None) or getattr(F, name, None)
+    if target is not None and callable(target):
+        return target
+    if name.startswith("final_state_"):  # legacy generated-name prefix
+        return __getattr__(name[len("final_state_"):])
+    pool = sorted(set(dir(paddle)) | set(dir(F)))
+    near = difflib.get_close_matches(name, pool, n=3)
+    raise AttributeError(
+        f"_C_ops.{name}: no matching op in the functional surface"
+        + (f"; close matches: {near}" if near else ""))

@@ -1,0 +1,53 @@
+"""paddle.dataset — legacy dataset loaders as reader creators.
+
+Reference analog: python/paddle/dataset/ (mnist/cifar/uci_housing/... exposing
+`train()/test()` reader creators). Deprecated upstream in favor of
+paddle.vision.datasets / paddle.text — this shim serves old recipes by
+wrapping those map-style datasets as reader generators. Downloads are
+disabled on the fleet: the vision datasets take local `image_path`/
+`label_path`/`data_file` arguments.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["mnist", "cifar", "uci_housing"]
+
+
+def _as_reader(ds) -> Callable:
+    def reader():
+        for i in range(len(ds)):
+            item = ds[i]
+            yield tuple(item) if isinstance(item, (tuple, list)) else (item,)
+    return reader
+
+
+class _Namespace:
+    def __init__(self, maker):
+        self._maker = maker
+
+    def train(self, **kwargs) -> Callable:
+        return _as_reader(self._maker(mode="train", **kwargs))
+
+    def test(self, **kwargs) -> Callable:
+        return _as_reader(self._maker(mode="test", **kwargs))
+
+
+def _mnist_maker(mode, **kwargs):
+    from ..vision.datasets import MNIST
+    return MNIST(mode=mode, **kwargs)
+
+
+def _cifar_maker(mode, **kwargs):
+    from ..vision.datasets import Cifar10
+    return Cifar10(mode=mode, **kwargs)
+
+
+def _uci_maker(mode, **kwargs):
+    from ..text import UCIHousing
+    return UCIHousing(mode=mode, **kwargs)
+
+
+mnist = _Namespace(_mnist_maker)
+cifar = _Namespace(_cifar_maker)
+uci_housing = _Namespace(_uci_maker)

@@ -93,11 +93,11 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
             flash_attention_blhd, flash_attention_qkv_packed,
             packed_layout_supported)
         if use_flash and packed_layout_supported(hd):
-            # fused-projection kernel: no head split/merge inside the scan
+            # fused-projection kernel: no head split/merge inside the scan —
+            # the output is already the [b, s, h] layout the proj matmul wants
             att = flash_attention_qkv_packed(
                 qkv, num_heads, causal=True, dropout_rate=attn_dropout,
-                seed=kd[0].astype(jnp.int32)).reshape(b, s, num_heads, hd)
-            q = k = v = None
+                seed=kd[0].astype(jnp.int32))
         elif use_flash:
             q, k, v = (t.reshape(b, s, num_heads, hd)
                        for t in jnp.split(qkv, 3, axis=-1))

@@ -50,7 +50,8 @@ class Optimizer:
         if isinstance(weight_decay, (float, int)) or weight_decay is None:
             self._weight_decay = float(weight_decay or 0.0)
         else:  # L2Decay-style object with a coeff
-            if type(weight_decay).__name__ == "L1Decay":
+            from ..regularizer import L1Decay
+            if isinstance(weight_decay, L1Decay):
                 raise NotImplementedError(
                     "optimizers apply decoupled L2 weight decay; add an L1 "
                     "penalty to the loss (or regularizer(param) to grads) "

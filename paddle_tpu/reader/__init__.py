@@ -1,0 +1,147 @@
+"""paddle.reader — legacy reader-creator decorators.
+
+Reference analog: python/paddle/reader/decorator.py — composable generators
+predating DataLoader (map_readers, shuffle, buffered, compose, chain,
+firstn, xmap_readers). Still imported by older recipes; kept semantically
+faithful over plain Python generators/threads.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable
+
+__all__ = ["map_readers", "shuffle", "buffered", "compose", "chain",
+           "firstn", "xmap_readers", "cache"]
+
+
+def map_readers(func: Callable, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader: Callable, buf_size: int):
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+    return shuffled
+
+
+def buffered(reader: Callable, size: int):
+    """Background-thread prefetch of up to `size` items."""
+    end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    return buffered_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into flattened tuples (reference compose).
+    check_alignment=True raises ComposeNotAligned when lengths differ;
+    False stops at the shortest (reference: outputs of ended readers are
+    simply absent)."""
+    _END = object()
+
+    def composed():
+        iters = [r() for r in readers]
+        while True:
+            items = [next(it, _END) for it in iters]
+            ended = [it is _END for it in items]
+            if all(ended):
+                return
+            if any(ended):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return  # stop at the shortest
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return composed
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+    return chained
+
+
+def firstn(reader: Callable, n: int):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Thread-pool mapped reader with a bounded in-flight window (reference
+    xmap_readers buffers at most buffer_size items — streaming sources never
+    materialize)."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    window = max(1, int(buffer_size))
+
+    def xreader():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            pending = deque()
+            it = reader()
+            for item in it:
+                pending.append(pool.submit(mapper, item))
+                if len(pending) >= window:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+    return xreader
+
+
+def cache(reader: Callable):
+    state = {}
+
+    def cached():
+        if "items" not in state:
+            items = list(reader())   # fill completely before publishing, so
+            state["items"] = items   # a mid-read failure can't leave a
+        yield from state["items"]    # half-cached prefix to be duplicated
+    return cached

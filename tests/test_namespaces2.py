@@ -140,3 +140,43 @@ def test_jacobian_and_hessian():
     assert J.shape == (2, 2)
     from paddle_tpu.incubate import autograd as iag
     assert iag.jvp is paddle.autograd.jvp
+
+
+def test_c_ops_shim_forwards():
+    import paddle_tpu._C_ops as C
+    x = paddle.to_tensor(np.asarray([[1.0, 2.0]], "float32"))
+    y = paddle.to_tensor(np.asarray([[3.0], [4.0]], "float32"))
+    np.testing.assert_allclose(C.matmul(x, y).numpy(), [[11.0]])
+    assert C.final_state_matmul is C.matmul or callable(C.final_state_matmul)
+    with pytest.raises(AttributeError, match="close matches"):
+        C.matmull  # typo -> suggestion
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))
+    import paddle_tpu.reader as reader
+    assert list(reader.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(reader.shuffle(r, 4)()) == list(range(10))
+    assert list(reader.buffered(r, 2)()) == list(range(10))
+    assert list(reader.chain(r, r)()) == list(range(10)) * 2
+    assert list(reader.map_readers(lambda a, b: a + b, r, r)()) == \
+        [2 * i for i in range(10)]
+    pairs = list(reader.compose(r, r)())
+    assert pairs[:2] == [(0, 0), (1, 1)]
+    short = lambda: iter(range(5))
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(r, short)())
+    assert len(list(reader.compose(r, short, check_alignment=False)())) == 5
+    sq = list(reader.xmap_readers(lambda v: v * v, r, 2, 4, order=True)())
+    assert sq == [i * i for i in range(10)]
+    c = reader.cache(r)
+    assert list(c()) == list(c()) == list(range(10))
+
+
+def test_dataset_shim(tmp_path):
+    rows = np.random.RandomState(0).rand(4, 14)
+    p = tmp_path / "uci.txt"
+    p.write_text("\n".join(" ".join(f"{v:.4f}" for v in r) for r in rows))
+    train = paddle.dataset.uci_housing.train(data_file=str(p))
+    recs = list(train())
+    assert len(recs) == 4 and recs[0][0].shape == (13,)
