@@ -31,9 +31,12 @@ def __getattr__(name: str) -> Any:
     paddle, F, registry = _candidates()
     target = getattr(paddle, name, None) or getattr(F, name, None)
     if target is not None and callable(target):
+        globals()[name] = target   # memoize: later accesses skip __getattr__
         return target
     if name.startswith("final_state_"):  # legacy generated-name prefix
-        return __getattr__(name[len("final_state_"):])
+        target = __getattr__(name[len("final_state_"):])
+        globals()[name] = target
+        return target
     pool = sorted(set(dir(paddle)) | set(dir(F)))
     near = difflib.get_close_matches(name, pool, n=3)
     raise AttributeError(

@@ -1,53 +1,102 @@
 """paddle.dataset — legacy dataset loaders as reader creators.
 
 Reference analog: python/paddle/dataset/ (mnist/cifar/uci_housing/... exposing
-`train()/test()` reader creators). Deprecated upstream in favor of
-paddle.vision.datasets / paddle.text — this shim serves old recipes by
-wrapping those map-style datasets as reader generators. Downloads are
-disabled on the fleet: the vision datasets take local `image_path`/
-`label_path`/`data_file` arguments.
+reader creators). Deprecated upstream in favor of paddle.vision.datasets /
+paddle.text — this shim serves old recipes with the LEGACY record shapes:
+mnist yields ((784,) float32 in [-1,1], int label); cifar exposes
+train10/test10/train100/test100; uci_housing normalizes features and splits
+80/20. Downloads are disabled on the fleet: pass the local-file arguments the
+underlying datasets take.
 """
 from __future__ import annotations
 
 from typing import Callable
 
+import numpy as np
+
 __all__ = ["mnist", "cifar", "uci_housing"]
 
 
-def _as_reader(ds) -> Callable:
-    def reader():
-        for i in range(len(ds)):
-            item = ds[i]
-            yield tuple(item) if isinstance(item, (tuple, list)) else (item,)
-    return reader
+class _Mnist:
+    """Legacy record shape: flattened (784,) float32 scaled to [-1, 1] and a
+    plain int label (reference dataset/mnist.py reader_creator)."""
 
-
-class _Namespace:
-    def __init__(self, maker):
-        self._maker = maker
+    @staticmethod
+    def _reader(mode, kwargs) -> Callable:
+        def reader():
+            from ..vision.datasets import MNIST
+            ds = MNIST(mode=mode, **kwargs)
+            for i in range(len(ds)):
+                img, label = ds[i]
+                arr = np.asarray(img, np.float32).reshape(-1)
+                yield arr / 127.5 - 1.0, int(np.asarray(label).ravel()[0])
+        return reader
 
     def train(self, **kwargs) -> Callable:
-        return _as_reader(self._maker(mode="train", **kwargs))
+        return self._reader("train", kwargs)
 
     def test(self, **kwargs) -> Callable:
-        return _as_reader(self._maker(mode="test", **kwargs))
+        return self._reader("test", kwargs)
 
 
-def _mnist_maker(mode, **kwargs):
-    from ..vision.datasets import MNIST
-    return MNIST(mode=mode, **kwargs)
+class _Cifar:
+    """Legacy names: train10/test10 (Cifar10), train100/test100 (Cifar100);
+    records are ((3072,) float32 in [0,1], int label) per the reference."""
+
+    @staticmethod
+    def _reader(cls_name, mode, kwargs) -> Callable:
+        def reader():
+            from ..vision import datasets as vds
+            ds = getattr(vds, cls_name)(mode=mode, **kwargs)
+            for i in range(len(ds)):
+                img, label = ds[i]
+                arr = np.asarray(img, np.float32).reshape(-1)
+                yield arr / 255.0, int(np.asarray(label).ravel()[0])
+        return reader
+
+    def train10(self, **kwargs) -> Callable:
+        return self._reader("Cifar10", "train", kwargs)
+
+    def test10(self, **kwargs) -> Callable:
+        return self._reader("Cifar10", "test", kwargs)
+
+    def train100(self, **kwargs) -> Callable:
+        return self._reader("Cifar100", "train", kwargs)
+
+    def test100(self, **kwargs) -> Callable:
+        return self._reader("Cifar100", "test", kwargs)
 
 
-def _cifar_maker(mode, **kwargs):
-    from ..vision.datasets import Cifar10
-    return Cifar10(mode=mode, **kwargs)
+class _UciHousing:
+    """Legacy semantics (reference dataset/uci_housing.py): features
+    max-normalized over the WHOLE file, first 80% of rows = train, rest =
+    test."""
+
+    @staticmethod
+    def _rows(kwargs):
+        from ..text import UCIHousing
+        ds = UCIHousing(mode="train", **kwargs)
+        feats = np.stack([ds[i][0] for i in range(len(ds))])
+        prices = np.stack([ds[i][1] for i in range(len(ds))])
+        scale = np.maximum(np.abs(feats).max(axis=0), 1e-12)
+        return feats / scale, prices
+
+    def _reader(self, mode, kwargs) -> Callable:
+        def reader():
+            feats, prices = self._rows(kwargs)
+            split = int(len(feats) * 0.8)
+            sl = slice(0, split) if mode == "train" else slice(split, None)
+            for f, p in zip(feats[sl], prices[sl]):
+                yield f.astype(np.float32), p.astype(np.float32)
+        return reader
+
+    def train(self, **kwargs) -> Callable:
+        return self._reader("train", kwargs)
+
+    def test(self, **kwargs) -> Callable:
+        return self._reader("test", kwargs)
 
 
-def _uci_maker(mode, **kwargs):
-    from ..text import UCIHousing
-    return UCIHousing(mode=mode, **kwargs)
-
-
-mnist = _Namespace(_mnist_maker)
-cifar = _Namespace(_cifar_maker)
-uci_housing = _Namespace(_uci_maker)
+mnist = _Mnist()
+cifar = _Cifar()
+uci_housing = _UciHousing()

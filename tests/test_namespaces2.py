@@ -147,7 +147,7 @@ def test_c_ops_shim_forwards():
     x = paddle.to_tensor(np.asarray([[1.0, 2.0]], "float32"))
     y = paddle.to_tensor(np.asarray([[3.0], [4.0]], "float32"))
     np.testing.assert_allclose(C.matmul(x, y).numpy(), [[11.0]])
-    assert C.final_state_matmul is C.matmul or callable(C.final_state_matmul)
+    assert C.final_state_matmul is C.matmul  # prefix stripping + memoization
     with pytest.raises(AttributeError, match="close matches"):
         C.matmull  # typo -> suggestion
 
@@ -174,9 +174,15 @@ def test_reader_decorators():
 
 
 def test_dataset_shim(tmp_path):
-    rows = np.random.RandomState(0).rand(4, 14)
+    rows = np.random.RandomState(0).rand(10, 14) + 0.5
     p = tmp_path / "uci.txt"
     p.write_text("\n".join(" ".join(f"{v:.4f}" for v in r) for r in rows))
-    train = paddle.dataset.uci_housing.train(data_file=str(p))
-    recs = list(train())
-    assert len(recs) == 4 and recs[0][0].shape == (13,)
+    train = list(paddle.dataset.uci_housing.train(data_file=str(p))())
+    test = list(paddle.dataset.uci_housing.test(data_file=str(p))())
+    # legacy semantics: 80/20 split, max-normalized features
+    assert len(train) == 8 and len(test) == 2
+    assert train[0][0].shape == (13,)
+    allf = np.stack([r[0] for r in train + test])
+    np.testing.assert_allclose(np.abs(allf).max(axis=0), 1.0, rtol=1e-5)
+    assert hasattr(paddle.dataset.cifar, "train10")   # legacy names
+    assert hasattr(paddle.dataset.cifar, "train100")

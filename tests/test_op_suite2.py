@@ -1,0 +1,98 @@
+"""OpTest coverage for round-2 additions: roi_align, deform_conv2d, box_coder,
+signal frame/overlap_add, rope — eager == traced, analytic grad == finite
+difference (SURVEY.md §4 per-op strategy)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+_rs = np.random.RandomState(7)
+
+
+class TestRoiAlignOp(OpTest):
+    @staticmethod
+    def fn(x):
+        from paddle_tpu.vision import ops as vops
+        boxes = paddle.to_tensor(
+            np.asarray([[1.0, 1.0, 9.0, 9.0], [2.0, 0.0, 7.5, 6.0]],
+                       "float32"))
+        n = paddle.to_tensor(np.asarray([2], "int32"))
+        return vops.roi_align(x, boxes, n, output_size=3, sampling_ratio=2)
+
+    def inputs(self):
+        return [_rs.randn(1, 2, 12, 12).astype("float32")]
+
+
+class TestDeformConvOp(OpTest):
+    diff_inputs = (0, 1, 2)
+    grad_rtol = 8e-2
+
+    @staticmethod
+    def fn(x, offset, w):
+        from paddle_tpu.vision import ops as vops
+        return vops.deform_conv2d(x, offset, w, padding=1)
+
+    def inputs(self):
+        # offsets biased to mid-cell (x.37): bilinear sampling is piecewise
+        # linear in the offsets, so finite differences straddle a kink when a
+        # sample point sits exactly on the integer grid
+        return [_rs.randn(1, 3, 6, 6).astype("float32") * 0.5,
+                (_rs.randn(1, 2 * 9, 6, 6) * 0.05 + 0.37).astype("float32"),
+                _rs.randn(4, 3, 3, 3).astype("float32") * 0.5]
+
+
+class TestBoxCoderDecodeOp(OpTest):
+    @staticmethod
+    def fn(t):
+        from paddle_tpu.vision import ops as vops
+        priors = paddle.to_tensor(
+            np.sort(np.random.RandomState(3).rand(4, 4) * 30, -1)
+            .astype("float32"))
+        return vops.box_coder(priors, None, t,
+                              code_type="decode_center_size")
+
+    def inputs(self):
+        return [(_rs.randn(2, 4, 4) * 0.1).astype("float32")]
+
+
+class TestSignalFrameOp(OpTest):
+    @staticmethod
+    def fn(x):
+        return paddle.signal.frame(x, frame_length=8, hop_length=4)
+
+    def inputs(self):
+        return [_rs.randn(2, 32).astype("float32")]
+
+    def np_ref(self, x):
+        num = 1 + (32 - 8) // 4
+        out = np.stack([x[:, i * 4:i * 4 + 8] for i in range(num)], -1)
+        return out
+
+
+class TestOverlapAddOp(OpTest):
+    @staticmethod
+    def fn(x):
+        return paddle.signal.overlap_add(x, hop_length=4)
+
+    def inputs(self):
+        return [_rs.randn(2, 8, 5).astype("float32")]
+
+    def np_ref(self, x):
+        out = np.zeros((2, 4 * 4 + 8), x.dtype)
+        for f in range(5):
+            out[:, f * 4:f * 4 + 8] += x[:, :, f]
+        return out
+
+
+class TestRopeOp(OpTest):
+    diff_inputs = (0, 1)
+
+    @staticmethod
+    def fn(q, k):
+        from paddle_tpu.ops._helpers import _op
+        out_q, out_k = _op("rope", q, k, theta=10000.0)
+        return out_q + out_k
+
+    def inputs(self):
+        return [(_rs.randn(1, 8, 2, 8) * 0.5).astype("float32"),
+                (_rs.randn(1, 8, 2, 8) * 0.5).astype("float32")]
