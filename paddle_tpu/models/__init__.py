@@ -10,5 +10,7 @@ from .bert import BertConfig, BertModel, BertForPreTraining, bert_base, bert_tin
 from .ernie import (ErnieConfig, ErnieModel,  # noqa: F401
                     ErnieForSequenceClassification, ErnieForMaskedLM,
                     ernie_tiny)
+from .t5 import (T5Config, T5Model,  # noqa: F401
+                 T5ForConditionalGeneration, t5_tiny)
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM, llama_tiny,  # noqa: F401
                     llama_7b, shard_llama_tp)

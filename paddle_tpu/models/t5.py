@@ -1,0 +1,255 @@
+"""T5-style encoder-decoder LM — the seq2seq family of the model zoo.
+
+Design notes (T5 recipe): RMS-style pre-norm (LayerNorm without bias/mean
+subtraction), relative position biases shared across layers (bucketed,
+bidirectional for the encoder, causal for the decoder), tied embedding, and
+a gated-GELU feed-forward. Built on paddle_tpu.nn so it runs eager, traced,
+and under mesh sharding like GPT/BERT/LLaMA (reference surface:
+nn.Transformer in python/paddle/nn/layer/transformer.py:257 — full seq2seq
+architectures live in PaddleNLP; here they are first-class zoo members).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from .. import ops
+
+
+@dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6            # encoder depth == decoder depth
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_epsilon: float = 1e-6
+    initializer_factor: float = 1.0
+
+
+def t5_tiny(**overrides) -> "T5Config":
+    cfg = dict(vocab_size=512, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+               num_heads=4)
+    cfg.update(overrides)
+    return T5Config(**cfg)
+
+
+def _relative_bucket(rel_pos, bidirectional, num_buckets, max_distance):
+    """T5's log-bucketed relative positions (numpy; built once per config)."""
+    ret = np.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(np.int64) * num_buckets
+        n = np.abs(n)
+    else:
+        n = np.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        np.log(np.maximum(n, 1) / max_exact)
+        / math.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(np.int64)
+    large = np.minimum(large, num_buckets - 1)
+    return ret + np.where(is_small, n, large)
+
+
+class T5LayerNorm(nn.Layer):
+    """RMS norm, no bias, no mean subtraction (the T5 variant)."""
+
+    def __init__(self, d, eps):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [d], default_initializer=nn.initializer.Constant(1.0))
+        self.eps = eps
+
+    def forward(self, x):
+        var = (x * x).mean(-1, keepdim=True)
+        return x * ops.rsqrt(var + self.eps) * self.weight
+
+
+class T5Attention(nn.Layer):
+    def __init__(self, config: T5Config, has_relative_bias: bool,
+                 bidirectional: bool):
+        super().__init__()
+        inner = config.num_heads * config.d_kv
+        self.q = nn.Linear(config.d_model, inner, bias_attr=False)
+        self.k = nn.Linear(config.d_model, inner, bias_attr=False)
+        self.v = nn.Linear(config.d_model, inner, bias_attr=False)
+        self.o = nn.Linear(inner, config.d_model, bias_attr=False)
+        self.n_heads = config.num_heads
+        self.d_kv = config.d_kv
+        self.dropout = config.dropout_rate
+        self._bias_cfg = (config.relative_attention_num_buckets,
+                          config.relative_attention_max_distance,
+                          bidirectional)
+        self.relative_attention_bias = (
+            nn.Embedding(config.relative_attention_num_buckets,
+                         config.num_heads) if has_relative_bias else None)
+
+    def _position_bias(self, q_len, kv_len):
+        buckets, maxd, bidir = self._bias_cfg
+        ctx = np.arange(q_len)[:, None]
+        mem = np.arange(kv_len)[None, :]
+        idx = _relative_bucket(mem - ctx, bidir, buckets, maxd)
+        from ..core.tensor import Tensor
+        bias = self.relative_attention_bias(Tensor(idx.astype(np.int64)))
+        return bias.transpose([2, 0, 1]).unsqueeze(0)   # [1, H, Lq, Lk]
+
+    def forward(self, x, kv=None, attn_mask=None, position_bias=None,
+                causal=False):
+        b, lq, _ = x.shape
+        src = kv if kv is not None else x
+        lk = src.shape[1]
+        q = self.q(x).reshape([b, lq, self.n_heads, self.d_kv])
+        k = self.k(src).reshape([b, lk, self.n_heads, self.d_kv])
+        v = self.v(src).reshape([b, lk, self.n_heads, self.d_kv])
+        if position_bias is None and self.relative_attention_bias is not None:
+            position_bias = self._position_bias(lq, lk)
+        mask = attn_mask
+        if position_bias is not None:
+            mask = position_bias if mask is None else mask + position_bias
+        # T5 scales by 1.0 (folded into init), so undo sdpa's 1/sqrt(d)
+        out = F.scaled_dot_product_attention(
+            q * math.sqrt(self.d_kv), k, v, attn_mask=mask,
+            dropout_p=self.dropout if self.training else 0.0,
+            is_causal=causal, training=self.training)
+        return self.o(out.reshape([b, lq, self.n_heads * self.d_kv])), \
+            position_bias
+
+
+class T5FF(nn.Layer):
+    """Gated-GELU feed-forward (T5 v1.1 recipe)."""
+
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.wi_0 = nn.Linear(config.d_model, config.d_ff, bias_attr=False)
+        self.wi_1 = nn.Linear(config.d_model, config.d_ff, bias_attr=False)
+        self.wo = nn.Linear(config.d_ff, config.d_model, bias_attr=False)
+
+    def forward(self, x):
+        return self.wo(F.gelu(self.wi_0(x), approximate=True) * self.wi_1(x))
+
+
+class T5Block(nn.Layer):
+    def __init__(self, config: T5Config, is_decoder: bool,
+                 has_relative_bias: bool):
+        super().__init__()
+        self.is_decoder = is_decoder
+        eps = config.layer_norm_epsilon
+        self.self_norm = T5LayerNorm(config.d_model, eps)
+        self.self_attn = T5Attention(config, has_relative_bias,
+                                     bidirectional=not is_decoder)
+        if is_decoder:
+            self.cross_norm = T5LayerNorm(config.d_model, eps)
+            self.cross_attn = T5Attention(config, False, bidirectional=True)
+        self.ff_norm = T5LayerNorm(config.d_model, eps)
+        self.ff = T5FF(config)
+        self.drop = nn.Dropout(config.dropout_rate)
+
+    def forward(self, x, enc=None, position_bias=None, self_mask=None,
+                cross_mask=None):
+        a, position_bias = self.self_attn(self.self_norm(x), attn_mask=self_mask,
+                                          position_bias=position_bias,
+                                          causal=self.is_decoder)
+        x = x + self.drop(a)
+        if self.is_decoder:
+            # cross-attention masks the SOURCE pads (T5 semantics: the encoder
+            # attention_mask applies to cross-attention too)
+            c, _ = self.cross_attn(self.cross_norm(x), kv=enc,
+                                   attn_mask=cross_mask)
+            x = x + self.drop(c)
+        x = x + self.drop(self.ff(self.ff_norm(x)))
+        return x, position_bias
+
+
+class T5Stack(nn.Layer):
+    def __init__(self, config: T5Config, is_decoder: bool, embed):
+        super().__init__()
+        self.embed = embed
+        self.is_decoder = is_decoder
+        # relative bias lives in the FIRST layer, shared by the rest (T5)
+        self.blocks = nn.LayerList(
+            [T5Block(config, is_decoder, has_relative_bias=(i == 0))
+             for i in range(config.num_layers)])
+        self.final_norm = T5LayerNorm(config.d_model,
+                                      config.layer_norm_epsilon)
+        self.drop = nn.Dropout(config.dropout_rate)
+
+    def forward(self, ids, enc=None, self_mask=None, cross_mask=None):
+        x = self.drop(self.embed(ids))
+        bias = None
+        for blk in self.blocks:
+            x, bias = blk(x, enc=enc, position_bias=bias, self_mask=self_mask,
+                          cross_mask=cross_mask)
+        return self.drop(self.final_norm(x))
+
+
+class T5Model(nn.Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.shared = nn.Embedding(config.vocab_size, config.d_model)
+        self.encoder = T5Stack(config, is_decoder=False, embed=self.shared)
+        self.decoder = T5Stack(config, is_decoder=True, embed=self.shared)
+        normal = nn.initializer.Normal(
+            mean=0.0, std=config.initializer_factor / math.sqrt(config.d_model))
+        for name, p in self.named_parameters():
+            if p.ndim >= 2:
+                p.set_value(normal(tuple(p.shape), p.dtype))
+
+    def forward(self, input_ids, decoder_input_ids, enc_mask=None):
+        enc = self.encoder(input_ids, self_mask=enc_mask)
+        return self.decoder(decoder_input_ids, enc=enc, cross_mask=enc_mask)
+
+
+class T5ForConditionalGeneration(nn.Layer):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.t5 = T5Model(config)
+        self.config = config
+
+    def _head(self, hidden):
+        # tied head, T5's rescaling by d_model^-0.5
+        return ops.matmul(hidden * (self.config.d_model ** -0.5),
+                          self.t5.shared.weight, transpose_y=True)
+
+    def forward(self, input_ids, decoder_input_ids, labels=None,
+                enc_mask=None):
+        hidden = self.t5(input_ids, decoder_input_ids, enc_mask)
+        logits = self._head(hidden)
+        if labels is not None:
+            v = logits.shape[-1]
+            loss = F.cross_entropy(logits.reshape([-1, v]),
+                                   labels.reshape([-1]), ignore_index=-100)
+            return logits, loss
+        return logits
+
+    def greedy_generate(self, input_ids, max_len=16, bos_id=0, eos_id=1,
+                        enc_mask=None):
+        """Minimal greedy decode: the source is encoded ONCE; the decoder
+        re-runs its full prefix per step (serving-grade KV-cache decoding
+        lives in the inference engine)."""
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+        b = input_ids.shape[0]
+        dec = np.full((b, 1), bos_id, np.int64)
+        with no_grad():
+            enc = self.t5.encoder(input_ids, self_mask=enc_mask)
+            for _ in range(max_len - 1):
+                hidden = self.t5.decoder(Tensor(dec), enc=enc,
+                                         cross_mask=enc_mask)
+                logits = self._head(hidden)
+                nxt = np.asarray(logits.value())[:, -1].argmax(-1)
+                dec = np.concatenate([dec, nxt[:, None].astype(np.int64)], 1)
+                if (nxt == eos_id).all():
+                    break
+        return dec

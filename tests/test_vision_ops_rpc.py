@@ -317,3 +317,65 @@ def test_rpc_two_processes(tmp_path):
     assert procs[0].returncode == 0, outs[0][-2000:]
     assert procs[1].returncode == 0, outs[1][-2000:]
     assert "RPC_OK" in outs[0] and "REMOTE_EXC_OK" in outs[0]
+
+
+def test_t5_seq2seq_trains_and_generates():
+    """Encoder-decoder family: loss decreases on a copy task; greedy decode
+    runs; relative position bias is shared from layer 0."""
+    from paddle_tpu.models import T5ForConditionalGeneration, t5_tiny
+    paddle.seed(0)
+    cfg = t5_tiny(dropout_rate=0.0)
+    m = T5ForConditionalGeneration(cfg)
+    rs = np.random.RandomState(0)
+    src = paddle.to_tensor(rs.randint(2, cfg.vocab_size, (4, 12)).astype("int64"))
+    # teacher forcing: decoder input = [BOS, y[:-1]], label = y
+    y = rs.randint(2, cfg.vocab_size, (4, 8)).astype("int64")
+    dec_in = np.concatenate([np.zeros((4, 1), "int64"), y[:, :-1]], 1)
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=m.parameters())
+    losses = []
+    for _ in range(5):
+        _, loss = m(src, paddle.to_tensor(dec_in),
+                    labels=paddle.to_tensor(y))
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    out = m.greedy_generate(src, max_len=4)
+    assert out.shape[0] == 4 and out.shape[1] <= 4
+    # only layer 0 holds the relative bias table (shared downward)
+    biases = [blk.self_attn.relative_attention_bias
+              for blk in m.t5.encoder.blocks]
+    assert biases[0] is not None and all(b is None for b in biases[1:])
+
+
+def test_dist_model_tp_sharded_serving():
+    """DistModel with TP-sharded weights: NamedSharded params serve through
+    the predictor path and match dense numerics."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed import fleet, get_mesh
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet_executor import DistModel, DistModelConfig
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4, "pp_degree": 1,
+                               "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = get_mesh()
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    net.eval()
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+    # column-shard the first weight, row-shard the second over `model`
+    net[0].weight._data = jax.device_put(
+        net[0].weight.value(), NamedSharding(mesh, P(None, "model")))
+    net[2].weight._data = jax.device_put(
+        net[2].weight.value(), NamedSharding(mesh, P("model", None)))
+
+    dm = DistModel(DistModelConfig(model=net, mp_degree=4,
+                                   micro_batch_size=2))
+    assert dm.init()
+    np.testing.assert_allclose(dm.run([x])[0], ref, rtol=1e-5, atol=1e-6)
