@@ -13,8 +13,17 @@
 //
 // C ABI (ctypes-bound from paddle_tpu/distributed/fleet_executor/bus.py):
 //   bus_create(rank) -> handle
-//   bus_listen(bus, port) -> bound port (0 = ephemeral)
+//   bus_set_token(bus, token, len)            optional shared auth token
+//   bus_listen(bus, port) -> bound port (0 = ephemeral, all interfaces)
+//   bus_listen_ip(bus, ip, port)              bind one interface
 //   bus_connect(bus, rank, host, port) -> 0/-1
+//
+// Security model: payloads are pickled by the Python layer, so the bus MUST
+// only be reachable by job peers (same trust model as the reference's brpc
+// message_bus). Two mitigations beyond the reference: the listener can bind
+// a specific interface (PADDLE_BIND_IP), and when a shared token is set
+// (PADDLE_BUS_TOKEN, distributed to ranks by the launcher) every inbound
+// connection must present it before any frame is parsed.
 //   bus_route(bus, actor_id, rank)            routing table entry
 //   bus_open_mailbox(bus, actor_id)           local mailbox (actor lives here)
 //   bus_send(bus, src, dst, type, payload, len) -> 0 ok, -1 no route/peer
@@ -35,6 +44,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -65,6 +75,7 @@ struct Peer {
 
 struct Bus {
   int rank = 0;
+  std::string token;  // when non-empty, peers must present it on connect
   std::atomic<bool> closing{false};  // wakes bus_recv waiters before destroy
   std::mutex mu;  // guards mailboxes/routes/peers maps (not mailbox queues)
   std::map<int64_t, std::unique_ptr<Mailbox>> mailboxes;
@@ -123,6 +134,42 @@ void deliver_local(Bus* bus, int64_t src, int64_t dst, int32_t type,
 }
 
 void reader_loop(Bus* bus, int fd) {
+  // Auth handshake: when the bus has a token, the very first bytes on an
+  // inbound link must be "PTB1" + [i32 len] + token. Anything else closes
+  // the socket before a single frame is parsed — unauthenticated peers
+  // cannot reach the pickle layer above. A tokenless server still peeks for
+  // the magic so a token-presence mismatch between peers fails loudly
+  // instead of mis-parsing the handshake as a frame header and hanging the
+  // job silently.
+  if (!bus->token.empty()) {
+    char magic[4];
+    int32_t tlen;
+    if (!read_full(fd, magic, 4) || std::memcmp(magic, "PTB1", 4) != 0 ||
+        !read_full(fd, &tlen, 4) || tlen < 0 || tlen > 4096) {
+      ::close(fd);
+      return;
+    }
+    std::string got(static_cast<size_t>(tlen), '\0');
+    if (tlen > 0 && !read_full(fd, &got[0], got.size())) {
+      ::close(fd);
+      return;
+    }
+    if (got != bus->token) {
+      ::close(fd);
+      return;
+    }
+  } else {
+    char magic[4];
+    ssize_t n = ::recv(fd, magic, 4, MSG_PEEK | MSG_WAITALL);
+    if (n == 4 && std::memcmp(magic, "PTB1", 4) == 0) {
+      std::fprintf(stderr,
+                   "[message_bus] rank %d: peer presented an auth token but "
+                   "this bus has none (PADDLE_BUS_TOKEN mismatch between "
+                   "ranks); closing link\n", bus->rank);
+      ::close(fd);
+      return;
+    }
+  }
   while (!bus->stop.load()) {
     char hdr[24];
     if (!read_full(fd, hdr, sizeof(hdr))) break;
@@ -151,7 +198,14 @@ void* bus_create(int rank) {
   return bus;
 }
 
-int bus_listen(void* h, int port) {
+void bus_set_token(void* h, const char* tok, int len) {
+  auto* bus = static_cast<Bus*>(h);
+  bus->token.assign(tok, tok + (len > 0 ? len : 0));
+}
+
+// ip == nullptr/"" binds all interfaces (legacy default); pass a concrete
+// address (PADDLE_BIND_IP) to keep the bus off untrusted networks.
+int bus_listen_ip(void* h, const char* ip, int port) {
   auto* bus = static_cast<Bus*>(h);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
@@ -159,7 +213,12 @@ int bus_listen(void* h, int port) {
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (ip == nullptr || ip[0] == '\0') {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (::inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       ::listen(fd, 64) != 0) {
@@ -183,6 +242,8 @@ int bus_listen(void* h, int port) {
   return ntohs(addr.sin_port);
 }
 
+int bus_listen(void* h, int port) { return bus_listen_ip(h, nullptr, port); }
+
 int bus_connect(void* h, int rank, const char* host, int port) {
   auto* bus = static_cast<Bus*>(h);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -199,6 +260,14 @@ int bus_connect(void* h, int rank, const char* host, int port) {
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      if (!bus->token.empty()) {  // present the shared job token first
+        int32_t tlen = static_cast<int32_t>(bus->token.size());
+        if (!write_full(fd, "PTB1", 4) || !write_full(fd, &tlen, 4) ||
+            !write_full(fd, bus->token.data(), bus->token.size())) {
+          ::close(fd);
+          return -1;
+        }
+      }
       auto peer = std::make_unique<Peer>();
       peer->fd = fd;
       std::lock_guard<std::mutex> g(bus->mu);

@@ -68,18 +68,27 @@ class _Cifar:
 
 
 class _UciHousing:
-    """Legacy semantics (reference dataset/uci_housing.py): features
-    max-normalized over the WHOLE file, first 80% of rows = train, rest =
-    test."""
+    """Legacy semantics (reference dataset/uci_housing.py:80-98 load_data):
+    per-feature (x - avg) / (max - min) computed over the WHOLE file, first
+    80% of rows = train, rest = test. The price column is left unscaled."""
 
-    @staticmethod
-    def _rows(kwargs):
-        from ..text import UCIHousing
-        ds = UCIHousing(mode="train", **kwargs)
-        feats = np.stack([ds[i][0] for i in range(len(ds))])
-        prices = np.stack([ds[i][1] for i in range(len(ds))])
-        scale = np.maximum(np.abs(feats).max(axis=0), 1e-12)
-        return feats / scale, prices
+    # loaded data cached per kwargs — the reference caches module-globally
+    # (UCI_TRAIN_DATA/UCI_TEST_DATA) so per-epoch reader() calls don't
+    # re-parse and re-normalize the file
+    _cache: dict = {}
+
+    @classmethod
+    def _rows(cls, kwargs):
+        key = tuple(sorted(kwargs.items()))
+        if key not in cls._cache:
+            from ..text import UCIHousing
+            ds = UCIHousing(mode="train", **kwargs)
+            feats = np.stack([ds[i][0] for i in range(len(ds))]).astype(np.float64)
+            prices = np.stack([ds[i][1] for i in range(len(ds))])
+            span = feats.max(axis=0) - feats.min(axis=0)
+            span = np.where(span == 0, 1.0, span)
+            cls._cache[key] = ((feats - feats.mean(axis=0)) / span, prices)
+        return cls._cache[key]
 
     def _reader(self, mode, kwargs) -> Callable:
         def reader():

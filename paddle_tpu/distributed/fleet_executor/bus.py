@@ -7,6 +7,7 @@ in-process condvar mailboxes and length-prefixed TCP frames across ranks.
 from __future__ import annotations
 
 import ctypes
+import os
 import socket
 from typing import Optional, Tuple
 
@@ -24,6 +25,11 @@ def _lib():
     lib.bus_create.argtypes = [ctypes.c_int]
     lib.bus_listen.restype = ctypes.c_int
     lib.bus_listen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bus_listen_ip.restype = ctypes.c_int
+    lib.bus_listen_ip.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+    lib.bus_set_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
     lib.bus_connect.restype = ctypes.c_int
     lib.bus_connect.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                 ctypes.c_char_p, ctypes.c_int]
@@ -43,18 +49,30 @@ def _lib():
 
 
 class MessageBus:
-    """Per-rank bus: local mailboxes + TCP links to peer ranks."""
+    """Per-rank bus: local mailboxes + TCP links to peer ranks.
+
+    Trust model (same as the reference's brpc message_bus): frames carry
+    pickled payloads, so the bus must only be reachable by job peers.
+    `PADDLE_BIND_IP` restricts the listener to one interface and
+    `PADDLE_BUS_TOKEN` (set for every rank by the launcher) gates inbound
+    connections on a shared token before any frame is parsed.
+    """
 
     def __init__(self, rank: int = 0):
         self._lib = _lib()
         self._h = self._lib.bus_create(rank)
         self.rank = rank
         self.port: Optional[int] = None
+        tok = os.environ.get("PADDLE_BUS_TOKEN", "")
+        if tok:
+            self._lib.bus_set_token(self._h, tok.encode(), len(tok.encode()))
 
-    def listen(self, port: int = 0) -> int:
-        p = self._lib.bus_listen(self._h, port)
+    def listen(self, port: int = 0, ip: Optional[str] = None) -> int:
+        ip = ip if ip is not None else os.environ.get("PADDLE_BIND_IP", "")
+        p = self._lib.bus_listen_ip(self._h, ip.encode() if ip else None, port)
         if p < 0:
-            raise RuntimeError(f"message bus failed to listen on port {port}")
+            raise RuntimeError(f"message bus failed to listen on "
+                               f"{ip or '0.0.0.0'}:{port}")
         self.port = p
         return p
 

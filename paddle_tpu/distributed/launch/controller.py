@@ -8,6 +8,7 @@ the reference's `--max_restart` elastic knob at level 0/1.
 from __future__ import annotations
 
 import os
+import secrets
 import signal
 import socket
 import subprocess
@@ -56,6 +57,7 @@ class PodController:
         self.procs: List[subprocess.Popen] = []
         self.logs: List[Optional[object]] = []
         self._master: Optional[Master] = None
+        self._token: str = ""
 
     # ------------------------------------------------------------- rendezvous
 
@@ -96,6 +98,35 @@ class PodController:
         rank, peers = self._master.sync_peers(my_ep, ctx.node_rank)
         return rank, f"{host}:{int(port) + 1}"
 
+    def _bus_token(self, node_rank: int) -> str:
+        """A per-job random secret gating the native message bus (see
+        core/native/message_bus.cpp security note).
+
+        Single node: generated here, never leaves this process tree. Multi
+        node: node 0 generates and publishes it through the rendezvous KV —
+        bootstrap-trust, the same model as NCCL-id exchange through a store
+        in the reference; export PADDLE_BUS_TOKEN on every node for a fully
+        out-of-band secret."""
+        if "PADDLE_BUS_TOKEN" in os.environ:
+            return os.environ["PADDLE_BUS_TOKEN"]
+        if self.ctx.nnodes <= 1 or self._master is None:
+            return secrets.token_hex(32)
+        key = f"/{self.ctx.job_id}/bus_token"
+        client = self._master._client
+        if node_rank == 0:
+            tok = secrets.token_hex(32)
+            if not client.put(key, tok):
+                raise RuntimeError("failed to publish bus token to master")
+            return tok
+        deadline = time.time() + 300
+        while True:
+            tok = client.get(key)
+            if tok:
+                return tok
+            if time.time() > deadline:
+                raise TimeoutError("bus token not published by node 0")
+            time.sleep(0.5)
+
     # ------------------------------------------------------------------ build
 
     def _build_env(self, node_rank: int, local_rank: int,
@@ -114,6 +145,9 @@ class PodController:
             "PADDLE_NNODES": str(ctx.nnodes),
             "PADDLE_NODE_RANK": str(node_rank),
             "PADDLE_JOB_ID": ctx.job_id,
+            # per-job message-bus auth secret (rpc/fleet_executor frames
+            # carry pickles); generated/shared once per job in _bus_token
+            "PADDLE_BUS_TOKEN": self._token,
         })
         if ctx.devices is not None:
             devices = ctx.devices.split(",")
@@ -175,6 +209,7 @@ class PodController:
         finishes when every trainer exits; servers are then torn down
         (reference launch/controllers/ps.py semantics)."""
         ctx = self.ctx
+        self._token = self._bus_token(0)
         n_srv = ctx.server_num or 1
         n_trn = ctx.trainer_num or 1
         if ctx.nnodes > 1:
@@ -191,7 +226,8 @@ class PodController:
             env.update(ctx.envs)
             env.update({"PADDLE_ROLE": role, "PADDLE_JOB_ID": ctx.job_id,
                         "PADDLE_PSERVERS_IP_PORT_LIST": ep_list,
-                        "PADDLE_TRAINERS_NUM": str(n_trn)})
+                        "PADDLE_TRAINERS_NUM": str(n_trn),
+                        "PADDLE_BUS_TOKEN": self._token})
             env.update(extra)
             log = None
             if ctx.log_dir:
@@ -235,6 +271,7 @@ class PodController:
             raise ValueError("--max_restart is only supported for single-node "
                              "jobs (nnodes == 1)")
         node_rank, coordinator = self._rendezvous()
+        self._token = self._bus_token(node_rank)
         restarts = 0
         try:
             while True:

@@ -11,6 +11,13 @@ TPU-native shape: transport is the native actor message bus
 instead of brpc; the bootstrap store is the native TCPStore. Each worker runs
 a server thread that executes incoming calls on a small thread pool, so a
 worker can serve requests while it issues its own.
+
+SECURITY: payloads are pickled callables — executing them is the point of
+RPC, which means anyone who can connect to the bus port can run code, the
+same trust model as the reference's brpc agent. Deploy only on a trusted
+cluster network. Mitigations: set PADDLE_BIND_IP to keep the listener off
+public interfaces, and PADDLE_BUS_TOKEN (the launcher sets one per job) so
+unauthenticated connections are dropped before a single frame is unpickled.
 """
 from __future__ import annotations
 

@@ -122,9 +122,13 @@ static int names_into(const char* fn, long pid, char* buf, int cap) {
   int need = -1;
   PyObject* r = PyObject_CallMethod(g_bridge, fn, "l", pid);
   if (r) {
-    const char* s = PyUnicode_AsUTF8(r);
-    need = static_cast<int>(std::strlen(s));
-    if (buf && cap > need) std::memcpy(buf, s, need + 1);
+    const char* s = PyUnicode_AsUTF8(r);  // null if r is not a str
+    if (s) {
+      need = static_cast<int>(std::strlen(s));
+      if (buf && cap > need) std::memcpy(buf, s, need + 1);
+    } else {
+      PyErr_Clear();
+    }
     Py_DECREF(r);
   } else {
     PyErr_Print();
@@ -244,9 +248,13 @@ int PD_PredictorGetOutputDtype(PD_Predictor* p, int idx, char* buf, int cap) {
   PyObject* r = PyObject_CallMethod(g_bridge, "get_output_dtype", "li",
                                     p->pid, idx);
   if (r) {
-    const char* s = PyUnicode_AsUTF8(r);
-    need = static_cast<int>(std::strlen(s));
-    if (buf && cap > need) std::memcpy(buf, s, need + 1);
+    const char* s = PyUnicode_AsUTF8(r);  // null if r is not a str
+    if (s) {
+      need = static_cast<int>(std::strlen(s));
+      if (buf && cap > need) std::memcpy(buf, s, need + 1);
+    } else {
+      PyErr_Clear();
+    }
     Py_DECREF(r);
   }
   PyGILState_Release(gil);

@@ -54,7 +54,13 @@ def get_output_names(pid: int) -> str:
 
 def set_input(pid: int, name: str, data: bytes, shape: tuple,
               dtype: str) -> None:
-    arr = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(shape)
+    if dtype == "bfloat16":
+        # numpy has no native bfloat16; ml_dtypes (a jax dep) registers one
+        import ml_dtypes
+        np_dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        np_dtype = np.dtype(dtype)
+    arr = np.frombuffer(data, dtype=np_dtype).reshape(shape)
     _INPUTS[pid][name] = arr
 
 

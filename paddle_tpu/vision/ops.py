@@ -168,6 +168,16 @@ register_op("roi_align", _roi_align_fwd, nondiff_inputs=(1, 2))
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference python/paddle/vision/ops.py roi_align).
+
+    Deviation from the reference for ``sampling_ratio=-1``: the reference
+    kernel adaptively uses ``ceil(roi_size / bin)`` bilinear samples per
+    output bin, a data-dependent count XLA cannot compile statically. This
+    implementation uses a fixed 2x2 sample grid instead — identical to the
+    reference whenever each output bin covers at most ~2 input pixels (the
+    common detector configuration), slightly smoother for very large RoIs.
+    Pass an explicit ``sampling_ratio`` to match the reference exactly.
+    """
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     return _op("roi_align", x, boxes, boxes_num,

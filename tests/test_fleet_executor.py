@@ -41,6 +41,40 @@ def test_message_bus_large_payload_regrow():
     bus.close()
 
 
+def test_message_bus_token_gates_unauthenticated_peers(monkeypatch):
+    """Advisor finding: the pickle-carrying bus listened unauthenticated.
+    With PADDLE_BUS_TOKEN set, a peer without the token is dropped before any
+    frame is parsed; a peer presenting the token delivers normally."""
+    monkeypatch.setenv("PADDLE_BUS_TOKEN", "sekrit")
+    server = MessageBus(rank=0)
+    server.open_mailbox(5)
+    port = server.listen(0, ip="127.0.0.1")
+
+    monkeypatch.delenv("PADDLE_BUS_TOKEN")
+    intruder = MessageBus(rank=1)  # no token
+    intruder.route(5, 0)
+    intruder.connect(0, "127.0.0.1", port)
+    try:
+        # the server closes the link at the failed handshake; depending on
+        # timing the write either hits the closed socket (raises) or lands
+        # and is discarded unparsed — both keep the payload out
+        intruder.send(src=9, dst=5, msg_type=DATA_IS_READY, payload=b"evil")
+    except RuntimeError:
+        pass
+    assert server.recv(5, timeout_ms=400) is None  # dropped at handshake
+
+    monkeypatch.setenv("PADDLE_BUS_TOKEN", "sekrit")
+    friend = MessageBus(rank=2)
+    friend.route(5, 0)
+    friend.connect(0, "127.0.0.1", port)
+    friend.send(src=9, dst=5, msg_type=DATA_IS_READY, payload=b"ok")
+    src, typ, payload = server.recv(5, timeout_ms=2000)
+    assert (src, typ, payload) == (9, DATA_IS_READY, b"ok")
+    intruder.close()
+    friend.close()
+    server.close()
+
+
 def test_compute_chain_orders_microbatches():
     """source -> stage0 -> stage1 -> sink, 6 micro-batches, buffer 1:
     results arrive complete and in order despite the tiny buffers."""

@@ -156,6 +156,31 @@ def test_flash_attention_pallas_ragged_lengths():
                                        atol=2e-2)
 
 
+def test_flash_attention_pallas_d128_bf16_scale_tolerance():
+    """d=128 makes sm_scale 1/sqrt(128) — NOT a power of two, so folding the
+    scale into a bf16 q tile adds a rounding step (advisor finding). Bound
+    that error against the fp32 reference at bf16-appropriate tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.pallas import flash_attention as fa
+
+    b, l, h, d = 1, 256, 2, 128
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, l, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, l, h, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, l, h, d), jnp.bfloat16)
+    r = lambda t: jnp.swapaxes(t.astype(jnp.float32), 1, 2).reshape(b * h, l, d)
+    for causal in (False, True):
+        out = fa.flash_attention_blhd(q, k, v, causal=causal, interpret=True)
+        ref = fa._reference_attention(r(q), r(k), r(v), causal,
+                                      1.0 / np.sqrt(d))
+        ref = jnp.swapaxes(ref.reshape(b, h, l, d), 1, 2)
+        # bf16 has ~3 decimal digits; 2e-2 abs catches a wrong/missing scale
+        # (which shows up as ~1e-1+) while tolerating quantization noise
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), atol=2e-2, rtol=2e-2)
+
+
 def test_attention_dropout_active_in_training():
     """Regression: sdpa dropout_p was silently ignored (code-review finding)."""
     import paddle_tpu.nn.functional as F
