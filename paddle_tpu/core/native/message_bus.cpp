@@ -14,6 +14,8 @@
 // C ABI (ctypes-bound from paddle_tpu/distributed/fleet_executor/bus.py):
 //   bus_create(rank) -> handle
 //   bus_set_token(bus, token, len)            optional shared auth token
+//     (every connection opens with a "PTB0"/"PTB1"+token preamble; token
+//      presence must match on both sides or the link closes loudly)
 //   bus_listen(bus, port) -> bound port (0 = ephemeral, all interfaces)
 //   bus_listen_ip(bus, ip, port)              bind one interface
 //   bus_connect(bus, rank, host, port) -> 0/-1
@@ -134,18 +136,19 @@ void deliver_local(Bus* bus, int64_t src, int64_t dst, int32_t type,
 }
 
 void reader_loop(Bus* bus, int fd) {
-  // Auth handshake: when the bus has a token, the very first bytes on an
-  // inbound link must be "PTB1" + [i32 len] + token. Anything else closes
-  // the socket before a single frame is parsed — unauthenticated peers
-  // cannot reach the pickle layer above. A tokenless server still peeks for
-  // the magic so a token-presence mismatch between peers fails loudly
-  // instead of mis-parsing the handshake as a frame header and hanging the
-  // job silently.
-  if (!bus->token.empty()) {
-    char magic[4];
+  // Mandatory connection preamble — every connector sends "PTB0" (no token)
+  // or "PTB1"+[i32 len]+token before any frame, so the handshake can never
+  // be confused with a frame header. A token mismatch in either direction
+  // closes the link LOUDLY; garbage (a non-bus client) closes it before a
+  // single frame reaches the pickle layer above.
+  char magic[4];
+  if (!read_full(fd, magic, 4)) {
+    ::close(fd);
+    return;
+  }
+  if (std::memcmp(magic, "PTB1", 4) == 0) {
     int32_t tlen;
-    if (!read_full(fd, magic, 4) || std::memcmp(magic, "PTB1", 4) != 0 ||
-        !read_full(fd, &tlen, 4) || tlen < 0 || tlen > 4096) {
+    if (!read_full(fd, &tlen, 4) || tlen < 0 || tlen > 4096) {
       ::close(fd);
       return;
     }
@@ -155,20 +158,26 @@ void reader_loop(Bus* bus, int fd) {
       return;
     }
     if (got != bus->token) {
+      if (bus->token.empty())
+        std::fprintf(stderr,
+                     "[message_bus] rank %d: peer presented an auth token but "
+                     "this bus has none (PADDLE_BUS_TOKEN mismatch between "
+                     "ranks); closing link\n", bus->rank);
+      ::close(fd);
+      return;
+    }
+  } else if (std::memcmp(magic, "PTB0", 4) == 0) {
+    if (!bus->token.empty()) {
+      std::fprintf(stderr,
+                   "[message_bus] rank %d: tokenless peer rejected "
+                   "(PADDLE_BUS_TOKEN is set here but not on the peer); "
+                   "closing link\n", bus->rank);
       ::close(fd);
       return;
     }
   } else {
-    char magic[4];
-    ssize_t n = ::recv(fd, magic, 4, MSG_PEEK | MSG_WAITALL);
-    if (n == 4 && std::memcmp(magic, "PTB1", 4) == 0) {
-      std::fprintf(stderr,
-                   "[message_bus] rank %d: peer presented an auth token but "
-                   "this bus has none (PADDLE_BUS_TOKEN mismatch between "
-                   "ranks); closing link\n", bus->rank);
-      ::close(fd);
-      return;
-    }
+    ::close(fd);  // not a bus peer
+    return;
   }
   while (!bus->stop.load()) {
     char hdr[24];
@@ -260,13 +269,18 @@ int bus_connect(void* h, int rank, const char* host, int port) {
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      if (!bus->token.empty()) {  // present the shared job token first
+      // mandatory preamble: identifies a bus peer and carries the token
+      bool ok;
+      if (!bus->token.empty()) {
         int32_t tlen = static_cast<int32_t>(bus->token.size());
-        if (!write_full(fd, "PTB1", 4) || !write_full(fd, &tlen, 4) ||
-            !write_full(fd, bus->token.data(), bus->token.size())) {
-          ::close(fd);
-          return -1;
-        }
+        ok = write_full(fd, "PTB1", 4) && write_full(fd, &tlen, 4) &&
+             write_full(fd, bus->token.data(), bus->token.size());
+      } else {
+        ok = write_full(fd, "PTB0", 4);
+      }
+      if (!ok) {
+        ::close(fd);
+        return -1;
       }
       auto peer = std::make_unique<Peer>();
       peer->fd = fd;

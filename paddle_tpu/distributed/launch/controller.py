@@ -107,7 +107,9 @@ class PodController:
         bootstrap-trust, the same model as NCCL-id exchange through a store
         in the reference; export PADDLE_BUS_TOKEN on every node for a fully
         out-of-band secret."""
-        if "PADDLE_BUS_TOKEN" in os.environ:
+        # empty counts as unset: a blank env default must not silently
+        # disable auth for the whole job
+        if os.environ.get("PADDLE_BUS_TOKEN"):
             return os.environ["PADDLE_BUS_TOKEN"]
         if self.ctx.nnodes <= 1 or self._master is None:
             return secrets.token_hex(32)

@@ -130,8 +130,18 @@ class DataLoader:
                  use_buffer_reader: bool = True, prefetch_factor: int = 2,
                  use_shared_memory: bool = True, timeout: int = 0,
                  worker_init_fn=None, persistent_workers: bool = False,
-                 use_process_workers: bool = False):
+                 use_process_workers: bool = False,
+                 bucket_boundaries=None):
         self.dataset = dataset
+        if bucket_boundaries is not None:
+            # variable-length policy: pad each batch to a bucket boundary so
+            # downstream jit/TrainStep compiles a bounded executable set
+            # (see io/bucketing.py for the full contract)
+            if collate_fn is not None:
+                raise ValueError("pass either collate_fn or bucket_boundaries "
+                                 "(wrap BucketingCollate yourself to combine)")
+            from .bucketing import BucketingCollate
+            collate_fn = BucketingCollate(boundaries=bucket_boundaries)
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor

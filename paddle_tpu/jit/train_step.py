@@ -198,6 +198,17 @@ class TrainStep:
         donate = (0, 1, 2, 3) if self._donate else ()
         self._compiled = jax.jit(step_fn, donate_argnums=donate)
 
+    @property
+    def num_compiles(self) -> int:
+        """Distinct executables compiled so far (one per input-shape bucket).
+
+        The bucketing contract (io/bucketing.py) promises a workload compiles
+        at most len(boundaries) of them; this is the observable that tests and
+        capacity planning assert against."""
+        if self._compiled is None:
+            return 0
+        return self._compiled._cache_size()
+
     # ------------------------------------------------------------------ call
 
     def __call__(self, *inputs):

@@ -1,0 +1,168 @@
+"""Dynamic-shape policy tests: bucketing + padding + masking.
+
+Reference bar: the LoD/variable-length world (phi/core/dense_tensor.h:38 LoD,
+fluid/operators/sequence_ops/, DataLoader per-batch padding). The TPU-native
+contract (paddle_tpu/io/bucketing.py): pad right to bucket boundaries, mask
+pad labels with ignore_index, and the jit/TrainStep shape-keyed cache bounds
+the executable count at len(boundaries).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BucketingCollate, DataLoader, Dataset,
+                           LengthGroupedBatchSampler, bucket_length,
+                           pad_to_bucket, padding_attn_mask)
+
+
+def test_bucket_length_and_pad():
+    bounds = (16, 32, 64)
+    assert bucket_length(1, bounds) == 16
+    assert bucket_length(16, bounds) == 16
+    assert bucket_length(17, bounds) == 32
+    assert bucket_length(64, bounds) == 64
+    with pytest.raises(ValueError):
+        bucket_length(65, bounds)
+
+    arr, lengths = pad_to_bucket([[1, 2, 3], [4, 5]], bounds, pad_value=9)
+    assert arr.shape == (2, 16)
+    assert lengths.tolist() == [3, 2]
+    assert arr[0, :3].tolist() == [1, 2, 3] and arr[0, 3:].tolist() == [9] * 13
+    assert arr[1, :2].tolist() == [4, 5]
+
+
+class _VarLenLM(Dataset):
+    """(ids, labels) pairs of random lengths in [lo, hi]."""
+
+    def __init__(self, n, lo=5, hi=60, vocab=50, seed=0):
+        rng = np.random.RandomState(seed)
+        self.seqs = [rng.randint(1, vocab, rng.randint(lo, hi + 1))
+                     .astype(np.int32) for _ in range(n)]
+
+    def __len__(self):
+        return len(self.seqs)
+
+    def __getitem__(self, i):
+        return self.seqs[i], self.seqs[i].astype(np.int64)
+
+
+def test_dataloader_bucket_boundaries():
+    ds = _VarLenLM(40, lo=5, hi=60)
+    loader = DataLoader(ds, batch_size=8, bucket_boundaries=(16, 32, 64))
+    seen_shapes = set()
+    n_rows = 0
+    for ids, labels, lengths in loader:
+        assert ids.shape == labels.shape
+        assert ids.shape[1] in (16, 32, 64)
+        seen_shapes.add(ids.shape[1])
+        ln = lengths.numpy()
+        n_rows += len(ln)
+        ids_np, lab_np = ids.numpy(), labels.numpy()
+        for r in range(len(ln)):
+            assert (lab_np[r, ln[r]:] == -100).all()   # labels masked at pads
+            assert (ids_np[r, ln[r]:] == 0).all()      # ids padded with 0
+            assert ids_np[r, ln[r] - 1] != 0           # right-padded, not left
+    assert n_rows == 40
+    assert seen_shapes <= {16, 32, 64}
+    # collate_fn + bucket_boundaries together is ambiguous -> error
+    with pytest.raises(ValueError):
+        DataLoader(ds, batch_size=8, bucket_boundaries=(16,),
+                   collate_fn=lambda b: b)
+
+
+def test_trainstep_50_lengths_compile_at_most_4_executables():
+    """THE contract test: 50 distinct sequence lengths, <= 4 executables."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=128, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt)
+
+    bounds = (32, 64, 96, 128)
+    rng = np.random.RandomState(0)
+    lengths = rng.permutation(np.arange(5, 129))[:50]  # 50 distinct lengths
+    assert len(set(lengths)) == 50
+    for L in lengths:
+        seqs = [rng.randint(1, 64, L).astype(np.int32) for _ in range(2)]
+        ids, _ = pad_to_bucket(seqs, bounds, pad_value=0)
+        labels, _ = pad_to_bucket(seqs, bounds, pad_value=-100)
+        loss = step(paddle.to_tensor(ids),
+                    paddle.to_tensor(labels.astype(np.int64)))
+        assert np.isfinite(float(loss))
+    assert step.num_compiles <= 4, step.num_compiles
+
+
+def test_padded_causal_lm_loss_matches_unpadded():
+    """Right padding + causal attention + ignore_index == exact numerics."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()  # no dropout; params fixed
+
+    rng = np.random.RandomState(3)
+    seqs = [rng.randint(1, 64, L).astype(np.int32) for L in (7, 19, 33)]
+
+    # padded batch loss
+    ids, _ = pad_to_bucket(seqs, (64,), pad_value=0)
+    labels, _ = pad_to_bucket(seqs, (64,), pad_value=-100)
+    _, loss_padded = model(paddle.to_tensor(ids),
+                           labels=paddle.to_tensor(labels.astype(np.int64)))
+
+    # unpadded per-sequence losses, token-weighted mean
+    tot, n = 0.0, 0
+    for s in seqs:
+        t = paddle.to_tensor(s[None, :])
+        _, li = model(t, labels=paddle.to_tensor(s[None, :].astype(np.int64)))
+        tot += float(li) * (len(s) - 1)
+        n += len(s) - 1
+    np.testing.assert_allclose(float(loss_padded), tot / n, rtol=2e-5)
+
+
+def test_padding_attn_mask_hides_pad_keys():
+    """Bidirectional attention with the mask == unpadded attention, at the
+    real query positions."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    L, lens = 16, [9, 16]
+    x = rng.randn(2, L, 2, 8).astype("float32")
+    for r, ln in enumerate(lens):
+        x[r, ln:] = 7.7  # poison the pad region: mask must hide it
+
+    q = paddle.to_tensor(x)
+    mask = padding_attn_mask(np.asarray(lens), L)
+    out = F.scaled_dot_product_attention(q, q, q, attn_mask=mask).numpy()
+    for r, ln in enumerate(lens):
+        xu = paddle.to_tensor(x[r:r + 1, :ln])
+        ref = F.scaled_dot_product_attention(xu, xu, xu).numpy()
+        np.testing.assert_allclose(out[r, :ln], ref[0], atol=1e-5)
+
+
+def test_length_grouped_batch_sampler():
+    lengths = np.random.RandomState(0).randint(1, 100, 103)
+    s = LengthGroupedBatchSampler(lengths, batch_size=8, shuffle=True,
+                                  window_mult=4, seed=0)
+    batches = list(s)
+    flat = sorted(i for b in batches for i in b)
+    assert flat == list(range(103))           # exact cover
+    assert len(batches) == len(s)
+    # grouping actually reduces padding waste vs random batching
+    def waste(batches):
+        return sum(len(b) * max(lengths[i] for i in b) - sum(lengths[i] for i in b)
+                   for b in batches)
+    rng = np.random.RandomState(1)
+    order = rng.permutation(103)
+    random_batches = [order[i:i + 8].tolist() for i in range(0, 103, 8)]
+    assert waste(batches) < waste(random_batches)
+
+    with pytest.raises(TypeError):
+        LengthGroupedBatchSampler(lambda i: 3, batch_size=8)
