@@ -80,6 +80,7 @@ from . import version  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
 from . import dataset  # noqa: F401,E402
+from . import strings  # noqa: F401,E402
 from . import _C_ops  # noqa: F401,E402
 DataParallel = distributed.DataParallel
 

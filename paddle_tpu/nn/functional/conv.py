@@ -95,13 +95,22 @@ def _conv_transpose_fwd(x, w, *rest, strides=(), padding="VALID", output_padding
                         has_bias=False):
     spatial = "".join("DHW"[3 - n_spatial:][i] for i in range(n_spatial))
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
-    rhs_spec = "IO" + spatial  # paddle conv_transpose weight: [in, out/groups, *k]
+    # paddle conv_transpose weight layout: [in, out/groups, *k]. With
+    # transpose_kernel=True lax SWAPS the spec's I/O (it describes the
+    # forward-conv kernel whose gradient this is), so the transpose-op's
+    # input-channel dim must be labeled "O" here.
+    rhs_spec = "OI" + spatial
     if not isinstance(padding, str):
-        # paddle semantics: out = (in-1)*s - 2p + k  ⇒  lax padding = eff_k - 1 - p
+        # paddle semantics: out = (in-1)*s - 2p + k + output_padding
+        # ⇒ lax padding = eff_k - 1 - p, with output_padding added on the
+        # HIGH side (torch/paddle compute those positions — they are part of
+        # the gradient stencil, NOT zero fill)
         ksp = w.shape[2:]
+        opad = output_padding or (0,) * len(ksp)
         padding = tuple(
-            ((k - 1) * d + 1 - 1 - lo, (k - 1) * d + 1 - 1 - hi)
-            for k, d, (lo, hi) in zip(ksp, dilations, padding))
+            ((k - 1) * d - lo, (k - 1) * d - hi + op)
+            for k, d, (lo, hi), op in zip(ksp, dilations, padding, opad))
+        output_padding = ()  # consumed here
     if groups != 1:
         # grouped transpose conv: split and concat along channels
         xs = jnp.split(x, groups, axis=1 if not channel_last else -1)

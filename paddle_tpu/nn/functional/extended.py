@@ -161,13 +161,16 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False, name=None):
     """Hierarchical sigmoid with the DEFAULT complete binary tree (the
-    reference's non-custom-tree mode)."""
-    iv = input.value() if isinstance(input, Tensor) else jnp.asarray(input)
-    lv = (label.value() if isinstance(label, Tensor)
-          else jnp.asarray(label)).reshape(-1).astype(jnp.int32)
-    wv = weight.value() if isinstance(weight, Tensor) else jnp.asarray(weight)
-    bv = bias.value() if (bias is not None and isinstance(bias, Tensor)) \
-        else (jnp.asarray(bias) if bias is not None else None)
+    reference's non-custom-tree mode). Dispatch op: grads flow to input,
+    weight and bias."""
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return _op("hsigmoid_loss", *args, num_classes=int(num_classes),
+               has_bias=bias is not None)
+
+
+def _hsigmoid_loss_fwd(iv, lv, wv, *rest, num_classes=2, has_bias=False):
+    lv = lv.reshape(-1).astype(jnp.int32)
+    bv = rest[0] if has_bias else None
     # complete binary heap: leaves live at [num_classes, 2*num_classes);
     # internal nodes 1..num_classes-1 map to weight rows 0..num_classes-2
     code_len = int(math.ceil(math.log2(max(num_classes, 2))))
@@ -185,7 +188,10 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         losses.append(valid * (jnp.maximum(logits, 0) - logits * bit
                                + jnp.log1p(jnp.exp(-jnp.abs(logits)))))
         node = parent
-    return Tensor(jnp.sum(jnp.stack(losses), axis=0).mean())
+    return jnp.sum(jnp.stack(losses), axis=0).mean()
+
+
+register_op("hsigmoid_loss", _hsigmoid_loss_fwd, nondiff_inputs=(1,))
 
 
 def _npair_fwd(a, p, lv, *, l2_reg=0.002):
@@ -407,8 +413,15 @@ def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
 # --------------------------------------------------------- spatial sampling
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):
-    tv = theta.value() if isinstance(theta, Tensor) else jnp.asarray(theta)
+    # dispatch op: theta is differentiable in the reference (STN training)
     n, _, h, w = [int(s) for s in out_shape]
+    return _op("affine_grid", theta, out_hw=(h, w),
+               align_corners=bool(align_corners))
+
+
+def _affine_grid_fwd(tv, out_hw=(1, 1), align_corners=True):
+    h, w = out_hw
+    n = tv.shape[0]
 
     def axis_coords(size):
         if align_corners:
@@ -422,13 +435,23 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     ones = jnp.ones_like(gx)
     base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)   # [H*W, 3]
     grid = jnp.einsum("nij,pj->npi", tv, base)                 # [N, H*W, 2]
-    return Tensor(grid.reshape(n, h, w, 2))
+    return grid.reshape(n, h, w, 2)
+
+
+register_op("affine_grid", _affine_grid_fwd)
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
-    xv = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
-    gv = grid.value() if isinstance(grid, Tensor) else jnp.asarray(grid)
+    # dispatch op: gradients flow to BOTH x and grid (reference grid_sample
+    # has grads for both; a tape bypass here silently froze them)
+    return _op("grid_sample", x, grid, mode=str(mode),
+               padding_mode=str(padding_mode),
+               align_corners=bool(align_corners))
+
+
+def _grid_sample_fwd(xv, gv, mode="bilinear", padding_mode="zeros",
+                     align_corners=True):
     n, c, h, w = xv.shape
 
     def unnormalize(coord, size):
@@ -467,8 +490,10 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 + fetch(y0 + 1, x0) * ((1 - fx) * fy)[None]
                 + fetch(y0 + 1, x0 + 1) * (fx * fy)[None])
 
-    out = jax.vmap(sample_one)(xv, px, py)
-    return Tensor(out)
+    return jax.vmap(sample_one)(xv, px, py)
+
+
+register_op("grid_sample", _grid_sample_fwd)
 
 
 # ------------------------------------------------------------- misc utilities
@@ -494,7 +519,12 @@ def gather_tree(ids, parents):
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                    name=None):
-    xv = x.value() if isinstance(x, Tensor) else jnp.asarray(x)
+    # dispatch op (was a tape bypass: gradients silently froze)
+    return _op("temporal_shift", x, seg_num=int(seg_num),
+               shift_ratio=float(shift_ratio))
+
+
+def _temporal_shift_fwd(xv, seg_num=1, shift_ratio=0.25):
     nt, c, h, w = xv.shape
     n = nt // seg_num
     v = xv.reshape(n, seg_num, c, h, w)
@@ -504,8 +534,10 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
     right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
                              v[:, :-1, fold:2 * fold]], axis=1)
     rest = v[:, :, 2 * fold:]
-    return Tensor(jnp.concatenate([left, right, rest], axis=2)
-                  .reshape(nt, c, h, w))
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+register_op("temporal_shift", _temporal_shift_fwd)
 
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
@@ -530,9 +562,17 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
             for r in range(L):
                 lo, hi = off[b, hh, r], off[b, hh, r + 1]
                 mask_np[b, hh, r, cols[b, hh, lo:hi]] = 0.0
-    logits = jnp.einsum("bhld,bhkd->bhlk", qv, kv) / math.sqrt(D)
-    probs = jax.nn.softmax(logits + jnp.asarray(mask_np), axis=-1)
-    return Tensor(jnp.einsum("bhlk,bhkd->bhld", probs, vv))
+    # the dense masked attention runs as a dispatch op so q/k/v get grads
+    from .attention import scaled_dot_product_attention
+    q4 = query if isinstance(query, Tensor) else Tensor(qv)
+    k4 = key if isinstance(key, Tensor) else Tensor(kv)
+    v4 = value if isinstance(value, Tensor) else Tensor(vv)
+    # sdpa takes [B, L, H, D]
+    swap = lambda t: t.transpose([0, 2, 1, 3])
+    out = scaled_dot_product_attention(
+        swap(q4), swap(k4), swap(v4),
+        attn_mask=Tensor(mask_np[:, :, :, :]))
+    return swap(out)
 
 
 # ----------------------------------------------------------- inplace variants

@@ -124,8 +124,56 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return _pool(x, kernel_size, stride, padding, 2, "max", data_format, ceil_mode)
 
 
+def _max_pool3d_mask_fwd(x, *, kernel, strides, pads):
+    """3-D max pool + argmax into the flattened input volume (reference
+    max_pool3d_with_index kernel contract, consumed by max_unpool3d)."""
+    import jax.numpy as jnp
+    n, c, d, h, w = x.shape
+    kd, kh, kw = kernel
+    sd, sh, sw = strides
+    pd, ph, pw = pads
+    neg = jnp.finfo(jnp.float32).min
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    d2 = (d + 2 * pd - kd) // sd + 1
+    h2 = (h + 2 * ph - kh) // sh + 1
+    w2 = (w + 2 * pw - kw) // sw + 1
+    wd = jnp.arange(d2)[:, None] * sd + jnp.arange(kd)[None, :]   # [d2, kd]
+    wi = jnp.arange(h2)[:, None] * sh + jnp.arange(kh)[None, :]   # [h2, kh]
+    wj = jnp.arange(w2)[:, None] * sw + jnp.arange(kw)[None, :]   # [w2, kw]
+    win = xp[:, :, wd[:, None, None, :, None, None],
+             wi[None, :, None, None, :, None],
+             wj[None, None, :, None, None, :]]   # [n,c,d2,h2,w2,kd,kh,kw]
+    flat = win.reshape(n, c, d2, h2, w2, kd * kh * kw)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1).astype(x.dtype)
+    z = jnp.zeros((d2, h2, w2, kd, kh, kw), jnp.int32)
+    gd = wd[:, None, None, :, None, None] + z
+    gi = wi[None, :, None, None, :, None] + z
+    gj = wj[None, None, :, None, None, :] + z
+    gidx = (((gd - pd) * h + (gi - ph)) * w + (gj - pw)).reshape(
+        d2, h2, w2, kd * kh * kw)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(gidx, (n, c, d2, h2, w2, kd * kh * kw)),
+        arg[..., None], axis=-1)[..., 0]
+    return out, idx.astype(jnp.int32)
+
+
+register_op("max_pool3d_mask", _max_pool3d_mask_fwd)
+
+
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        assert data_format == "NCDHW" and not ceil_mode, \
+            "return_mask supports NCDHW, ceil_mode=False"
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        s = tuple(k) if stride is None else (
+            (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        return _op("max_pool3d_mask", x, kernel=k, strides=s, pads=p)
     return _pool(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode)
 
 

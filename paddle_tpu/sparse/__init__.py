@@ -20,7 +20,8 @@ from jax.experimental import sparse as jsparse
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "is_same_shape", "add", "matmul", "masked_matmul", "relu", "nn"]
+           "SparseCsrTensor", "is_same_shape", "add", "matmul",
+           "masked_matmul", "relu", "nn"]
 
 
 class SparseCooTensor:
@@ -74,6 +75,17 @@ class SparseCooTensor:
     def is_sparse_csr(self):
         return False
 
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        """COO -> CSR (reference sparse_ops.yaml to_sparse_csr). Same BCOO
+        storage, CSR surface (crows/cols materialized on demand)."""
+        assert len(self._bcoo.shape) == 2, "CSR is 2-D"
+        srt = self.coalesce()
+        return SparseCsrTensor(srt._bcoo)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None
+                      ) -> "SparseCooTensor":
+        return self
+
     def astype(self, dtype) -> "SparseCooTensor":
         from ..core.dtype import convert_dtype
         return SparseCooTensor(
@@ -83,6 +95,43 @@ class SparseCooTensor:
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
                 f"dtype={self.dtype})")
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR surface over the same BCOO storage (module docstring: the TPU has
+    no CSR-native kernel worth preserving; crows/cols are views)."""
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None
+                      ) -> SparseCooTensor:
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _tensor_to_sparse_coo(self, sparse_dim: Optional[int] = None
+                          ) -> SparseCooTensor:
+    """Dense Tensor -> COO (reference Tensor.to_sparse_coo / sparse_ops.yaml
+    to_sparse_coo). sparse_dim defaults to ndim (fully sparse)."""
+    arr = self.value()
+    nd = arr.ndim if sparse_dim is None else int(sparse_dim)
+    bcoo = jsparse.BCOO.fromdense(arr, n_batch=0, n_dense=arr.ndim - nd)
+    return SparseCooTensor(bcoo)
+
+
+def _tensor_to_sparse_csr(self) -> "SparseCsrTensor":
+    return _tensor_to_sparse_coo(self).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr
 
 
 def _dense_value(x):
@@ -115,7 +164,8 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
     crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
     cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
     rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    return sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype)
+    coo = sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype)
+    return SparseCsrTensor(coo._bcoo)
 
 
 def is_same_shape(x, y) -> bool:

@@ -134,4 +134,5 @@ class name_scope:
 
 from .compat import *  # noqa: F401,F403,E402
 from .compat import __all__ as _compat_all  # noqa: E402
-__all__ = list(__all__) + list(_compat_all)
+from . import nn  # noqa: F401,E402  (paddle.static.nn sequence ops)
+__all__ = list(__all__) + list(_compat_all) + ["nn"]

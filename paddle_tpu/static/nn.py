@@ -1,0 +1,131 @@
+"""paddle.static.nn — sequence ops over the bucketing contract.
+
+Reference analog: python/paddle/static/nn/sequence_lod.py (sequence_pad,
+sequence_unpad, sequence_pool, ...) operating on 1-level LoD tensors from
+`fluid/operators/sequence_ops/`.
+
+TPU-native shape: there is no LoD tensor — the variable-length contract is
+(padded dense tensor, lengths) from `paddle_tpu.io.bucketing`. `sequence_pad`
+therefore takes the ragged form (a list of [Li, K] tensors) and produces the
+dense pair; `sequence_unpad` inverts it; the pooled/masked ops consume the
+dense pair. Semantics (pad value broadcast, tail padding, length dtype)
+follow the reference ops.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import ops
+from ..nn.functional import sequence_mask  # noqa: F401  (reference name here)
+
+__all__ = ["sequence_pad", "sequence_unpad", "sequence_pool",
+           "sequence_concat", "sequence_mask", "sequence_reverse"]
+
+
+def _np(t):
+    return t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+
+
+def sequence_pad(x, pad_value, maxlen: Optional[int] = None, name=None):
+    """Pad a batch of ragged sequences to a common length (reference
+    static/nn/sequence_lod.py:911).
+
+    x: list of [Li] or [Li, K] tensors/arrays. pad_value: scalar or [K].
+    Returns (out [B, maxlen, K?], lengths int64 [B]).
+    """
+    seqs = [_np(s) for s in x]
+    lengths = np.asarray([s.shape[0] for s in seqs], np.int64)
+    longest = int(lengths.max()) if seqs else 0
+    if maxlen is None:
+        maxlen = longest
+    elif maxlen < longest:
+        raise ValueError(f"maxlen {maxlen} < longest sequence {longest}")
+    pv = _np(pad_value)
+    tail = seqs[0].shape[1:]
+    out = np.empty((len(seqs), maxlen) + tail, dtype=seqs[0].dtype)
+    out[:] = pv  # scalar or [K] broadcast, reference pad_value contract
+    for i, s in enumerate(seqs):
+        out[i, :s.shape[0]] = s
+    return Tensor(out), Tensor(lengths)
+
+
+def sequence_unpad(x, length, name=None):
+    """Strip padding: [B, L, ...] + lengths -> concatenated [sum(len), ...]
+    (reference sequence_lod.py:1032 — the output is the flattened LoD
+    tensor; here lengths carry what LoD carried)."""
+    arr = _np(x)
+    ln = _np(length).astype(np.int64).ravel()
+    pieces = [arr[i, :ln[i]] for i in range(arr.shape[0])]
+    return Tensor(np.concatenate(pieces, axis=0) if pieces
+                  else arr[:0].reshape((0,) + arr.shape[2:]))
+
+
+def sequence_pool(x, pool_type: str, lengths=None, pad_value=0.0, name=None):
+    """Pool over the time axis honoring lengths (reference sequence_pool op
+    family: sum/average/max/min/first/last). x: [B, L, ...]; lengths [B]
+    (None = no padding). Empty sequences produce pad_value like the
+    reference."""
+    t = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    B, L = t.shape[0], t.shape[1]
+    pt = pool_type.lower()
+    if lengths is None:
+        ln_t = ops.full([B], L, dtype="int64")
+    else:
+        ln_t = lengths if isinstance(lengths, Tensor) \
+            else Tensor(np.asarray(lengths, np.int64))
+    rng = ops.arange(0, L, dtype="int64").unsqueeze(0)          # [1, L]
+    valid = ops.less_than(rng, ln_t.unsqueeze(1))               # [B, L] bool
+    vshape = [B, L] + [1] * (len(t.shape) - 2)
+    vmask = valid.cast(t.dtype).reshape(vshape)
+    if pt == "sum":
+        out = (t * vmask).sum(axis=1)
+    elif pt in ("average", "mean"):
+        denom = vmask.sum(axis=1).clip(min=1)
+        out = (t * vmask).sum(axis=1) / denom
+    elif pt == "sqrt":
+        denom = vmask.sum(axis=1).clip(min=1).sqrt()
+        out = (t * vmask).sum(axis=1) / denom
+    elif pt == "max":
+        neg = ops.full_like(t, -3.4e38) if "float" in str(t.dtype) \
+            else ops.full_like(t, np.iinfo(np.int32).min)
+        out = ops.where(valid.reshape(vshape).broadcast_to(t.shape), t,
+                        neg).max(axis=1)
+    elif pt == "first":
+        out = t[:, 0]
+    elif pt == "last":
+        idx = (ln_t - 1).clip(min=0)
+        out = ops.stack([t[i, int(idx.numpy()[i])] for i in range(B)])
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    if lengths is not None:
+        empty = ops.equal(ln_t, ops.zeros_like(ln_t))
+        eshape = [B] + [1] * (len(out.shape) - 1)
+        out = ops.where(empty.reshape(eshape).broadcast_to(out.shape),
+                        ops.full_like(out, pad_value), out)
+    return out
+
+
+def sequence_concat(x: Sequence, name=None):
+    """Concatenate ragged batches element-wise (reference sequence_concat):
+    inputs are (list-of-sequences) batches; output is the per-row
+    concatenation, returned ragged (list of tensors)."""
+    batches = [[_np(s) for s in b] for b in x]
+    n = len(batches[0])
+    assert all(len(b) == n for b in batches), "same batch size required"
+    return [Tensor(np.concatenate([b[i] for b in batches], axis=0))
+            for i in range(n)]
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each sequence's valid prefix, keeping padding in place
+    (reference sequence_reverse op)."""
+    arr = _np(x).copy()
+    if lengths is None:
+        return Tensor(arr[:, ::-1].copy())
+    ln = _np(lengths).astype(np.int64).ravel()
+    for i in range(arr.shape[0]):
+        arr[i, :ln[i]] = arr[i, :ln[i]][::-1]
+    return Tensor(arr)

@@ -1,0 +1,95 @@
+"""paddle.strings — string-tensor ops.
+
+Reference analog: `phi/api/yaml/strings_ops.yaml` (empty/empty_like/lower/
+upper) over `phi/kernels/strings/` (pstring StringTensor + unicode case
+tables).
+
+TPU-native shape: strings never touch the accelerator (no string dtype in
+XLA); a StringTensor is a host-side numpy object array with the same
+shape/empty/lower/upper surface. `use_utf8_encoding=True` applies full
+unicode case mapping (Python's str casing IS the unicode table the
+reference ships in unicode.h); False applies ASCII-only casing like the
+reference's non-utf8 path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "lower", "upper"]
+
+
+class StringTensor:
+    """Host string tensor: numpy object array of python str."""
+
+    def __init__(self, data):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(o, dtype=object)))
+
+
+def to_string_tensor(data) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+def empty(shape: Sequence[int]) -> StringTensor:
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x: StringTensor) -> StringTensor:
+    return empty(to_string_tensor(x).shape)
+
+
+def _ascii_case(s: str, up: bool) -> str:
+    # reference non-utf8 path: only [a-zA-Z] change case, bytes preserved
+    return "".join(
+        (c.upper() if up else c.lower()) if ("a" <= c <= "z" or
+                                             "A" <= c <= "Z") else c
+        for c in s)
+
+
+def _case(x, up: bool, use_utf8_encoding: bool) -> StringTensor:
+    arr = to_string_tensor(x)._data
+    if use_utf8_encoding:
+        fn = (lambda s: s.upper()) if up else (lambda s: s.lower())
+    else:
+        fn = lambda s: _ascii_case(s, up)
+    return StringTensor(np.frompyfunc(fn, 1, 1)(arr))
+
+
+def lower(x, use_utf8_encoding: bool = False,
+          name: Optional[str] = None) -> StringTensor:
+    """reference strings_ops.yaml `lower` (strings_lower_upper_kernel.h)."""
+    return _case(x, up=False, use_utf8_encoding=use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding: bool = False,
+          name: Optional[str] = None) -> StringTensor:
+    """reference strings_ops.yaml `upper`."""
+    return _case(x, up=True, use_utf8_encoding=use_utf8_encoding)

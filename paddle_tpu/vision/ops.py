@@ -28,7 +28,9 @@ from .. import nn
 
 __all__ = ["nms", "roi_align", "RoIAlign", "roi_pool", "RoIPool",
            "box_coder", "yolo_box", "prior_box", "deform_conv2d",
-           "DeformConv2D", "distribute_fpn_proposals"]
+           "DeformConv2D", "distribute_fpn_proposals", "yolo_loss",
+           "psroi_pool", "PSRoIPool", "generate_proposals", "matrix_nms",
+           "read_file", "decode_jpeg"]
 
 
 def _np(t):
@@ -563,3 +565,431 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     restore = np.empty(len(rois), np.int64)
     restore[np.asarray(order, int)] = np.arange(len(rois))
     return multi, Tensor(restore.reshape(-1, 1)), nums
+
+
+# --------------------------------------------------------------- yolo_loss
+
+def _yolo_loss_fwd(x, gt_box, gt_label, *rest, anchors=(), anchor_mask=(),
+                   class_num=1, ignore_thresh=0.7, downsample_ratio=32,
+                   use_label_smooth=True, scale_x_y=1.0, has_score=False):
+    """YOLOv3 loss (reference python/paddle/vision/ops.py:51 semantics,
+    fluid/operators/detection yolov3_loss kernel behavior):
+
+    x [N, S*(5+C), H, W]; gt_box [N, B, 4] normalized cx,cy,w,h; gt_label
+    [N, B] int; output [N]. Sigmoid-CE on x/y/objectness/class, L1 on w/h,
+    box losses scaled by (2 - w*h); each gt matches its best wh-IoU anchor
+    over ALL anchors and only contributes if that anchor is in anchor_mask;
+    negative objectness is ignored where the decoded prediction overlaps any
+    gt above ignore_thresh; gt_score (mixup) weights every loss of its box.
+    """
+    n, _, h, w = x.shape
+    s = len(anchor_mask)
+    c = class_num
+    b = gt_box.shape[1]
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)    # [A, 2] pixels
+    mask = np.asarray(anchor_mask, np.int64)
+    input_size = downsample_ratio * h
+
+    x5 = x.reshape(n, s, 5 + c, h, w).astype(jnp.float32)
+    tx, ty, tw, th = x5[:, :, 0], x5[:, :, 1], x5[:, :, 2], x5[:, :, 3]
+    tobj = x5[:, :, 4]                                     # [N, S, H, W]
+    tcls = x5[:, :, 5:]                                    # [N, S, C, H, W]
+
+    gx, gy = gt_box[..., 0], gt_box[..., 1]                # [N, B] in [0,1]
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    gt_valid = gw > 0                                      # padding boxes: w<=0
+    score = rest[0] if has_score else jnp.ones((n, b), jnp.float32)
+
+    # ---- decoded predictions vs gt IoU -> objectness ignore mask
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    px = (jax.nn.sigmoid(tx) * alpha + beta + grid_x) / w  # [N,S,H,W]
+    py = (jax.nn.sigmoid(ty) * alpha + beta + grid_y) / h
+    masked_an = an[mask]                                   # [S, 2]
+    pw = jnp.exp(tw) * masked_an[None, :, 0, None, None] / input_size
+    ph = jnp.exp(th) * masked_an[None, :, 1, None, None] / input_size
+
+    def corners(cx, cy, ww, hh):
+        return cx - ww / 2, cy - hh / 2, cx + ww / 2, cy + hh / 2
+
+    px1, py1, px2, py2 = corners(px[..., None], py[..., None],
+                                 pw[..., None], ph[..., None])  # [N,S,H,W,1]
+    gx1, gy1, gx2, gy2 = corners(gx[:, None, None, None, :],
+                                 gy[:, None, None, None, :],
+                                 gw[:, None, None, None, :],
+                                 gh[:, None, None, None, :])    # [N,1,1,1,B]
+    ix = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0.0)
+    iy = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0.0)
+    inter = ix * iy
+    union = pw[..., None] * ph[..., None] + (gw * gh)[:, None, None, None, :] \
+        - inter
+    iou = jnp.where(gt_valid[:, None, None, None, :],
+                    inter / jnp.maximum(union, 1e-10), 0.0)
+    obj_ignore = jnp.max(iou, axis=-1) > ignore_thresh     # [N, S, H, W]
+
+    # ---- gt -> best anchor (wh IoU over ALL anchors, centered at origin)
+    gwp = gw * input_size                                  # pixels
+    ghp = gh * input_size
+    inter_a = jnp.minimum(gwp[..., None], an[None, None, :, 0]) * \
+        jnp.minimum(ghp[..., None], an[None, None, :, 1])  # [N, B, A]
+    union_a = gwp[..., None] * ghp[..., None] + \
+        an[None, None, :, 0] * an[None, None, :, 1] - inter_a
+    best_anchor = jnp.argmax(inter_a / jnp.maximum(union_a, 1e-10), axis=-1)
+    # slot in the masked set (or -1 -> not this scale's responsibility)
+    slot = jnp.full((n, b), -1, jnp.int32)
+    for si, a_idx in enumerate(mask):
+        slot = jnp.where(best_anchor == a_idx, si, slot)
+    pos = gt_valid & (slot >= 0)                           # [N, B]
+
+    gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)    # [N, B]
+    gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+    slot_c = jnp.where(pos, slot, 0)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    # gather positive-cell predictions per gt: [N, B]
+    bi = jnp.arange(n)[:, None]
+    ptx = tx[bi, slot_c, gj, gi]
+    pty = ty[bi, slot_c, gj, gi]
+    ptw = tw[bi, slot_c, gj, gi]
+    pth = th[bi, slot_c, gj, gi]
+    ptobj = tobj[bi, slot_c, gj, gi]
+    ptcls = tcls.transpose(0, 1, 3, 4, 2)[bi, slot_c, gj, gi]  # [N, B, C]
+
+    tgt_x = gx * w - gi.astype(jnp.float32)
+    tgt_y = gy * h - gj.astype(jnp.float32)
+    masked_an_j = jnp.asarray(masked_an)
+    aw = masked_an_j[:, 0][slot_c]                         # [N, B]
+    ah = masked_an_j[:, 1][slot_c]
+    tgt_w = jnp.log(jnp.maximum(gwp / jnp.maximum(aw, 1e-10), 1e-9))
+    tgt_h = jnp.log(jnp.maximum(ghp / jnp.maximum(ah, 1e-10), 1e-9))
+    box_scale = 2.0 - gw * gh
+    wgt = jnp.where(pos, score * box_scale, 0.0)
+
+    loss_xy = bce(ptx, tgt_x) * wgt + bce(pty, tgt_y) * wgt
+    loss_wh = jnp.abs(ptw - tgt_w) * wgt + jnp.abs(pth - tgt_h) * wgt
+
+    smooth_pos = 1.0 - 1.0 / c if (use_label_smooth and c > 1) else 1.0
+    smooth_neg = 1.0 / c if (use_label_smooth and c > 1) else 0.0
+    onehot = jax.nn.one_hot(jnp.clip(gt_label, 0, c - 1), c)
+    cls_tgt = onehot * smooth_pos + (1.0 - onehot) * smooth_neg
+    loss_cls = jnp.sum(bce(ptcls, cls_tgt), axis=-1) * \
+        jnp.where(pos, score, 0.0)
+
+    # positive objectness at matched cells (scatter via segment sum over the
+    # flat cell index so duplicate matches behave additively like the kernel)
+    flat = ((slot_c * h + gj) * w + gi)                    # [N, B]
+    posw = jnp.where(pos, score, 0.0)
+    pos_obj = jax.vmap(
+        lambda f, v: jax.ops.segment_sum(v, f, num_segments=s * h * w)
+    )(flat, posw).reshape(n, s, h, w)
+    is_pos_cell = pos_obj > 0
+    loss_obj_pos = jnp.sum(bce(tobj, 1.0) * pos_obj, axis=(1, 2, 3))
+    loss_obj_neg = jnp.sum(
+        bce(tobj, 0.0) * jnp.where(is_pos_cell | obj_ignore, 0.0, 1.0),
+        axis=(1, 2, 3))
+
+    per_gt = loss_xy + loss_wh + loss_cls
+    return jnp.sum(per_gt, axis=1) + loss_obj_pos + loss_obj_neg
+
+
+register_op("yolo_loss", _yolo_loss_fwd, nondiff_inputs=(1, 2, 3))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None else [])
+    return _op("yolo_loss", *args, anchors=tuple(anchors),
+               anchor_mask=tuple(anchor_mask), class_num=int(class_num),
+               ignore_thresh=float(ignore_thresh),
+               downsample_ratio=int(downsample_ratio),
+               use_label_smooth=bool(use_label_smooth),
+               scale_x_y=float(scale_x_y), has_score=gt_score is not None)
+
+
+# -------------------------------------------------------------- psroi_pool
+
+def _psroi_pool_fwd(x, boxes, boxes_num, output_size=(1, 1),
+                    spatial_scale=1.0, output_channels=1):
+    """Position-sensitive RoI pooling (reference psroi_pool kernel,
+    phi/kernels/cpu/psroi_pool_kernel): input [N, C*ph*pw, H, W], each output
+    bin (i, j) of channel c averages input channel c*ph*pw + i*pw + j over
+    the bin's pixel region. Exact bin-average via a per-RoI membership mask
+    (XLA-friendly: no data-dependent loop bounds)."""
+    ph, pw = output_size
+    n, _, h, w = x.shape
+    r = boxes.shape[0]
+    img_of_roi = jnp.repeat(jnp.arange(n), boxes_num, total_repeat_length=r)
+
+    # reference rounds RoI corners to integer grid then forces size >= 0.1
+    x1 = jnp.round(boxes[:, 0]) * spatial_scale
+    y1 = jnp.round(boxes[:, 1]) * spatial_scale
+    x2 = jnp.round(boxes[:, 2] + 1.0) * spatial_scale
+    y2 = jnp.round(boxes[:, 3] + 1.0) * spatial_scale
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    cols = jnp.arange(w, dtype=jnp.float32)
+    rows = jnp.arange(h, dtype=jnp.float32)
+    # bin pixel ranges [floor(start), ceil(end)) clipped to the map
+    jgrid = jnp.arange(pw, dtype=jnp.float32)
+    igrid = jnp.arange(ph, dtype=jnp.float32)
+    wstart = jnp.clip(jnp.floor(x1[:, None] + jgrid[None, :] * bin_w[:, None]),
+                      0, w)                                 # [R, pw]
+    wend = jnp.clip(jnp.ceil(x1[:, None] + (jgrid[None, :] + 1) * bin_w[:, None]),
+                    0, w)
+    hstart = jnp.clip(jnp.floor(y1[:, None] + igrid[None, :] * bin_h[:, None]),
+                      0, h)                                 # [R, ph]
+    hend = jnp.clip(jnp.ceil(y1[:, None] + (igrid[None, :] + 1) * bin_h[:, None]),
+                    0, h)
+    col_in = (cols[None, None, :] >= wstart[..., None]) & \
+        (cols[None, None, :] < wend[..., None])             # [R, pw, W]
+    row_in = (rows[None, None, :] >= hstart[..., None]) & \
+        (rows[None, None, :] < hend[..., None])             # [R, ph, H]
+    area = jnp.maximum(
+        (hend - hstart)[:, :, None] * (wend - wstart)[:, None, :], 1.0)
+
+    # x regrouped: [N, C, ph, pw, H, W]. Contract against the PER-IMAGE map
+    # and select with a one-hot image mask — gathering xg[img_of_roi] first
+    # would materialize R copies of the feature map ([R,C,ph,pw,H,W] is GBs
+    # at detection scale); [N,R,C,ph,pw] is KBs.
+    xg = x.reshape(n, output_channels, ph, pw, h, w)
+    onehot = (img_of_roi[:, None] == jnp.arange(n)[None, :])  # [R, N]
+    pooled = jnp.einsum("ncijhw,rih,rjw,rn->rcij",
+                        xg.astype(jnp.float32),
+                        row_in.astype(jnp.float32),
+                        col_in.astype(jnp.float32),
+                        onehot.astype(jnp.float32))
+    empty = ((hend - hstart)[:, :, None] <= 0) | \
+        ((wend - wstart)[:, None, :] <= 0)                  # [R, ph, pw]
+    out = pooled / area[:, None, :, :]
+    return jnp.where(empty[:, None, :, :], 0.0, out).astype(x.dtype)
+
+
+register_op("psroi_pool", _psroi_pool_fwd, nondiff_inputs=(1, 2))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference python/paddle/vision/ops.py psroi_pool: output channels =
+    C / (ph * pw), inferred from the input."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    c = x.shape[1]
+    if c % (ph * pw) != 0:
+        raise ValueError(
+            f"psroi_pool input channels {c} must divide output_size "
+            f"{ph}x{pw}")
+    return _op("psroi_pool", x, boxes, boxes_num,
+               output_size=(int(ph), int(pw)),
+               spatial_scale=float(spatial_scale),
+               output_channels=c // (ph * pw))
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+# ------------------------------------------------- generate_proposals (RPN)
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference vision.ops.generate_proposals /
+    fluid/operators/detection/generate_proposals_v2_op): decode anchors with
+    deltas, clip to image, drop tiny boxes, NMS, keep top-N. Variable-length
+    output -> host numpy, like nms/distribute_fpn_proposals above."""
+    sc = _np(scores)          # [N, A, H, W]
+    bd = _np(bbox_deltas)     # [N, 4A, H, W]
+    ims = _np(img_size)       # [N, 2] (h, w)
+    anc = _np(anchors).reshape(-1, 4)      # [H*W*A, 4] x1 y1 x2 y2
+    var = _np(variances).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(n):
+        # layout parity: scores [A,H,W] -> (H,W,A); deltas [4A,H,W] -> (H,W,A,4)
+        s_i = sc[i].transpose(1, 2, 0).ravel()
+        d_i = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s_i, kind="stable")[:pre_nms_top_n]
+        s_i, d_i, anc_i, var_i = s_i[order], d_i[order], anc[order], var[order]
+
+        aw = anc_i[:, 2] - anc_i[:, 0] + offset
+        ah = anc_i[:, 3] - anc_i[:, 1] + offset
+        acx = anc_i[:, 0] + aw * 0.5
+        acy = anc_i[:, 1] + ah * 0.5
+        dx, dy, dw, dh = (d_i * var_i).T
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        bw = np.exp(np.minimum(dw, np.log(1000.0 / 16))) * aw
+        bh = np.exp(np.minimum(dh, np.log(1000.0 / 16))) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - offset, cy + bh * 0.5 - offset], 1)
+        ih, iw = ims[i]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - offset)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - offset)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + offset >= min_size) &
+                   (boxes[:, 3] - boxes[:, 1] + offset >= min_size))
+        boxes, s_i = boxes[keep_sz], s_i[keep_sz]
+        if len(boxes):
+            if eta < 1.0:
+                # adaptive NMS (reference generate_proposals adaptive mode):
+                # the threshold decays by eta after each kept box while >0.5
+                order = np.argsort(-s_i, kind="stable")
+                bx = boxes[order]
+                area = np.maximum(bx[:, 2] - bx[:, 0] + offset, 0) * \
+                    np.maximum(bx[:, 3] - bx[:, 1] + offset, 0)
+                thresh = nms_thresh
+                keep_idx, alive = [], np.ones(len(bx), bool)
+                for j in range(len(bx)):
+                    if not alive[j]:
+                        continue
+                    keep_idx.append(order[j])
+                    if len(keep_idx) >= post_nms_top_n:
+                        break
+                    xx1 = np.maximum(bx[j, 0], bx[:, 0])
+                    yy1 = np.maximum(bx[j, 1], bx[:, 1])
+                    xx2 = np.minimum(bx[j, 2], bx[:, 2])
+                    yy2 = np.minimum(bx[j, 3], bx[:, 3])
+                    inter = np.maximum(xx2 - xx1 + offset, 0) * \
+                        np.maximum(yy2 - yy1 + offset, 0)
+                    iou = inter / np.maximum(area[j] + area - inter, 1e-10)
+                    alive &= iou <= thresh
+                    alive[j] = False
+                    if thresh > 0.5:
+                        thresh *= eta
+                keep = np.asarray(keep_idx, int)
+            else:
+                keep = _np(nms(Tensor(boxes.astype(np.float32)),
+                               iou_threshold=nms_thresh,
+                               scores=Tensor(s_i.astype(np.float32)),
+                               top_k=post_nms_top_n)).astype(int)
+            boxes, s_i = boxes[keep], s_i[keep]
+        all_rois.append(boxes)
+        all_probs.append(s_i)
+        nums.append(len(boxes))
+
+    rois = Tensor(np.concatenate(all_rois, 0).astype(np.float32)
+                  if all_rois else np.zeros((0, 4), np.float32))
+    probs = Tensor(np.concatenate(all_probs, 0).astype(np.float32)
+                   if all_probs else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, probs, Tensor(np.asarray(nums, np.int32))
+    return rois, probs
+
+
+# ------------------------------------------------------------- matrix_nms
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference vision.ops.matrix_nms): scores decay by
+    the worst-case IoU with any higher-scored same-class box — one matrix op,
+    no iterative suppression. Host numpy (variable-length output)."""
+    bb = _np(bboxes)          # [N, M, 4]
+    sc = _np(scores)          # [N, C, M]
+    n, c, m = sc.shape
+    offset = 0.0 if normalized else 1.0
+
+    def iou_matrix(b):
+        x1 = np.maximum(b[:, None, 0], b[None, :, 0])
+        y1 = np.maximum(b[:, None, 1], b[None, :, 1])
+        x2 = np.minimum(b[:, None, 2], b[None, :, 2])
+        y2 = np.minimum(b[:, None, 3], b[None, :, 3])
+        inter = np.clip(x2 - x1 + offset, 0, None) * \
+            np.clip(y2 - y1 + offset, 0, None)
+        area = (b[:, 2] - b[:, 0] + offset) * (b[:, 3] - b[:, 1] + offset)
+        return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+    outs, indices, nums = [], [], []
+    for i in range(n):
+        dets = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[i, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-s[sel], kind="stable")][:nms_top_k]
+            b, s_o = bb[i][order], s[order]
+            iou = np.triu(iou_matrix(b), k=1)          # pairwise, j > i rows
+            # compensation term: suppressor i's own max IoU with any
+            # higher-scored box = column-max of the upper triangle at i
+            iou_cmax = np.max(iou, axis=0) if len(b) > 1 \
+                else np.zeros(len(b))
+            # decay: for box j, min over i<j of f(iou_ij)/f(iou_cmax_i)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - iou_cmax[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay, 1e30)
+            factor = np.minimum(np.min(decay, axis=0), 1.0)
+            s_dec = s_o * factor
+            keep = s_dec > post_threshold
+            for j in np.nonzero(keep)[0]:
+                dets.append((float(s_dec[j]), cls, b[j], order[j] + i * m))
+        dets.sort(key=lambda d: -d[0])
+        dets = dets[:keep_top_k] if keep_top_k > 0 else dets
+        for s_d, cls, b, gidx in dets:
+            outs.append([cls, s_d, *b.tolist()])
+            indices.append(gidx)
+        nums.append(len(dets))
+
+    out = Tensor(np.asarray(outs, np.float32) if outs
+                 else np.zeros((0, 6), np.float32))
+    idx = Tensor(np.asarray(indices, np.int64).reshape(-1, 1))
+    res = (out,)
+    if return_index:
+        res += (idx,)
+    if return_rois_num:
+        res += (Tensor(np.asarray(nums, np.int32)),)
+    return res if len(res) > 1 else out
+
+
+# ------------------------------------------------------ image file IO ops
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 1-D tensor (reference vision/ops.py
+    read_file over the read_file CUDA-side op). Host op by nature."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (reference
+    vision/ops.py:1289 decode_jpeg over nvjpeg). Host decode via PIL — the
+    TPU has no jpeg engine; datasets decode on host then feed the mesh."""
+    from io import BytesIO
+    from PIL import Image
+
+    img = Image.open(BytesIO(_np(x).tobytes()))
+    if mode != "unchanged":
+        conv = {"gray": "L", "rgb": "RGB", "rgba": "RGBA"}.get(mode.lower())
+        if conv is None:
+            raise ValueError(f"decode_jpeg: unsupported mode {mode}")
+        img = img.convert(conv)
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                  # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)     # [C, H, W]
+    return Tensor(np.ascontiguousarray(arr))
