@@ -46,11 +46,16 @@ class GradScaler:
             return
         inv = 1.0 / self._scale
         flags = []
+        from ..core.selected_rows import SelectedRows
         for p in optimizer._parameter_list:
             if p._grad is None:
                 continue
-            g = p._grad * inv
-            flags.append(jnp.all(jnp.isfinite(g)))
+            if isinstance(p._grad, SelectedRows):
+                g = p._grad.map_values(lambda v: v * inv)
+                flags.append(jnp.all(jnp.isfinite(g.values)))
+            else:
+                g = p._grad * inv
+                flags.append(jnp.all(jnp.isfinite(g)))
             p._grad = g
         # one host sync for the whole step, not one per parameter
         self._found_inf = bool(flags) and not bool(jnp.all(jnp.stack(flags)))

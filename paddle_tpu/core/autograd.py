@@ -201,7 +201,10 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
     leaf_acc: Dict[int, list] = {}
 
     def _leaf_add(t, g):
+        from .selected_rows import SelectedRows
         sh = getattr(t, "_grad_sharding", None)
+        if sh is not None and isinstance(g, SelectedRows):
+            g = g.to_dense()  # ZeRO-sharded params keep the dense contract
         if sh is not None and not isinstance(g, Tensor):
             # ZeRO stage-2 invariant: grads shard the moment they're produced,
             # even while buffered here — never a full replicated copy per param

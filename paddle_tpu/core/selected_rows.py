@@ -1,0 +1,136 @@
+"""SelectedRows — the sparse-gradient representation for tall embeddings.
+
+Reference analog: `phi/core/selected_rows.h` (rows + value block over a tall
+dense shape) and the `phi/kernels/selected_rows/` update kernels (sgd,
+adam with lazy_mode, merge). The reference uses it so a [V, d] embedding
+touched by a small batch produces an O(batch·d) gradient instead of O(V·d).
+
+TPU-native shape: a registered pytree (rows int32 [k], values [k, d]) so it
+can flow out of jitted explicit-backward executables, through the autograd
+tape's accumulation (`__add__` concatenates; dense+sparse densifies), into
+the optimizer's scatter update (donated, so the parameter updates in place
+without a second V·d buffer). Sparse grads are an EAGER-mode feature, like
+the reference (the compiled TrainStep path keeps dense grads — XLA already
+fuses the scatter there).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "merge_selected_rows"]
+
+
+class SelectedRows:
+    """rows: int32 [k]; values: [k, *tail]; dense_shape: full tensor shape.
+
+    A merged SelectedRows may contain OUT-OF-RANGE fill rows (== dense
+    rows count): their values are zero and every consumer either ignores
+    them numerically (norms: zero contribution) or drops them structurally
+    (XLA scatter drops out-of-bounds writes by default). This keeps merge()
+    shape-static — the jit caches stay warm across batches with different
+    unique-id counts.
+    """
+
+    __slots__ = ("rows", "values", "dense_shape", "_merged")
+
+    def __init__(self, rows, values, dense_shape: Tuple[int, ...],
+                 _merged: bool = False):
+        self.rows = rows
+        self.values = values
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+        self._merged = _merged
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    # ------------------------------------------------------------ conversion
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        # mode="drop" so merged fill rows (index == V) vanish
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.to_dense())
+
+    def merge(self) -> "SelectedRows":
+        """Deduplicate rows, summing their values (reference
+        merge_selected_rows op, selected_rows_functor.h MergeAdd).
+
+        Shape-static and trace-safe: `jnp.unique(size=k)` keeps the output
+        at k entries, padding with the OUT-OF-RANGE row index V whose values
+        are zero (see class docstring) — so per-batch unique-id counts never
+        retrace the optimizer's compiled scatter update, and no host sync
+        happens here."""
+        if self._merged:
+            return self
+        k = int(self.rows.shape[0])
+        fill = self.dense_shape[0]          # out of range on purpose
+        uniq, inv = jnp.unique(self.rows, return_inverse=True, size=k,
+                               fill_value=fill)
+        merged = jax.ops.segment_sum(self.values, inv, num_segments=k)
+        return SelectedRows(uniq.astype(jnp.int32), merged, self.dense_shape,
+                            _merged=True)
+
+    def map_values(self, fn) -> "SelectedRows":
+        return SelectedRows(self.rows, fn(self.values), self.dense_shape,
+                            _merged=self._merged)
+
+    def astype(self, dtype) -> "SelectedRows":
+        return self.map_values(lambda v: v.astype(dtype))
+
+    # ------------------------------------------------------- tape arithmetic
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.dense_shape != self.dense_shape:
+                raise ValueError(
+                    f"SelectedRows shape mismatch: {self.dense_shape} vs "
+                    f"{other.dense_shape}")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        # dense + sparse: densify (a dense consumer grad already paid V·d)
+        return jnp.asarray(other).at[self.rows].add(
+            self.values.astype(jnp.asarray(other).dtype), mode="drop")
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(shape={self.dense_shape}, nnz={self.nnz}, "
+                f"dtype={self.values.dtype})")
+
+
+def merge_selected_rows(x: SelectedRows) -> SelectedRows:
+    """Module-level surface for the reference `merge_selected_rows` op
+    (ops.yaml)."""
+    return x.merge()
+
+
+def _flatten(sr):
+    return (sr.rows, sr.values), (sr.dense_shape, sr._merged)
+
+
+def _unflatten(aux, children):
+    rows, values = children
+    dense_shape, merged = aux
+    return SelectedRows(rows, values, dense_shape, _merged=merged)
+
+
+jax.tree_util.register_pytree_node(SelectedRows, _flatten, _unflatten)

@@ -170,12 +170,16 @@ class Tensor:
     def grad(self) -> Optional["Tensor"]:
         if self._grad is None:
             return None
+        from .selected_rows import SelectedRows
+        if isinstance(self._grad, SelectedRows):
+            return self._grad  # sparse grads surface as SelectedRows
         return Tensor(self._grad, stop_gradient=True)
 
     @grad.setter
     def grad(self, value):
-        if value is None:
-            self._grad = None
+        from .selected_rows import SelectedRows
+        if value is None or isinstance(value, SelectedRows):
+            self._grad = value
         else:
             self._grad = value.value() if isinstance(value, Tensor) else jnp.asarray(value)
 
@@ -187,6 +191,11 @@ class Tensor:
         hooks = getattr(self, "_hooks", None)
         if not hooks:
             return g
+        from .selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            # hooks see the dense view (reference hooks receive a Tensor);
+            # a hook on a sparse-grad param forfeits the sparsity
+            g = g.to_dense()
         for hook in list(hooks.values()):
             t_in = g if isinstance(g, Tensor) else Tensor(g)
             r = hook(t_in)
@@ -197,7 +206,10 @@ class Tensor:
 
     def _accumulate_grad(self, g):
         # GradNodeAccumulation analog (reference: eager/accumulation/)
+        from .selected_rows import SelectedRows
         sh = getattr(self, "_grad_sharding", None)
+        if sh is not None and isinstance(g, SelectedRows):
+            g = g.to_dense()  # sharded-grad params keep the dense contract
         if sh is not None and not isinstance(g, Tensor):
             # ZeRO stage-2 semantics: the gradient is sharded AT accumulation
             # (reduce-scatter), never held replicated on the tape — reference
@@ -237,7 +249,11 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero: bool = False):
         if set_to_zero and self._grad is not None:
-            self._grad = jnp.zeros_like(self._grad)
+            from .selected_rows import SelectedRows
+            if isinstance(self._grad, SelectedRows):
+                self._grad = None  # sparse grads have no zero-filled form
+            else:
+                self._grad = jnp.zeros_like(self._grad)
         else:
             self._grad = None
 

@@ -17,6 +17,11 @@ class ClipGradBase:
         raise NotImplementedError
 
 
+def _is_sparse(g):
+    from ..core.selected_rows import SelectedRows
+    return isinstance(g, SelectedRows)
+
+
 class ClipGradByValue(ClipGradBase):
     def __init__(self, max, min=None):
         self.max = float(max)
@@ -24,7 +29,11 @@ class ClipGradByValue(ClipGradBase):
 
     @no_grad()
     def __call__(self, params_grads):
-        return [(p, jnp.clip(g, self.min, self.max)) for p, g in params_grads]
+        # sparse: duplicate rows sum BEFORE clamping (dense equivalence)
+        return [(p, g.merge().map_values(
+                    lambda v: jnp.clip(v, self.min, self.max))
+                 if _is_sparse(g) else jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
 
 
 class ClipGradByNorm(ClipGradBase):
@@ -35,6 +44,13 @@ class ClipGradByNorm(ClipGradBase):
     def __call__(self, params_grads):
         out = []
         for p, g in params_grads:
+            if _is_sparse(g):
+                g = g.merge()   # duplicate rows must sum before the norm
+                norm = jnp.sqrt(jnp.sum(jnp.square(g.values)))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                    1.0)
+                out.append((p, g.map_values(lambda v: v * scale)))
+                continue
             norm = jnp.sqrt(jnp.sum(jnp.square(g)))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
             out.append((p, g * scale))
@@ -52,10 +68,19 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     @no_grad()
     def __call__(self, params_grads):
-        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for _, g in params_grads]
+        # SelectedRows contribute through their merged values (duplicate rows
+        # sum before squaring — the dense-equivalent norm)
+        merged = [(p, g.merge() if _is_sparse(g) else g)
+                  for p, g in params_grads]
+        sq = [jnp.sum(jnp.square((g.values if _is_sparse(g) else g)
+                                 .astype(jnp.float32)))
+              for _, g in merged]
         if not sq:
             return params_grads
         global_norm = jnp.sqrt(sum(sq))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        return [(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
-                for p, g in params_grads]
+        return [(p, g.map_values(
+                    lambda v: (v.astype(jnp.float32) * scale).astype(v.dtype))
+                 if _is_sparse(g)
+                 else (g.astype(jnp.float32) * scale).astype(g.dtype))
+                for p, g in merged]

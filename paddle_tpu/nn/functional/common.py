@@ -100,8 +100,42 @@ def _embedding_fwd(w, ids, padding_idx=-1, has_pad=False):
 register_op("embedding", _embedding_fwd, nondiff_inputs=(1,))
 
 
+def _embedding_sparse_bwd(primals, outs, cotangents, padding_idx=-1,
+                          has_pad=False):
+    """Explicit backward producing a SelectedRows weight grad: O(batch·d)
+    instead of the dense O(V·d) (reference selected_rows embedding grad,
+    phi/kernels/selected_rows/). Duplicate ids stay duplicated — the tape
+    concatenates and the optimizer's scatter-add sums them."""
+    from ...core.selected_rows import SelectedRows
+    w, ids = primals
+    ct = cotangents[0]
+    rows = ids.reshape(-1).astype(jnp.int32)
+    vals = ct.reshape(rows.shape[0], *w.shape[1:])
+    if has_pad:
+        vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+    rows = jnp.clip(rows, 0, w.shape[0] - 1)  # pad ids may be out of range
+    return (SelectedRows(rows, vals, w.shape), None)
+
+
+register_op("embedding_sparse", _embedding_fwd, bwd=_embedding_sparse_bwd,
+            nondiff_inputs=(1,))
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    return _op("embedding", weight, x,
+    from ...core.dispatch import in_trace
+    from ...core.tensor import Tensor as _T
+    if padding_idx is not None and padding_idx < 0:
+        # reference semantics: negative padding_idx counts from the end
+        padding_idx = int(weight.shape[0]) + int(padding_idx)
+    # sparse grads are an eager feature (reference: selected-rows path);
+    # inside a trace the whole-graph vjp keeps grads dense and XLA fuses the
+    # scatter. A NON-LEAF weight (tied/scaled embedding) also falls back:
+    # its upstream vjp consumes an array cotangent, not SelectedRows.
+    weight_is_leaf = not (isinstance(weight, _T)
+                          and weight._grad_node is not None)
+    op_name = "embedding_sparse" if sparse and weight_is_leaf \
+        and not in_trace() else "embedding"
+    return _op(op_name, weight, x,
                padding_idx=-1 if padding_idx is None else int(padding_idx),
                has_pad=padding_idx is not None)
 

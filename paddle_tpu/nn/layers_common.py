@@ -49,16 +49,18 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = bool(sparse)
         self.weight = self.create_parameter(
             shape=[num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=Normal(0.0, 1.0) if weight_attr is None else None)
         if padding_idx is not None:
-            arr = np.asarray(self.weight.numpy())
+            arr = np.array(self.weight.numpy())  # writable copy
             arr[padding_idx] = 0
             self.weight.set_value(arr)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

@@ -35,6 +35,25 @@ def _jitted_update(cls, static_key):
     return jax.jit(update)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_sparse_update(cls, static_key, donate: bool):
+    """Compiled row-wise (SelectedRows) update. When `donate`, the PARAM
+    buffer is donated so the scatter aliases it in place and a [V, d]
+    embedding update never allocates a second V·d buffer (reference
+    phi/kernels/selected_rows/ kernels mutate in place). Accumulator state
+    and master weights are NOT donated — optimizer.state_dict() snapshots
+    alias those buffers and must stay readable. Donation means a user-held
+    `p.value()` array from before the step becomes invalid; holders should
+    `.copy()` (same hazard as the reference's in-place mutation)."""
+    static = dict(static_key)
+
+    def update(param, rows, vals, state, scalars):
+        return cls._sparse_update_rule(param, rows, vals, state, scalars,
+                                       **static)
+
+    return jax.jit(update, donate_argnums=(0,) if donate else ())
+
+
 class Optimizer:
     _state_names: List[str] = []
 
@@ -111,6 +130,8 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        from ..core.selected_rows import SelectedRows
+
         params = [p for p in self._parameter_list
                   if p.trainable and p._grad is not None]
         if not params:
@@ -123,6 +144,20 @@ class Optimizer:
             self._ensure_state(p)
 
         scalars = self._scalars(self.get_lr())  # advances step count ONCE
+
+        # SelectedRows grads (sparse embeddings) take the row-wise path;
+        # everything else goes through the fused dense update below
+        sparse_pairs = [(p, g) for p, g in zip(params, grads)
+                        if isinstance(g, SelectedRows)]
+        if sparse_pairs:
+            dense_pairs = [(p, g) for p, g in zip(params, grads)
+                           if not isinstance(g, SelectedRows)]
+            for p, sr in sparse_pairs:
+                self._sparse_apply(p, sr, scalars)
+            if not dense_pairs:
+                return
+            params = [p for p, _ in dense_pairs]
+            grads = [g for _, g in dense_pairs]
         # pipeline parallelism places stages on disjoint submeshes; one jit cannot
         # span disjoint device sets, so run one fused update per device group
         groups = {}
@@ -217,6 +252,44 @@ class Optimizer:
     def _update_rule(params, grads, states, scalars, **static):
         raise NotImplementedError
 
+    # ------------------------------------------------------------ sparse
+
+    def _sparse_apply(self, p, sr, scalars):
+        """Row-wise update for a SelectedRows gradient (reference
+        selected_rows optimizer kernels / Adam lazy_mode). Regularization is
+        skipped, matching the reference's warning for sparse parameters."""
+        import warnings
+
+        if self._weight_decay and not getattr(self, "_warned_sparse_wd", False):
+            warnings.warn(
+                "weight decay is skipped for parameters with SelectedRows "
+                "(sparse) gradients — the reference applies no "
+                "regularization on the sparse path either")
+            self._warned_sparse_wd = True
+        sr = sr.merge()     # no-op when the grad clip already merged
+        lr_scale = float(p.optimize_attr.get("learning_rate", 1.0))
+        use_master = id(p) in self._master_weights
+        pv = self._master_weights[id(p)] if use_master else p.value()
+        state = self._accumulators[id(p)]
+        key = self._static_config() + (("lr_scale", lr_scale),)
+        # master weights live in state_dict snapshots: don't donate them
+        new_p, new_state = _jitted_sparse_update(type(self), key,
+                                                 not use_master)(
+            pv, sr.rows, sr.values.astype(pv.dtype), state, scalars)
+        self._accumulators[id(p)] = new_state
+        if use_master:
+            self._master_weights[id(p)] = new_p
+            p._set_value_inplace(new_p.astype(p.value().dtype))
+        else:
+            p._set_value_inplace(new_p)
+
+    @staticmethod
+    def _sparse_update_rule(param, rows, vals, state, scalars, **static):
+        raise NotImplementedError(
+            "this optimizer has no SelectedRows update rule; use "
+            "SGD/Momentum/Adam/AdamW/Adagrad for sparse-grad embeddings or "
+            "set sparse=False (reference supports the same subset)")
+
 
 def _apply_wd(p, g, wd):
     """L2 regularization added to the gradient (reference L2Decay semantics)."""
@@ -233,6 +306,12 @@ class SGD(Optimizer):
         new_params = [p - (lr * s) * _apply_wd(p, g, weight_decay * w)
                       for p, g, s, w in zip(params, grads, lr_scales, wd_scales)]
         return new_params, states
+
+    @staticmethod
+    def _sparse_update_rule(param, rows, vals, state, scalars, weight_decay=0.0,
+                            lr_scale=1.0):
+        # reference sgd selected-rows kernel: scatter-subtract touched rows
+        return param.at[rows].add(-(scalars["lr"] * lr_scale) * vals), state
 
 
 class Momentum(Optimizer):
@@ -265,6 +344,20 @@ class Momentum(Optimizer):
             new_params.append(p2)
             new_states.append({"velocity": v})
         return new_params, new_states
+
+    @staticmethod
+    def _sparse_update_rule(param, rows, vals, state, scalars, weight_decay=0.0,
+                            momentum=0.9, use_nesterov=False, lr_scale=1.0):
+        # lazy rows-only velocity (reference sparse_momentum semantics:
+        # untouched rows keep their velocity unchanged this step)
+        lr = scalars["lr"] * lr_scale
+        v_rows = momentum * state["velocity"][rows] + vals
+        if use_nesterov:
+            delta = lr * (vals + momentum * v_rows)
+        else:
+            delta = lr * v_rows
+        return (param.at[rows].add(-delta),
+                {"velocity": state["velocity"].at[rows].set(v_rows)})
 
 
 class Adam(Optimizer):
@@ -306,6 +399,23 @@ class Adam(Optimizer):
             new_params.append(p - step_v)
             new_states.append({"moment1": m1, "moment2": m2})
         return new_params, new_states
+
+    @staticmethod
+    def _sparse_update_rule(param, rows, vals, state, scalars, weight_decay=0.0,
+                            beta1=0.9, beta2=0.999, epsilon=1e-8, lr_scale=1.0,
+                            decouple_wd=False):
+        # reference Adam lazy_mode over SelectedRows: moments and param move
+        # only at touched rows; bias correction uses the global step
+        lr = scalars["lr"] * lr_scale
+        t = scalars["step"]
+        m1r = beta1 * state["moment1"][rows] + (1 - beta1) * vals
+        m2r = beta2 * state["moment2"][rows] + (1 - beta2) * jnp.square(vals)
+        m1h = m1r / (1.0 - beta1 ** t)
+        m2h = m2r / (1.0 - beta2 ** t)
+        delta = lr * m1h / (jnp.sqrt(m2h) + epsilon)
+        return (param.at[rows].add(-delta),
+                {"moment1": state["moment1"].at[rows].set(m1r),
+                 "moment2": state["moment2"].at[rows].set(m2r)})
 
 
 class AdamW(Adam):
@@ -390,6 +500,15 @@ class Adagrad(Optimizer):
             new_params.append(p - (lr * s) * g / (jnp.sqrt(m) + epsilon))
             new_states.append({"moment": m})
         return new_params, new_states
+
+    @staticmethod
+    def _sparse_update_rule(param, rows, vals, state, scalars, weight_decay=0.0,
+                            epsilon=1e-6, lr_scale=1.0):
+        # reference adagrad selected-rows kernel: rows-only accumulator
+        lr = scalars["lr"] * lr_scale
+        m_rows = state["moment"][rows] + jnp.square(vals)
+        return (param.at[rows].add(-lr * vals / (jnp.sqrt(m_rows) + epsilon)),
+                {"moment": state["moment"].at[rows].set(m_rows)})
 
 
 class Adadelta(Optimizer):

@@ -111,6 +111,8 @@ ALIASES = {
     "trilinear_interp": "nn.functional.interpolate(mode='trilinear')",
     "warpctc": "dispatch op 'ctc_loss' (nn.functional.ctc_loss)",
     "warprnnt": "dispatch op 'rnnt_loss_op' (nn.functional.rnnt_loss)",
+    "merge_selected_rows":
+        "core.selected_rows.merge_selected_rows (SelectedRows.merge)",
     "to_dense": "sparse.SparseCooTensor.to_dense()",
     "to_sparse_coo": "Tensor.to_sparse_coo() / SparseCsrTensor.to_sparse_coo()",
     "to_sparse_csr": "SparseCooTensor.to_sparse_csr() / Tensor.to_sparse_csr()",
