@@ -188,3 +188,7 @@ class PredictorPool:
 
     def __len__(self):
         return len(self._preds)
+
+from . import passes  # noqa: F401,E402  (pre-lowering pass framework)
+from .passes import Pass, PassPipeline, register_pass, get_pass, list_passes  # noqa: F401,E402
+__all__ += ["passes", "Pass", "PassPipeline", "register_pass", "get_pass", "list_passes"]

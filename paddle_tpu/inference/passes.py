@@ -1,0 +1,155 @@
+"""Pre-lowering pass framework: model rewrites before XLA export.
+
+Reference analog: `inference/api/paddle_pass_builder.cc:91` — AnalysisPredictor
+runs an ordered pass list (fusions, quant, layout, memory) over the loaded
+ProgramDesc. On TPU, XLA performs the fusion/layout/memory optimization at
+export time, so the passes that REMAIN meaningful are the semantic rewrites
+that must happen before lowering: int8 quantization of weights+activations,
+inference-mode graph cleanup. This registry hosts those, applied to the Layer
+tree right before `jit.save` exports it (`jit.save(..., passes=[...])`).
+
+A Pass sees the model (a Layer) and returns the rewritten model. Passes are
+named and ordered like the reference's pass strategy lists.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+from ..nn.layer import Layer
+
+__all__ = ["Pass", "register_pass", "get_pass", "PassPipeline",
+           "list_passes"]
+
+_PASSES: Dict[str, "Pass"] = {}
+
+
+class Pass:
+    """One rewrite over the Layer tree. Subclass and implement apply()."""
+
+    name = "pass"
+
+    def apply(self, model: Layer) -> Layer:
+        raise NotImplementedError
+
+    def __call__(self, model: Layer) -> Layer:
+        return self.apply(model)
+
+
+def register_pass(name: str):
+    """Decorator: register a Pass subclass (or a callable model->model)."""
+
+    def deco(obj):
+        if isinstance(obj, type) and issubclass(obj, Pass):
+            inst = obj()
+            inst.name = name
+        else:
+            inst = _FnPass(name, obj)
+        _PASSES[name] = inst
+        return obj
+
+    return deco
+
+
+class _FnPass(Pass):
+    def __init__(self, name: str, fn: Callable[[Layer], Layer]):
+        self.name = name
+        self._fn = fn
+
+    def apply(self, model: Layer) -> Layer:
+        return self._fn(model)
+
+
+def get_pass(name: str) -> Pass:
+    if name not in _PASSES:
+        raise KeyError(f"unknown pass '{name}'; available: "
+                       f"{sorted(_PASSES)}")
+    return _PASSES[name]
+
+
+def list_passes() -> List[str]:
+    return sorted(_PASSES)
+
+
+class PassPipeline:
+    """Ordered pass list (reference PaddlePassBuilder)."""
+
+    def __init__(self, names: Sequence[str]):
+        self._names = list(names)
+
+    def append(self, name: str):
+        self._names.append(name)
+
+    def delete(self, name: str):
+        self._names = [n for n in self._names if n != name]
+
+    def passes(self) -> List[str]:
+        return list(self._names)
+
+    def run(self, model: Layer) -> Layer:
+        for name in self._names:
+            model = get_pass(name).apply(model)
+        return model
+
+
+# ------------------------------------------------------------ built-in passes
+
+from ..nn.layer import swap_sublayers as _walk_swap  # noqa: E402 (shared walker)
+
+
+@register_pass("delete_dropout")
+def _delete_dropout(model: Layer) -> Layer:
+    """Inference cleanup: Dropout layers become identity (reference
+    delete_dropout_op_pass, paddle_pass_builder.cc list)."""
+    from .. import nn
+
+    class _Identity(Layer):
+        def forward(self, x):
+            return x
+
+    def swap(layer):
+        if isinstance(layer, (nn.Dropout, nn.Dropout2D, nn.Dropout3D,
+                              nn.AlphaDropout)):
+            return _Identity()
+        return None
+
+    return _walk_swap(model, swap)
+
+
+@register_pass("quant_int8")
+class QuantInt8Pass(Pass):
+    """Rewrite QuantedLinear/ConvertedLinear layers into Int8Linear — int8
+    weights AND int8 activations feeding an int8 dot with a dequant epilogue
+    (reference: the int8 pipeline behind quant_conv2d_dequant_fuse_pass /
+    TRT int8 mode).
+
+    Activations quantize PER TOKEN from the live row max (dynamic=True):
+    more accurate than a calibrated static scale, no calibration required.
+    The calibrated scale (when present) is preserved on the layer so
+    reference-style static quant remains one `dynamic=False` away. Layers
+    quantized with w_bits != 8 are skipped with a warning — the int8 MXU
+    path hard-codes 8-bit scales."""
+
+    def apply(self, model: Layer) -> Layer:
+        import warnings
+
+        from ..quantization import Int8Linear, QuantedLinear, ConvertedLinear
+
+        def swap(layer):
+            if isinstance(layer, QuantedLinear):
+                if layer._cfg.w_bits != 8:
+                    warnings.warn(
+                        f"quant_int8: skipping a QuantedLinear with "
+                        f"w_bits={layer._cfg.w_bits} (int8 serving path "
+                        f"requires 8)")
+                    return None
+                return Int8Linear.from_quanted(layer)
+            if isinstance(layer, ConvertedLinear):
+                if layer.bits != 8:
+                    warnings.warn(
+                        f"quant_int8: skipping a ConvertedLinear with "
+                        f"bits={layer.bits}")
+                    return None
+                return Int8Linear.from_converted(layer)
+            return None
+
+        return _walk_swap(model, swap)

@@ -277,9 +277,24 @@ def save(layer, path, input_spec=None, **configs):
     """jit.save analog: <path>.pdmodel = serialized StableHLO export of the traced
     forward; <path>.pdiparams = parameters/buffers.
     Reference: paddle.jit.save → *.pdmodel (ProgramDesc) + *.pdiparams.
+
+    configs["passes"]: ordered pre-lowering pass names
+    (inference/passes.py) applied to the layer IN PLACE before export —
+    the reference runs its pass list at Predictor-load time
+    (paddle_pass_builder.cc); here semantic rewrites (int8 quant, dropout
+    removal) happen before XLA lowers the graph.
     """
     from jax import export as jax_export
     from ..framework import io as fio
+
+    pass_names = configs.pop("passes", None)
+    if pass_names:
+        import copy
+        from ..inference.passes import PassPipeline
+        # rewrite a deep copy: exporting an inference snapshot must not
+        # mutate the caller's live (training) model — the reference runs
+        # its passes on a separate program at Predictor-load time
+        layer = PassPipeline(pass_names).run(copy.deepcopy(layer))
 
     if isinstance(layer, Layer):
         fn = layer.forward if isinstance(layer.forward, (StaticFunction,)) else None
@@ -367,7 +382,14 @@ class TranslatedLayer(Layer):
         super().__init__()
         self._exported = exported
         self._input_specs = input_specs  # [(shape, dtype_str)] from save time
-        self._param_arrays = [p.value() for p in params.values()]
+        # committed to device ONCE — serving must never re-upload weights
+        self._param_arrays = [jax.device_put(p.value())
+                              for p in params.values()]
+        # jit-wrap the exported call: Exported.call rebuilds its calling
+        # convention per invocation (~0.5ms host overhead); the jit cache
+        # turns steady-state dispatch into a hash lookup (~20us)
+        self._call = jax.jit(
+            lambda ps, ins: self._exported.call(ps, ins))
         for name, p in params.items():
             self.add_parameter(name.replace(".", "__"), p)
         for name, b in buffers.items():
@@ -376,7 +398,7 @@ class TranslatedLayer(Layer):
     def forward(self, *inputs):
         arrays = [t.value() if isinstance(t, Tensor) else jnp.asarray(t)
                   for t in inputs]
-        outs = self._exported.call(self._param_arrays, list(arrays))
+        outs = self._call(self._param_arrays, list(arrays))
         outs = [Tensor(o) for o in outs]
         return outs[0] if len(outs) == 1 else tuple(outs)
 

@@ -444,3 +444,19 @@ class LayerDict(Layer):
         for k, v in items:
             self.add_sublayer(k, v)
         return self
+
+
+def swap_sublayers(model: "Layer", fn) -> "Layer":
+    """Rewrite a Layer tree: fn(layer) returns a replacement or None to
+    recurse. The ROOT is offered to fn first — a single-layer model must be
+    replaceable too (pass-framework + quantization share this walker)."""
+    replaced = fn(model)
+    if replaced is not None:
+        return replaced
+    for name, child in list(model.named_children()):
+        new_child = fn(child)
+        if new_child is not None:
+            setattr(model, name, new_child)
+        else:
+            swap_sublayers(child, fn)
+    return model

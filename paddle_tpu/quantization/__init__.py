@@ -23,7 +23,8 @@ from ..core.tensor import Tensor
 from ..nn.layer import Layer
 from ..ops._helpers import _op
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "quant_dequant"]
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "quant_dequant",
+           "Int8Linear"]
 
 
 def _qdq_fwd(x, scale, *, bits=8):
@@ -151,14 +152,85 @@ class ConvertedLinear(Layer):
         return F.linear(x, self._w, self.bias)
 
 
+def _int8_linear_fwd(x, qw, w_scale, *rest, a_scale=1.0, has_bias=False,
+                     dynamic=True):
+    """int8 GEMM with dequant epilogue: quantize the activation on the fly,
+    contract int8×int8 on the MXU (accumulate int32), scale back to the
+    input dtype. XLA fuses the quant/dequant elementwise chains into the
+    GEMM (reference: the TRT/cublasLt int8 path).
+
+    dynamic=True quantizes activations PER TOKEN from the live row max —
+    more accurate than a calibrated static scale and fused by XLA (the
+    TPU-native choice); dynamic=False uses the calibrated a_scale like the
+    reference's static PTQ pipeline."""
+    xf = x.astype(jnp.float32)
+    if dynamic:
+        s_tok = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                            1e-8)
+    else:
+        s_tok = jnp.asarray(a_scale, jnp.float32)
+    xq = jnp.clip(jnp.round(xf * (127.0 / s_tok)), -127, 127) \
+        .astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, qw, (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (s_tok / 127.0) * w_scale
+    if has_bias:
+        out = out + rest[0].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+register_op("int8_linear", _int8_linear_fwd, nondiff_inputs=(1, 2, 3))
+
+
+class Int8Linear(Layer):
+    """Serving-form Linear: int8 weights + int8 activations + int8 MXU dot.
+
+    Produced by the `quant_int8` pre-lowering pass
+    (inference/passes.py) from a calibrated QuantedLinear/ConvertedLinear;
+    the int8 weight and scales are registered BUFFERS so `jit.save` persists
+    them and the Predictor serves the int8 graph directly — closing the
+    reference's quant→serving pipeline (paddle_pass_builder int8 passes).
+    """
+
+    def __init__(self, qweight_i8, w_scale, a_scale: float, bias=None,
+                 bits: int = 8, dynamic: bool = True):
+        super().__init__()
+        assert bits == 8, "int8 serving path"
+        self.register_buffer("qweight", Tensor(jnp.asarray(qweight_i8,
+                                                           jnp.int8)))
+        # w_scale: per-output-channel dequant multiplier (already /qmax)
+        self.register_buffer("w_scale", Tensor(jnp.asarray(w_scale,
+                                                           jnp.float32)))
+        self.a_scale = float(a_scale)
+        self.dynamic = bool(dynamic)  # per-token live scales (see op)
+        self.bias = bias
+
+    @classmethod
+    def from_quanted(cls, quanted: "QuantedLinear") -> "Int8Linear":
+        cfg = quanted._cfg
+        w = quanted._inner.weight.numpy()
+        qmax = 2.0 ** (cfg.w_bits - 1) - 1
+        scale = np.maximum(np.abs(w).max(axis=0, keepdims=True), 1e-8)
+        qw = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+        return cls(qw, (scale / qmax).astype(np.float32),
+                   float(quanted._observer.scale or 1.0),
+                   quanted._inner.bias, bits=cfg.w_bits)
+
+    @classmethod
+    def from_converted(cls, conv: "ConvertedLinear") -> "Int8Linear":
+        return cls(conv.qweight, conv.w_scale, conv.a_scale, conv.bias,
+                   bits=conv.bits)
+
+    def forward(self, x):
+        args = [x, self.qweight, self.w_scale] + \
+            ([self.bias] if self.bias is not None else [])
+        return _op("int8_linear", *args, a_scale=self.a_scale,
+                   has_bias=self.bias is not None, dynamic=self.dynamic)
+
+
 def _swap_layers(model: Layer, fn):
-    for name, child in list(model.named_children()):
-        replaced = fn(child)
-        if replaced is not None:
-            setattr(model, name, replaced)
-        else:
-            _swap_layers(child, fn)
-    return model
+    from ..nn.layer import swap_sublayers
+    return swap_sublayers(model, fn)
 
 
 class QAT:
