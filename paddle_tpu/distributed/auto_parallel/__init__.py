@@ -23,7 +23,90 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "to_static"]
+from .planner import ModelStats, ParallelPlan, Planner  # noqa: F401
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "to_static",
+           "Planner", "ParallelPlan", "ModelStats", "apply_plan"]
+
+
+def apply_plan(model: "Layer", plan: "ParallelPlan", optimizer=None) -> Mesh:
+    """Materialize a planner decision: build the (dp, mp) mesh, shard every
+    parameter's largest mp-divisible dim over the model axis (GSPMD
+    propagates the rest — the reference's completion+partitioner stage), and
+    ZeRO-shard optimizer states over dp when plan.sharding > 1.
+
+    Pipeline degrees need stage structure (PipelineLayer); plans with
+    pp > 1 are the manual/compiled-pipeline path and are rejected here.
+    """
+    if plan.pp != 1:
+        raise NotImplementedError(
+            "apply_plan handles dp/mp/sharding; pp>1 requires PipelineLayer "
+            "stages (distributed.fleet compiled pipeline)")
+    n = plan.dp * plan.mp
+    all_devs = jax.devices()
+    if len(all_devs) < n:
+        raise ValueError(f"plan {plan.degrees} needs {n} devices, "
+                         f"have {len(all_devs)}")
+    devs = np.empty(n, dtype=object)   # object array: Device is not a scalar
+    for i, d in enumerate(all_devs[:n]):
+        devs[i] = d
+    mesh = Mesh(devs.reshape(plan.dp, plan.mp), ("dp", "mp"))
+
+    def spec_with_axis(shape, axis_name, degree, existing=None):
+        """Largest free divisible dim gets the axis; dims already carrying
+        another axis are preserved (ZeRO composes with TP — same rule as
+        fleet meta_optimizers._shard_spec_for)."""
+        spec = [None] * len(shape)
+        if existing is not None:
+            for i, s in enumerate(tuple(existing)[:len(shape)]):
+                spec[i] = s
+        if degree > 1 and not any(axis_name == s for s in spec):
+            free = [i for i in range(len(shape)) if spec[i] is None
+                    and shape[i] % degree == 0 and shape[i] >= degree]
+            if free:
+                spec[max(free, key=lambda i: shape[i])] = axis_name
+        while spec and spec[-1] is None:
+            spec.pop()   # canonical form: P('dp', None) != P('dp') to jit
+        return spec
+
+    zero = optimizer is not None and plan.sharding > 1
+    for _, p in model.named_parameters():
+        arr = p.value()
+        spec = spec_with_axis(arr.shape, "mp", plan.mp)
+        if zero:
+            # fully-sharded (ZeRO-3-style): params take the dp axis too, so
+            # parameter/state placements agree from step 0 — no GSPMD drift,
+            # no second compile (the estimate's 1.5x dp-comm factor covers
+            # the per-step parameter all-gather)
+            spec = spec_with_axis(arr.shape, "dp", plan.dp, existing=spec)
+        p._data = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+    for _, b in model.named_buffers():
+        b._data = jax.device_put(b.value(), NamedSharding(mesh, P()))
+    # the global RNG state rides TrainStep's buffer list: commit it to the
+    # mesh NOW or its step-1 output sharding differs from its input sharding
+    # and every auto run pays a second compile
+    from ...core import random as _random
+    rng_t = _random.rng_state_tensor()
+    rng_t._data = jax.device_put(rng_t.value(), NamedSharding(mesh, P()))
+
+    if zero:
+        optimizer._ensure_all_states()
+        for p in optimizer._parameter_list:
+            pid = id(p)
+            existing = getattr(p.value().sharding, "spec", None)
+            if pid in optimizer._accumulators:
+                st = optimizer._accumulators[pid]
+                for k, arr in st.items():
+                    sp = spec_with_axis(arr.shape, "dp", plan.dp,
+                                        existing if arr.ndim == p.ndim
+                                        else None)
+                    st[k] = jax.device_put(arr, NamedSharding(mesh, P(*sp)))
+            if pid in optimizer._master_weights:
+                mw = optimizer._master_weights[pid]
+                sp = spec_with_axis(mw.shape, "dp", plan.dp, existing)
+                optimizer._master_weights[pid] = jax.device_put(
+                    mw, NamedSharding(mesh, P(*sp)))
+    return mesh
 
 
 class ProcessMesh:
@@ -108,6 +191,43 @@ class Engine:
         self._optimizer = optimizer
         self._metrics = metrics or []
         self._step = None
+        self._plan: Optional[ParallelPlan] = None
+        self._mesh: Optional[Mesh] = None
+        # strategy="auto" (or DistributedStrategy.auto) turns the planner on
+        self._auto = strategy == "auto" or bool(getattr(strategy, "auto", False))
+
+    def prepare(self, *example_inputs, auto: Optional[bool] = None,
+                n_devices: Optional[int] = None) -> Optional[ParallelPlan]:
+        """Plan and apply a parallel strategy before fit (reference
+        Engine.prepare + planner_v2 search). With auto on, searches
+        (dp, mp, sharding) degrees via Planner, applies the winner with
+        apply_plan, and returns it."""
+        if auto is None:
+            auto = self._auto
+        if not auto:
+            return None
+        n = n_devices or jax.device_count()
+        # trace the (model + loss) step the Engine actually runs: the batch
+        # is (inputs..., labels) and the bare model doesn't take labels
+        self._ensure_step()
+        stats = ModelStats.from_model(self._wrapped, *example_inputs)
+        plans = [p for p in Planner().search(stats, n) if p.pp == 1]
+        if not plans:
+            return None
+        self._plan = plans[0]
+        self._mesh = apply_plan(self._model, self._plan, self._optimizer)
+        return self._plan
+
+    def _shard_batch(self, t):
+        """Split the batch over the dp axis (auto mode)."""
+        if self._mesh is None:
+            return t
+        arr = t.value() if isinstance(t, Tensor) else jax.numpy.asarray(t)
+        spec = [None] * arr.ndim
+        if arr.ndim and arr.shape[0] % self._plan.dp == 0:
+            spec[0] = "dp"
+        placed = jax.device_put(arr, NamedSharding(self._mesh, P(*spec)))
+        return Tensor(placed)
 
     def _ensure_step(self):
         if self._step is None:
@@ -128,18 +248,32 @@ class Engine:
             self._step = TrainStep(self._wrapped, self._optimizer)
 
     def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
-            verbose: int = 0):
+            verbose: int = 0, auto: Optional[bool] = None):
         from ...io import DataLoader, Dataset
         loader = (train_data if not isinstance(train_data, Dataset)
                   else DataLoader(train_data, batch_size=batch_size,
                                   shuffle=False))
+        if (auto if auto is not None else self._auto) and self._plan is None:
+            import itertools
+            it = iter(loader)
+            try:
+                first = next(it)
+            except StopIteration:
+                raise ValueError("Engine.fit: empty train_data") from None
+            self.prepare(*first, auto=True)
+            if it is loader:
+                # one-shot iterable (iter(x) is x): put the peeked batch
+                # back so the first batch still trains; re-iterable loaders
+                # restart from batch 0 on the epoch loop anyway
+                loader = itertools.chain([first], it)
         self._ensure_step()
         history = []
         for _ in range(epochs):
             last = None
             for batch in loader:
                 x, y = batch
-                last = float(self._step(x, y))
+                last = float(self._step(self._shard_batch(x),
+                                        self._shard_batch(y)))
             history.append(last)
         return history
 

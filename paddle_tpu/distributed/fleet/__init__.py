@@ -14,6 +14,7 @@ from ..topology import AXES, CommunicateTopology, HybridCommunicateGroup
 from .strategy import DistributedStrategy
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
+from . import auto  # noqa: F401  (fleet.auto: planner + auto-parallel Engine)
 from .meta_optimizers import HybridParallelOptimizer, DygraphShardingOptimizer
 from .recompute import recompute  # noqa: F401
 

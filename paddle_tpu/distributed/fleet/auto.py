@@ -1,0 +1,13 @@
+"""fleet.auto — the auto-parallel surface under fleet (reference:
+`from paddle.distributed.fleet import auto` re-exporting
+python/paddle/distributed/auto_parallel). The planner (degree search over
+the cost model) and Engine live in distributed.auto_parallel; this module
+is the fleet-side name for them.
+"""
+from ..auto_parallel import (  # noqa: F401
+    Engine, ModelStats, ParallelPlan, Planner, ProcessMesh, apply_plan,
+    shard_op, shard_tensor, to_static,
+)
+
+__all__ = ["Engine", "ProcessMesh", "shard_tensor", "shard_op", "to_static",
+           "Planner", "ParallelPlan", "ModelStats", "apply_plan"]

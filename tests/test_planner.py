@@ -1,0 +1,257 @@
+"""Auto-parallel planner tests.
+
+Reference bar (VERDICT missing #4): auto_parallel/planner_v2.py + cost_model
+— the framework must CHOOSE (dp, mp, pp, sharding) degrees, not just accept
+annotations. Validation measures real dryrun steps on the virtual 8-device
+mesh and checks the planner's choice beats naive DP for a model where it
+should (param-dominated), and that batch-dominated models rank DP first.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import (Engine, ModelStats,
+                                                  ParallelPlan, Planner,
+                                                  apply_plan)
+
+
+def test_factorizations_cover_device_count():
+    f = Planner.factorizations(8)
+    assert all(dp * mp * pp == 8 for dp, mp, pp in f)
+    assert (8, 1, 1) in f and (1, 8, 1) in f and (2, 2, 2) in f
+    assert len(set(f)) == len(f)
+
+
+def _stats(fwd_flops=1e12, param_bytes=1e9, act_bytes=1e8, n_blocks=8,
+           batch=64):
+    return ModelStats(fwd_flops=fwd_flops, param_bytes=param_bytes,
+                      act_bytes=act_bytes, n_blocks=n_blocks, batch=batch)
+
+
+def test_param_dominated_model_prefers_mp_or_zero():
+    """Huge params, small activations (large-vocab LM): pure DP pays a huge
+    grad all-reduce every step — the planner must NOT pick plain dp=8."""
+    planner = Planner()
+    ranked = planner.search(_stats(param_bytes=8e9, act_bytes=1e7), 8)
+    best = ranked[0]
+    naive_dp = next(p for p in ranked
+                    if p.degrees == (8, 1, 1, 1))
+    assert best.est_time < naive_dp.est_time
+    assert best.mp > 1 or best.sharding > 1, best
+
+
+def test_activation_dominated_model_prefers_dp():
+    """Small params, huge activations (vision CNN): TP would all-reduce the
+    activations — DP wins."""
+    planner = Planner()
+    ranked = planner.search(_stats(param_bytes=1e8, act_bytes=4e9), 8)
+    best = ranked[0]
+    assert best.mp == 1, best
+    assert best.dp == 8, best
+
+
+def test_memory_limit_forces_sharding():
+    """A model whose optimizer states exceed the per-device limit under pure
+    DP must come back with sharding/mp so it fits."""
+    stats = _stats(param_bytes=4e9, act_bytes=1e7)
+    # pure-DP memory: 2*4e9 + 12e9 ~ 20GB; force a 8GB budget
+    planner = Planner(mem_limit=8e9)
+    ranked = planner.search(stats, 8)
+    assert ranked, "no plan returned"
+    assert all(p.est_mem <= 8e9 for p in ranked)
+    best = ranked[0]
+    assert best.sharding > 1 or best.mp * best.pp > 1
+
+
+def test_pipeline_bubble_penalizes_pp_at_few_microbatches():
+    planner_few = Planner(microbatches=2)
+    planner_many = Planner(microbatches=64)
+    stats = _stats()
+    pp_few = planner_few.estimate(stats, ParallelPlan(dp=1, mp=1, pp=8))
+    pp_many = planner_many.estimate(stats, ParallelPlan(dp=1, mp=1, pp=8))
+    assert pp_few.est_time > pp_many.est_time
+    assert pp_few.breakdown["bubble"] > pp_many.breakdown["bubble"]
+
+
+def test_model_stats_from_gpt_tiny():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.RandomState(0)
+                           .randint(0, 256, (4, 32)).astype("int32"))
+    stats = ModelStats.from_model(model, ids)
+    n_params = sum(int(np.prod(p.shape)) for _, p in model.named_parameters())
+    assert stats.param_bytes == pytest.approx(4 * n_params)
+    # fwd flops at least the block matmuls: 4 layers x qkv/out/fc1/fc2
+    assert stats.fwd_flops > 2 * 4 * 32 * 64 * 64 * 4
+    assert stats.n_blocks >= 4
+    assert stats.batch == 4
+
+
+def _measure_step(step, ids, labels, iters=6):
+    float(step(ids, labels))          # compile
+    float(step(ids, labels))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    float(loss)
+    return (time.perf_counter() - t0) / iters
+
+
+def test_planner_choice_beats_naive_dp_measured():
+    """THE acceptance test (8 virtual devices): a param-dominated GPT (huge
+    vocab, small batch, fused-CE loss path) — the planner's (pp==1) pick
+    must beat measured naive-DP dryrun step time."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    def build():
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=32768, hidden_size=256, num_layers=2,
+                        num_heads=4, max_position_embeddings=16,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                        use_flash_attention=False)
+        m = GPTForCausalLM(cfg)
+        o = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                   parameters=m.parameters())
+        return m, o
+
+    ids_np = np.random.RandomState(0).randint(0, 32768, (8, 16))
+    ids = paddle.to_tensor(ids_np.astype("int32"))
+    labels = paddle.to_tensor(ids_np.astype("int64"))
+
+    # planner prediction from real traced stats (labels => fused lm_head_ce,
+    # so activations stay H-sized and the 33MB embedding dominates)
+    model, opt = build()
+    stats = ModelStats.from_model(model, ids, labels)
+    ranked = [p for p in Planner(microbatches=1).search(stats, 8)
+              if p.pp == 1]
+    chosen = ranked[0]
+    assert chosen.degrees != (8, 1, 1, 1), chosen  # param-dominated: not DP
+
+    # measured: naive DP
+    model_dp, opt_dp = build()
+    mesh = apply_plan(model_dp, ParallelPlan(dp=8, mp=1), opt_dp)
+    step_dp = paddle.jit.TrainStep(model_dp, opt_dp)
+    import jax as _j
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ids_dp = paddle.to_tensor(_j.device_put(
+        ids_np.astype(np.int32), NamedSharding(mesh, P("dp"))))
+    lab_dp = paddle.to_tensor(_j.device_put(
+        ids_np.astype(np.int64), NamedSharding(mesh, P("dp"))))
+    t_dp = _measure_step(step_dp, ids_dp, lab_dp)
+
+    # measured: planner's choice
+    model_c, opt_c = build()
+    mesh_c = apply_plan(model_c, chosen, opt_c)
+    step_c = paddle.jit.TrainStep(model_c, opt_c)
+    spec = [None, None]
+    if chosen.dp > 1:
+        spec[0] = "dp"
+    ids_c = paddle.to_tensor(_j.device_put(
+        ids_np.astype(np.int32), NamedSharding(mesh_c, P(*spec))))
+    lab_c = paddle.to_tensor(_j.device_put(
+        ids_np.astype(np.int64), NamedSharding(mesh_c, P(*spec))))
+    t_c = _measure_step(step_c, ids_c, lab_c)
+
+    assert np.isfinite(t_c) and np.isfinite(t_dp)
+    assert t_c < t_dp * 1.05, (
+        f"planner choice {chosen.degrees} measured {t_c * 1e3:.1f} ms vs "
+        f"naive DP {t_dp * 1e3:.1f} ms")
+
+
+def test_engine_fit_auto():
+    """Engine.fit(auto=True): plans, applies, trains; loss finite and
+    decreasing-ish."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    from paddle_tpu.io import Dataset
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    class Toy(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(128, 32).astype("float32")
+            self.y = rng.randint(0, 8, 128).astype("int64")
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    eng = Engine(model, loss=loss_fn, optimizer=opt, strategy="auto")
+    hist = eng.fit(Toy(), epochs=3, batch_size=32)
+    assert eng._plan is not None
+    assert eng._plan.dp * eng._plan.mp == 8
+    assert np.isfinite(hist).all()
+    assert hist[-1] < hist[0]
+
+
+def test_apply_plan_no_recompile_under_zero():
+    """Review regression: ZeRO placement must not drift (param/state/RNG
+    shardings stable from step 0) — exactly ONE executable for repeated
+    same-shape steps."""
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.auto_parallel import ParallelPlan
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 8))
+    o = paddle.optimizer.AdamW(learning_rate=1e-3,
+                               parameters=m.parameters())
+    apply_plan(m, ParallelPlan(dp=8, mp=1, sharding=8), o)
+
+    class WithLoss(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.m = m
+
+        def forward(self, x, y):
+            return F.mse_loss(self.m(x), y)
+
+    step = paddle.jit.TrainStep(WithLoss(), o)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(16, 64).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(16, 8).astype("float32"))
+    for _ in range(3):
+        assert np.isfinite(float(step(x, y)))
+    assert step.num_compiles == 1, step.num_compiles
+
+
+def test_apply_plan_rejects_too_few_devices():
+    from paddle_tpu.distributed.auto_parallel import ParallelPlan
+    import jax
+    m = nn.Linear(4, 4)
+    with pytest.raises(ValueError, match="devices"):
+        apply_plan(m, ParallelPlan(dp=jax.device_count() * 2, mp=1))
+
+
+def test_candidates_have_no_duplicates():
+    planner = Planner()
+    cands = planner.candidates(8, _stats())
+    degrees = [p.degrees for p in cands]
+    assert len(degrees) == len(set(degrees))
+
+
+def test_fleet_auto_namespace():
+    from paddle_tpu.distributed.fleet import auto
+    assert hasattr(auto, "Planner") and hasattr(auto, "Engine")
+    assert hasattr(auto, "shard_tensor") and hasattr(auto, "ProcessMesh")
