@@ -40,8 +40,160 @@ _REDUCERS = {
 }
 
 
+def _red_np(op):
+    import numpy as np
+    return {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
+            ReduceOp.PROD: np.prod, ReduceOp.AVG: np.sum}[op]
+
+
 def _group_or_default(group) -> Group:
     return group if group is not None else get_group(0)
+
+
+# --------------------------------------------------------- multi-process mode
+#
+# Under a launcher-spawned job (jax.distributed initialized, process_count>1)
+# every rank is its OWN process holding a LOCAL tensor — the reference
+# semantics (python/paddle/distributed/communication/all_reduce.py). The
+# rank-stack dialect below remains the single-controller simulation; this
+# backend handles the real per-process calls: collectives ride
+# jax.experimental.multihost_utils (process_allgather + reduce for the
+# reductions — O(world x bytes) moved per call, fine for eager/debug use;
+# the compiled TrainStep path is the bandwidth-optimal psum), p2p rides the
+# native C++ message bus with endpoints exchanged once at backend init.
+
+def _mp_world() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _mp_mode(group: Optional[Group]) -> bool:
+    if _mp_world() <= 1:
+        return False
+    if group is not None and group.nranks != _mp_world():
+        raise NotImplementedError(
+            "multi-process eager collectives currently support the WORLD "
+            "group; build sub-groups with compiled collectives (mesh axes)")
+    return True
+
+
+class _MPBackend:
+    """Per-process backend: multihost collectives + bus p2p.
+
+    The bus (endpoint exchange + TCP links) initializes EAGERLY at backend
+    construction — i.e. on every rank's FIRST mp-collective call — so the
+    endpoint all-gather is always the first global collective on every rank
+    and can never pair with a different rank's data collective (a lazy
+    exchange inside send/recv could).
+    """
+
+    _instance = None
+
+    def __init__(self):
+        self.rank = jax.process_index()
+        self.world = jax.process_count()
+        self._bus = None
+        self._pending = {}          # src rank -> parked out-of-order arrays
+        self._ensure_bus()
+
+    @classmethod
+    def get(cls) -> "_MPBackend":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    # ------------------------------------------------------- collectives
+
+    def allgather_np(self, arr):
+        """[world, ...] numpy across processes (same local shape on all)."""
+        from jax.experimental import multihost_utils
+        import numpy as np
+        return np.asarray(multihost_utils.process_allgather(
+            np.asarray(arr), tiled=False))
+
+    def barrier(self):
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+    # --------------------------------------------------------------- p2p
+
+    @staticmethod
+    def _my_ip() -> str:
+        """The address peers can reach: PADDLE_BIND_IP when set (must match
+        the bus listener), else the interface that routes toward the jax
+        coordinator (gethostbyname(hostname) maps to 127.0.1.1 on many
+        distros — useless to remote ranks)."""
+        import os
+        import socket as _socket
+        bind_ip = os.environ.get("PADDLE_BIND_IP")
+        if bind_ip:
+            return bind_ip
+        master = os.environ.get("PADDLE_MASTER", "")
+        if ":" in master:
+            host, port = master.rsplit(":", 1)
+            try:
+                with _socket.socket(_socket.AF_INET,
+                                    _socket.SOCK_DGRAM) as s:
+                    s.connect((host, int(port)))  # no traffic; routing only
+                    return s.getsockname()[0]
+            except OSError:
+                pass
+        return _socket.gethostbyname(_socket.gethostname())
+
+    def _ensure_bus(self):
+        if self._bus is not None:
+            return self._bus
+        import numpy as np
+
+        from .fleet_executor.bus import MessageBus
+        bus = MessageBus(self.rank)
+        port = bus.listen(0)
+        ep = f"{self._my_ip()}:{port}".encode()
+        assert len(ep) < 64
+        padded = np.zeros(64, np.uint8)
+        padded[:len(ep)] = np.frombuffer(ep, np.uint8)
+        eps = self.allgather_np(padded)        # [world, 64]
+        bus.open_mailbox(self.rank + 1)
+        for r in range(self.world):
+            raw = bytes(eps[r].tobytes()).rstrip(b"\x00").decode()
+            host, p = raw.rsplit(":", 1)
+            bus.route(r + 1, r)
+            if r != self.rank:
+                bus.connect(r, host, int(p))
+        self._bus = bus
+        return bus
+
+    def send(self, arr, dst: int):
+        import pickle
+
+        import numpy as np
+        bus = self._ensure_bus()
+        a = np.asarray(arr)
+        bus.send(self.rank + 1, dst + 1, 64,
+                 pickle.dumps((a.dtype.str, a.shape, a.tobytes())))
+
+    def recv(self, src: int):
+        import pickle
+
+        import numpy as np
+        q = self._pending.get(src)
+        if q:
+            return q.pop(0)
+        bus = self._ensure_bus()
+        while True:
+            msg = bus.recv(self.rank + 1, timeout_ms=300_000)
+            if msg is None:
+                raise TimeoutError(f"recv from rank {src} timed out")
+            sender_actor, _typ, payload = msg
+            dt, shape, raw = pickle.loads(payload)
+            arr = np.frombuffer(raw, np.dtype(dt)).reshape(shape).copy()
+            s = sender_actor - 1
+            if s == src:
+                return arr
+            # reference recv(src) matches by source; park other senders
+            self._pending.setdefault(s, []).append(arr)
 
 
 def _stack_spec(group: Group, ndim: int) -> P:
@@ -110,8 +262,20 @@ def _jitted(op_key, mesh, axes, op=ReduceOp.SUM, nranks=None):
 
 def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
-    """In the rank-stack view: every slice of dim 0 becomes the reduction of all
-    slices (each rank ends with the reduced value — reference all_reduce)."""
+    """Multi-process mode (launcher jobs): every rank passes its LOCAL tensor
+    and gets the cross-process reduction back — the reference per-process
+    semantics. Single-controller mode: the rank-stack view, where every
+    slice of dim 0 becomes the reduction of all slices."""
+    if _mp_mode(group):
+        be = _MPBackend.get()
+        stacked = be.allgather_np(_unwrap(tensor))
+        red = _red_np(op)(stacked, axis=0)
+        if op == ReduceOp.AVG:
+            red = red / be.world
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(red)
+            return tensor
+        return Tensor(red)
     g = _group_or_default(group)
     x = _unwrap(tensor)
     if g.nranks <= 1:
@@ -157,7 +321,21 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
 
 def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM,
            group: Optional[Group] = None, sync_op: bool = True):
-    """Only the dst slice gets the reduced value; others keep their input."""
+    """Multi-process: rank dst gets the reduction of all LOCAL tensors,
+    others keep theirs. Single-controller: only the dst slice gets the
+    reduced value."""
+    if _mp_mode(group):
+        be = _MPBackend.get()
+        stacked = be.allgather_np(_unwrap(tensor))
+        if be.rank != dst:
+            return tensor
+        red = _red_np(op)(stacked, axis=0)
+        if op == ReduceOp.AVG:
+            red = red / be.world
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(red)
+            return tensor
+        return Tensor(red)
     g = _group_or_default(group)
     x = _unwrap(tensor)
     if g.nranks <= 1:
@@ -178,9 +356,15 @@ def all_gather(tensor_list: Optional[List] = None, tensor=None,
     Call styles (reference parity): all_gather(tensor_list, tensor) appends each
     rank's tensor to tensor_list; all_gather(tensor=t) returns the stacked Tensor.
     """
-    g = _group_or_default(group)
     if tensor is None and tensor_list is not None and not isinstance(tensor_list, list):
         tensor, tensor_list = tensor_list, None
+    if _mp_mode(group):
+        gathered = _MPBackend.get().allgather_np(_unwrap(tensor))
+        if tensor_list is not None:
+            for i in range(gathered.shape[0]):
+                tensor_list.append(Tensor(gathered[i]))
+        return Tensor(gathered)
+    g = _group_or_default(group)
     x = _unwrap(tensor)
     if g.nranks > 1:
         x = _place_on_group(x, g)
@@ -193,7 +377,24 @@ def all_gather(tensor_list: Optional[List] = None, tensor=None,
 
 
 def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
-    """Single-controller: every rank's object is the same python object."""
+    """Multi-process: pickles each rank's object and gathers the real
+    per-rank values. Single-controller: every rank's object is the same
+    python object."""
+    if _mp_mode(group):
+        import pickle
+
+        import numpy as np
+        be = _MPBackend.get()
+        blob = np.frombuffer(pickle.dumps(obj), np.uint8)
+        n = np.asarray([blob.size], np.int64)
+        max_n = int(be.allgather_np(n).max())
+        padded = np.zeros(max_n, np.uint8)
+        padded[:blob.size] = blob
+        sizes = be.allgather_np(n)[:, 0]
+        blobs = be.allgather_np(padded)
+        for r in range(be.world):
+            object_list.append(pickle.loads(blobs[r][:sizes[r]].tobytes()))
+        return object_list
     g = _group_or_default(group)
     object_list.extend([obj] * g.nranks)
     return object_list
@@ -201,7 +402,20 @@ def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
 
 def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
               sync_op: bool = True):
-    """Every slice of dim 0 becomes the src slice."""
+    """Multi-process: every rank's LOCAL tensor becomes rank src's value.
+    Single-controller: every slice of dim 0 becomes the src slice."""
+    if _mp_mode(group):
+        from jax.experimental import multihost_utils
+        import numpy as np
+        be = _MPBackend.get()
+        # one source moves the data once (vs a full allgather)
+        val = multihost_utils.broadcast_one_to_all(
+            np.asarray(_unwrap(tensor)),
+            is_source=(be.rank == src))
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(val)
+            return tensor
+        return Tensor(np.asarray(val))
     g = _group_or_default(group)
     x = _unwrap(tensor)
     if g.nranks <= 1:
@@ -217,8 +431,28 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
 
 def reduce_scatter(tensor, tensor_or_tensor_list=None, op: str = ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op: bool = True):
-    """Input rank-stack [n, n, ...] (dim 0 = source rank, dim 1 = destination
-    chunk); output [n, ...] where slice k = reduction over sources of chunk k."""
+    """Multi-process: each rank passes n local chunks; rank k receives the
+    cross-rank reduction of chunk k. Single-controller: input rank-stack
+    [n, n, ...] (dim 0 = source rank, dim 1 = destination chunk); output
+    [n, ...] where slice k = reduction over sources of chunk k."""
+    if _mp_mode(group):
+        import numpy as np
+        be = _MPBackend.get()
+        src_in = tensor_or_tensor_list if tensor_or_tensor_list is not None \
+            else tensor
+        if isinstance(src_in, (list, tuple)):
+            x = np.stack([np.asarray(_unwrap(t)) for t in src_in], 0)
+        else:
+            x = np.asarray(_unwrap(src_in))
+            x = x.reshape((be.world, x.shape[0] // be.world) + x.shape[1:])
+        gathered = be.allgather_np(x)        # [world, world, chunk...]
+        red = _red_np(op)(gathered[:, be.rank], axis=0)
+        if op == ReduceOp.AVG:
+            red = red / be.world
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(red)
+            return tensor
+        return Tensor(red)
     g = _group_or_default(group)
     src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
     if isinstance(src, (list, tuple)):
@@ -240,8 +474,19 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op: str = ReduceOp.SUM,
 
 def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None,
              sync_op: bool = True):
-    """Rank-stack [n, n, ...]: out[j, i] = in[i, j] (chunk i of rank j ← chunk j of
-    rank i). List form gathers/ scatters python lists for reference parity."""
+    """Multi-process: each rank passes its LOCAL list of n chunks and gets
+    back chunk[rank] from every rank. Single-controller rank-stack
+    [n, n, ...]: out[j, i] = in[i, j]. List form gathers/scatters python
+    lists for reference parity."""
+    if _mp_mode(group):
+        import numpy as np
+        be = _MPBackend.get()
+        x = np.stack([np.asarray(_unwrap(t)) for t in in_tensor_list], 0)
+        gathered = be.allgather_np(x)          # [world, world, ...]
+        outs = [Tensor(gathered[r, be.rank]) for r in range(be.world)]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+        return outs
     g = _group_or_default(group)
     if isinstance(in_tensor_list, (list, tuple)):
         x = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
@@ -262,7 +507,26 @@ def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None
 
 def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[Group] = None, sync_op: bool = True):
-    """src's stack is distributed: slice k of the result is tensor_list[k]."""
+    """Multi-process: rank src's tensor_list is distributed — rank k
+    receives tensor_list[k]. Single-controller: slice k of the result is
+    tensor_list[k]."""
+    if _mp_mode(group):
+        from jax.experimental import multihost_utils
+        import numpy as np
+        be = _MPBackend.get()
+        if be.rank == src:
+            stacked = np.stack([np.asarray(_unwrap(t))
+                                for t in tensor_list], 0)
+        else:
+            base = np.asarray(_unwrap(tensor))
+            stacked = np.zeros((be.world,) + base.shape, base.dtype)
+        full = multihost_utils.broadcast_one_to_all(
+            stacked, is_source=(be.rank == src))
+        val = np.asarray(full)[be.rank]
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(val)
+            return tensor
+        return Tensor(val)
     g = _group_or_default(group)
     if tensor_list is not None:
         x = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
@@ -284,18 +548,27 @@ _mailbox = {}
 
 
 def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True):
-    """Enqueue onto the group's FIFO mailbox, tagged with the destination rank.
-
-    The single controller executes every logical rank's code in one process, so
-    sender identity is not modeled; messages never overwrite each other and are
-    delivered in send order. Real cross-device p2p is the compiled path
-    (ppermute over the pipe axis — fleet/meta_parallel/pp_utils)."""
+    """Multi-process: REAL point-to-point over the native message bus (TCP
+    frames with the job's auth token — reference send over NCCL p2p).
+    Single-controller: enqueue onto the group's FIFO mailbox; sender
+    identity is not modeled, messages are delivered in send order. The
+    compiled p2p path stays ppermute (fleet/meta_parallel/pp_utils)."""
+    if _mp_mode(group):
+        _MPBackend.get().send(_unwrap(tensor), dst)
+        return
     g = _group_or_default(group)
     _mailbox.setdefault(g.id, []).append((dst, _unwrap(tensor)))
 
 
 def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
-    """Pop the oldest pending message in this group (FIFO — see send)."""
+    """Multi-process: blocking matched-by-source receive over the bus.
+    Single-controller: pop the oldest pending message (FIFO — see send)."""
+    if _mp_mode(group):
+        val = _MPBackend.get().recv(src)
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(val)
+            return tensor
+        return Tensor(val)
     g = _group_or_default(group)
     queue = _mailbox.get(g.id)
     if not queue:
@@ -309,7 +582,11 @@ def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = Tr
 
 
 def barrier(group: Optional[Group] = None):
-    """Device-level sync: drain all pending async work."""
+    """Multi-process: a real cross-process barrier; single-controller:
+    device-level sync draining pending async work."""
+    if _mp_mode(group):
+        _MPBackend.get().barrier()
+        return
     (jax.device_put(jnp.zeros(()), jax.devices()[0]) + 0).block_until_ready()
 
 

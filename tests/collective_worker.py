@@ -1,0 +1,115 @@
+"""Rank worker for test_launch_collectives.py — exercises the REAL
+per-process eager collective semantics (reference
+python/paddle/distributed/communication/: each rank passes its LOCAL tensor).
+The same body would run unchanged under the reference framework.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def run_collectives(rank: int, world: int):
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    results = {}
+
+    # all_reduce: local [2, 3] block of rank-dependent values
+    local = np.full((2, 3), float(rank + 1), np.float32)
+    t = paddle.to_tensor(local.copy())
+    dist.all_reduce(t)
+    results["all_reduce"] = t.numpy().tolist()
+    results["all_reduce_want"] = np.full(
+        (2, 3), sum(range(1, world + 1)), np.float32).tolist()
+
+    # all_reduce MAX
+    t = paddle.to_tensor(local.copy())
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    results["all_reduce_max"] = t.numpy().tolist()
+    results["all_reduce_max_want"] = np.full((2, 3), float(world),
+                                             np.float32).tolist()
+
+    # all_gather of per-rank locals
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(
+        np.array([rank * 10.0, rank * 10.0 + 1.0], np.float32)))
+    results["all_gather"] = [g.numpy().tolist() for g in gathered]
+    results["all_gather_want"] = [[r * 10.0, r * 10.0 + 1.0]
+                                  for r in range(world)]
+
+    # broadcast from rank 1
+    t = paddle.to_tensor(np.full(4, float(rank), np.float32))
+    dist.broadcast(t, src=1)
+    results["broadcast"] = t.numpy().tolist()
+    results["broadcast_want"] = [1.0] * 4
+
+    # reduce to dst=0 only
+    t = paddle.to_tensor(np.full(3, float(rank + 1), np.float32))
+    dist.reduce(t, dst=0)
+    results["reduce"] = t.numpy().tolist()
+    results["reduce_want"] = ([float(sum(range(1, world + 1)))] * 3
+                              if rank == 0 else [float(rank + 1)] * 3)
+
+    # scatter from rank 0
+    recv_t = paddle.to_tensor(np.zeros(2, np.float32))
+    chunks = [paddle.to_tensor(np.array([r, r + 0.5], np.float32))
+              for r in range(world)] if rank == 0 else None
+    dist.scatter(recv_t, chunks, src=0)
+    results["scatter"] = recv_t.numpy().tolist()
+    results["scatter_want"] = [float(rank), rank + 0.5]
+
+    # reduce_scatter: each rank passes `world` chunks
+    out_t = paddle.to_tensor(np.zeros(2, np.float32))
+    my_chunks = [paddle.to_tensor(
+        np.array([rank * 10 + k, rank * 10 + k + 0.5], np.float32))
+        for k in range(world)]
+    dist.reduce_scatter(out_t, my_chunks)
+    results["reduce_scatter"] = out_t.numpy().tolist()
+    want = np.zeros(2, np.float32)
+    for r in range(world):
+        want += np.array([r * 10 + rank, r * 10 + rank + 0.5], np.float32)
+    results["reduce_scatter_want"] = want.tolist()
+
+    # alltoall
+    outs = dist.alltoall([paddle.to_tensor(
+        np.array([100 * rank + k], np.float32)) for k in range(world)])
+    results["alltoall"] = [o.numpy().tolist() for o in outs]
+    results["alltoall_want"] = [[100.0 * r + rank] for r in range(world)]
+
+    # all_gather_object with per-rank python objects
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    results["gather_obj_ok"] = objs == [
+        {"rank": r, "tag": "x" * (r + 1)} for r in range(world)]
+
+    # REAL p2p: ring send/recv — rank r sends its value to (r+1) % world
+    payload = np.arange(6, dtype=np.float32).reshape(2, 3) + 100 * rank
+    dist.send(paddle.to_tensor(payload), dst=(rank + 1) % world)
+    got = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    got = dist.recv(got, src=(rank - 1) % world)
+    results["recv"] = got.numpy().tolist()
+    results["recv_want"] = (np.arange(6, dtype=np.float32).reshape(2, 3)
+                            + 100 * ((rank - 1) % world)).tolist()
+
+    dist.barrier()
+    return results
+
+
+def main():
+    out_dir = sys.argv[1]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    results = run_collectives(rank, world)
+    with open(os.path.join(out_dir, f"collectives_{rank}.json"), "w") as f:
+        json.dump(results, f)
+
+
+if __name__ == "__main__":
+    main()
