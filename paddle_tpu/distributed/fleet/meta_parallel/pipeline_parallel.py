@@ -1,25 +1,60 @@
-"""Pipeline-parallel runtime (1F1B).
+"""Pipeline-parallel runtime.
 
-Reference analog: fleet/meta_parallel/pipeline_parallel.py:31 — train_batch splits the
-batch into micro-batches and runs the 1F1B schedule (:117 forward_backward_pipeline:
-warmup forwards, steady 1F1B pairs, cooldown backwards) with p2p send/recv between
-stage processes.
+Reference analog: fleet/meta_parallel/pipeline_parallel.py:31 — train_batch
+splits the batch into micro-batches and runs the 1F1B schedule (:117
+forward_backward_pipeline: warmup forwards, steady 1F1B pairs, cooldown
+backwards) with p2p send/recv between stage processes.
 
-TPU-native: one controller owns every stage; stage boundaries are placement changes
-(pp_layers). jax's async dispatch IS the pipeline: each micro-batch's per-stage ops
-enqueue on that stage's devices and different micro-batches execute concurrently on
-different stages — the interleaving the reference schedules by hand emerges from data
-dependencies. The 1F1B ordering is kept (forward i+1 issued before backward i) so the
-dispatch queue exposes the same concurrency and peak-memory profile.
+TPU-native: ONE pipeline stack. When the PipelineLayer's body is a run of
+identical shape-preserving blocks (transformer stacks are), `train_batch`
+routes through the COMPILED ring schedule (compiled_pipeline.py: shard_map +
+ppermute over the pipe axis, the whole fill/steady/drain schedule in one XLA
+executable) — prologue (embedding) and epilogue (norm/head/loss) compile into
+the same executable, and the backward pipeline falls out of jax.grad
+reversing the scan+permutes. When the body is irregular, train_batch falls
+back to a sequential per-microbatch loop with gradient accumulation — which
+is NOT a 1F1B schedule and overlaps nothing; it is the correctness fallback,
+the compiled ring is the performance path.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....core import dispatch
 from ....core.tensor import Tensor
 from ....nn.layer import Layer
 from .pp_layers import PipelineLayer
 from .wrappers import InnerLayerDelegate
+
+
+def _param_signature(layer: Layer) -> Tuple:
+    return (type(layer).__name__,
+            tuple((name, tuple(p.shape), str(p.dtype))
+                  for name, p in layer.named_parameters()))
+
+
+def _functional_apply(layers: List[Layer], params: List, arrays, x):
+    """Run `layers` with `arrays` substituted for their parameters — pure, so
+    it can live inside jit/shard_map (TrainStep's trace trick)."""
+    saved = [p._data for p in params]
+    ctx = dispatch.TraceContext()
+    dispatch.push_trace(ctx)
+    try:
+        for p, a in zip(params, arrays):
+            p._data = a
+        t = Tensor(x)
+        for l in layers:
+            t = l(t)
+        return t.value()
+    finally:
+        dispatch.pop_trace()
+        ctx.restore()
+        for p, d in zip(params, saved):
+            p._data = d
 
 
 class PipelineParallel(InnerLayerDelegate, Layer):
@@ -36,9 +71,211 @@ class PipelineParallel(InnerLayerDelegate, Layer):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.total_loss = None
+        self._ring = None           # (jitted loss_and_grad, metadata)
+        self._ring_checked = False
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    # ------------------------------------------------------- compiled route
+
+    def _find_ring(self):
+        """Locate the longest contiguous run of structurally identical
+        parameterized layers whose count is a multiple of the stage count —
+        the ring body; everything before is the prologue, after the epilogue.
+        Returns None when the model shape doesn't admit the compiled ring."""
+        from ...env import get_mesh
+        mesh = get_mesh()
+        S = self._layers._num_stages
+        if mesh is None or "pipe" not in mesh.axis_names or S <= 1 \
+                or mesh.shape["pipe"] != S:
+            return None
+        seq = list(self._layers.run_function)
+        sigs = [_param_signature(l) for l in seq]
+        best = (0, 0)                    # (start, length)
+        i = 0
+        while i < len(seq):
+            if not sigs[i][1]:           # parameterless: cannot anchor a run
+                i += 1
+                continue
+            j = i
+            while j < len(seq) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1]:
+                best = (i, j - i)
+            i = j
+        start, length = best
+        L = (length // S) * S            # ring takes a stage-divisible count
+        if L < S or L == 0:
+            return None
+        # the ring bakes buffers/RNG state in as constants (unlike TrainStep,
+        # which threads buffer updates through the executable): models with
+        # live dropout or stateful buffers (BN) must keep the eager fallback
+        # or dropout masks would repeat every step
+        from .... import nn as _nn
+        drop_types = tuple(t for t in (
+            getattr(_nn, "Dropout", None), getattr(_nn, "Dropout2D", None),
+            getattr(_nn, "Dropout3D", None),
+            getattr(_nn, "AlphaDropout", None)) if t is not None)
+
+        def _ring_safe(layer):
+            for sub in [layer] + [l for _, l in layer.named_sublayers()]:
+                if isinstance(sub, drop_types) and float(
+                        getattr(sub, "p", getattr(sub, "_p", 0))) > 0:
+                    return False
+                if list(sub.named_buffers()):
+                    return False
+            return True
+
+        if not all(_ring_safe(l) for l in self._layers.run_function):
+            return None
+        # keep trailing extras in the epilogue
+        return start, L, S
+
+    def _build_ring(self):
+        """Compile (prologue -> ring -> epilogue -> loss) into one
+        value_and_grad executable over (ring, prologue, epilogue) params."""
+        found = self._find_ring()
+        if found is None:
+            return None
+        from .compiled_pipeline import pipeline_apply
+        from ...env import get_mesh
+        start, L, S = found
+        mesh = get_mesh()
+        V = L // S
+        seq = list(self._layers.run_function)
+        blocks = seq[start:start + L]
+        prologue = seq[:start]
+        epilogue = seq[start + L:]
+        loss_fn = self._layers._loss_fn
+
+        template = blocks[0]
+        tmpl_params = [p for _, p in template.named_parameters()]
+
+        def collect(layers):
+            seen, out = set(), []
+            for l in layers:
+                for _, p in l.named_parameters():
+                    if id(p) not in seen:       # tied weights appear once
+                        seen.add(id(p))
+                        out.append(p)
+            return out
+
+        pro_params = collect(prologue)
+        epi_params = collect(epilogue)
+
+        def stage_fn(w_leaves, x):
+            return _functional_apply([template], tmpl_params, w_leaves, x)
+
+        def full_loss(ring_w, pro_w, epi_w, xs, labels):
+            # xs: [M, mb, ...] raw microbatches
+
+            def pro_one(x):
+                return _functional_apply(prologue, pro_params, pro_w, x)
+
+            h = jax.vmap(pro_one)(xs) if prologue else xs
+            h = pipeline_apply(tuple(ring_w), h, stage_fn, mesh, "pipe", V)
+
+            def epi_one(hm, lm):
+                out = _functional_apply(epilogue, epi_params, epi_w, hm)
+                if loss_fn is not None:
+                    if lm is None:
+                        return loss_fn(Tensor(out)).value()
+                    return loss_fn(Tensor(out), Tensor(lm)).value()
+                if int(np.prod(out.shape)) != 1:
+                    raise ValueError(
+                        "pipeline model must end in a scalar loss or define "
+                        "loss_fn (reference: same requirement)")
+                return out.reshape(())
+
+            if labels is not None:
+                losses = jax.vmap(epi_one)(h, labels)
+                return jnp.mean(losses)
+            return jnp.mean(jax.vmap(lambda hm: epi_one(hm, None))(h))
+
+        jitted = jax.jit(jax.value_and_grad(full_loss, argnums=(0, 1, 2)))
+        block_params = [[p for _, p in blk.named_parameters()]
+                        for blk in blocks]
+        meta = dict(blocks=blocks, tmpl_params=tmpl_params,
+                    block_params=block_params,
+                    pro_params=pro_params, epi_params=epi_params, L=L, S=S)
+        return jitted, meta
+
+    def _try_ring(self):
+        if not self._ring_checked:
+            self._ring_checked = True
+            try:
+                self._ring = self._build_ring()
+            except Exception:
+                self._ring = None
+        return self._ring
+
+    def _ring_step(self, inputs, labels, optimizer, scaler):
+        jitted, meta = self._ring
+        n = self.accumulate_steps
+        x = inputs.value() if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        lab = labels.value() if isinstance(labels, Tensor) else \
+            (jnp.asarray(labels) if labels is not None else None)
+        b = x.shape[0]
+        if b % n != 0:
+            raise ValueError(f"batch {b} not divisible by accumulate_steps {n}")
+        xs = x.reshape((n, b // n) + x.shape[1:])
+        ls = lab.reshape((n, b // n) + lab.shape[1:]) if lab is not None else None
+
+        if meta["L"] > meta["S"] and n < meta["S"]:
+            raise ValueError(
+                f"interleaved ring needs accumulate_steps >= stages "
+                f"({meta['S']}); got {n} (reference: micro-batches >= stages)")
+        # refresh stacked weights from the live parameters (optimizer steps
+        # mutate them between batches). Stack on HOST: per-stage params live
+        # on disjoint submeshes and device-side stack would be cross-device.
+        stacked = []
+        for k in range(len(meta["tmpl_params"])):
+            stacked.append(jnp.asarray(np.stack(
+                [np.asarray(bp[k].value()) for bp in meta["block_params"]],
+                axis=0)))
+        # prologue/epilogue params live on different stage submeshes
+        # (pp_layers._place_stages); one jit needs a consistent device set,
+        # so hand them over uncommitted (host) and let GSPMD place them
+        pro_w = [np.asarray(p.value()) for p in meta["pro_params"]]
+        epi_w = [np.asarray(p.value()) for p in meta["epi_params"]]
+
+        loss, (g_ring, g_pro, g_epi) = jitted(tuple(stacked), pro_w, epi_w,
+                                              xs, ls)
+        # scatter grads back onto the real Parameters — re-placed onto each
+        # param's own (stage-submesh) sharding so the optimizer's fused
+        # update sees matching device sets; then step exactly as in eager
+        def land(p, g):
+            sh = getattr(p.value(), "sharding", None)
+            if sh is not None:
+                g = jax.device_put(np.asarray(g), sh)
+            p._accumulate_grad(g)
+
+        with dispatch.no_grad():
+            for k, g in enumerate(g_ring):
+                for bi, bp in enumerate(meta["block_params"]):
+                    land(bp[k], g[bi])
+            for p, g in zip(meta["pro_params"], g_pro):
+                land(p, g)
+            for p, g in zip(meta["epi_params"], g_epi):
+                land(p, g)
+        if scaler is not None:
+            # the ring computes loss/grads in full precision (no fp16
+            # scaling needed), but the scaler's found_inf contract still
+            # holds: skip the step when any grad is non-finite
+            flat = jax.tree_util.tree_leaves((g_ring, g_pro, g_epi))
+            finite = bool(jnp.all(jnp.stack(
+                [jnp.all(jnp.isfinite(g)) for g in flat])))
+            scaler._found_inf = not finite
+            if finite:
+                optimizer.step()
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        return Tensor(loss)
+
+    # ----------------------------------------------------------- train/eval
 
     def _split_micro(self, data):
         """Split [B, ...] into accumulate_steps micro-batches along dim 0."""
@@ -60,11 +297,25 @@ class PipelineParallel(InnerLayerDelegate, Layer):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """reference pipeline_parallel.py:228 — returns the averaged loss."""
         self._layers.train()
+        if self._try_ring() is not None:
+            inputs, labels = data if isinstance(data, (tuple, list)) \
+                else (data, None)
+            try:
+                loss = self._ring_step(inputs, labels, optimizer, scaler)
+            except ValueError:
+                # trace-time shape/contract failure (jit compiles lazily at
+                # the first call): permanently fall back to the eager loop,
+                # which re-raises genuine model errors with the right message
+                self._ring = None
+            else:
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
         micros = self._split_micro(data)
         n = len(micros)
         total = None
-        # 1F1B emerges from async dispatch; python-side we issue fwd/bwd per micro
-        # in order, gradients accumulate across micro-batches on the tape
+        # correctness fallback: sequential per-microbatch fwd+bwd with grad
+        # accumulation (no stage overlap — the ring above is the fast path)
         for inputs, labels in micros:
             loss = self._forward_step(inputs, labels)
             scaled = loss * (1.0 / n)
