@@ -68,11 +68,32 @@ def main():
 
     tokens_per_sec = batch * seq * iters / dt
     assert np.isfinite(final), f"loss diverged: {final}"
+
+    # ---- MFU accounting (absolute FLOPs vs hardware peak)
+    # matmul params only: 12*L*d^2 block weights + the tied lm-head
+    # projection (embedding GATHERS are not matmul FLOPs and stay out)
+    n_block = 12 * cfg.num_layers * cfg.hidden_size ** 2
+    # fwd+bwd = 6 FLOPs/param/token on matmul params (incl. the tied lm-head
+    # projection = vocab*d) + attention dots 12*L*d*S per token
+    flops_per_token = 6.0 * (n_block + cfg.vocab_size * cfg.hidden_size) \
+        + 12.0 * cfg.num_layers * cfg.hidden_size * seq
+    model_tflops = tokens_per_sec * flops_per_token / 1e12
+    peak = {"TPU v5 lite": 197e12, "TPU v4": 275e12,
+            "TPU v5p": 459e12, "TPU v6 lite": 918e12}
+    kind = jax.devices()[0].device_kind
+    peak_flops = next((v for k, v in peak.items() if kind.startswith(k)),
+                      None)
+    # unknown chip: report mfu null rather than a confidently wrong number
+    mfu = (round(model_tflops * 1e12 / peak_flops, 3)
+           if peak_flops else None)
     print(json.dumps({
         "metric": "gpt_medium_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / REF_TOKENS_PER_SEC, 3),
+        "model_tflops": round(model_tflops, 1),
+        "mfu": mfu,
+        "device_kind": kind,
     }))
 
 

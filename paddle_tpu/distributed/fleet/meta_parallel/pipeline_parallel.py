@@ -119,10 +119,18 @@ class PipelineParallel(InnerLayerDelegate, Layer):
             getattr(_nn, "AlphaDropout", None)) if t is not None)
 
         def _ring_safe(layer):
+            drop_attrs = ("p", "_p", "dropout", "dropout_p", "attn_dropout",
+                          "dropout_rate", "dropout_prob", "drop_rate")
             for sub in [layer] + [l for _, l in layer.named_sublayers()]:
                 if isinstance(sub, drop_types) and float(
                         getattr(sub, "p", getattr(sub, "_p", 0))) > 0:
                     return False
+                # functional dropout: layers stash the rate as a float attr
+                # (MultiHeadAttention.dropout etc.) and draw RNG per call
+                for a in drop_attrs:
+                    v = getattr(sub, a, None)
+                    if isinstance(v, float) and v > 0:
+                        return False
                 if list(sub.named_buffers()):
                     return False
             return True
@@ -222,23 +230,25 @@ class PipelineParallel(InnerLayerDelegate, Layer):
         xs = x.reshape((n, b // n) + x.shape[1:])
         ls = lab.reshape((n, b // n) + lab.shape[1:]) if lab is not None else None
 
-        if meta["L"] > meta["S"] and n < meta["S"]:
-            raise ValueError(
-                f"interleaved ring needs accumulate_steps >= stages "
-                f"({meta['S']}); got {n} (reference: micro-batches >= stages)")
-        # refresh stacked weights from the live parameters (optimizer steps
-        # mutate them between batches). Stack on HOST: per-stage params live
-        # on disjoint submeshes and device-side stack would be cross-device.
+        # refresh weights from the live parameters (optimizer steps mutate
+        # them between batches). Per-stage params live on disjoint
+        # submeshes; re-place them REPLICATED over the full mesh
+        # (device-side reshard, no host roundtrip) so one jit sees a
+        # consistent device set.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...env import get_mesh
+        rep = NamedSharding(get_mesh(), P())
+
+        def to_full(arr):
+            return jax.device_put(arr, rep)
+
         stacked = []
         for k in range(len(meta["tmpl_params"])):
-            stacked.append(jnp.asarray(np.stack(
-                [np.asarray(bp[k].value()) for bp in meta["block_params"]],
-                axis=0)))
-        # prologue/epilogue params live on different stage submeshes
-        # (pp_layers._place_stages); one jit needs a consistent device set,
-        # so hand them over uncommitted (host) and let GSPMD place them
-        pro_w = [np.asarray(p.value()) for p in meta["pro_params"]]
-        epi_w = [np.asarray(p.value()) for p in meta["epi_params"]]
+            stacked.append(jnp.stack(
+                [to_full(bp[k].value()) for bp in meta["block_params"]],
+                axis=0))
+        pro_w = [to_full(p.value()) for p in meta["pro_params"]]
+        epi_w = [to_full(p.value()) for p in meta["epi_params"]]
 
         loss, (g_ring, g_pro, g_epi) = jitted(tuple(stacked), pro_w, epi_w,
                                               xs, ls)
@@ -248,7 +258,7 @@ class PipelineParallel(InnerLayerDelegate, Layer):
         def land(p, g):
             sh = getattr(p.value(), "sharding", None)
             if sh is not None:
-                g = jax.device_put(np.asarray(g), sh)
+                g = jax.device_put(g, sh)   # device-side reshard
             p._accumulate_grad(g)
 
         with dispatch.no_grad():
@@ -259,14 +269,17 @@ class PipelineParallel(InnerLayerDelegate, Layer):
                 land(p, g)
             for p, g in zip(meta["epi_params"], g_epi):
                 land(p, g)
-        if scaler is not None:
+        if scaler is not None and getattr(scaler, "_enable", True):
             # the ring computes loss/grads in full precision (no fp16
-            # scaling needed), but the scaler's found_inf contract still
-            # holds: skip the step when any grad is non-finite
+            # scaling needed), but an ENABLED scaler's found_inf contract
+            # still holds: skip the step when any grad is non-finite.
+            # A disabled scaler (bf16 default) never gates the step and
+            # pays no per-step finiteness sync.
             flat = jax.tree_util.tree_leaves((g_ring, g_pro, g_epi))
             finite = bool(jnp.all(jnp.stack(
                 [jnp.all(jnp.isfinite(g)) for g in flat])))
             scaler._found_inf = not finite
+            scaler._cache_founf_inf = not finite  # reference attr name (sic)
             if finite:
                 optimizer.step()
             scaler.update()
@@ -298,14 +311,27 @@ class PipelineParallel(InnerLayerDelegate, Layer):
         """reference pipeline_parallel.py:228 — returns the averaged loss."""
         self._layers.train()
         if self._try_ring() is not None:
+            _, meta = self._ring
+            n = self.accumulate_steps
+            if meta["L"] > meta["S"] and n < meta["S"]:
+                # deliberate config diagnostic: must reach the user, not the
+                # fallback swallow below
+                raise ValueError(
+                    f"interleaved ring needs accumulate_steps >= stages "
+                    f"({meta['S']}); got {n} (reference: micro-batches >= "
+                    f"stages)")
             inputs, labels = data if isinstance(data, (tuple, list)) \
                 else (data, None)
             try:
                 loss = self._ring_step(inputs, labels, optimizer, scaler)
-            except ValueError:
+            except (ValueError, TypeError) as e:
                 # trace-time shape/contract failure (jit compiles lazily at
-                # the first call): permanently fall back to the eager loop,
-                # which re-raises genuine model errors with the right message
+                # the first call; jax raises TypeError for tracer leaks):
+                # permanently fall back to the eager loop, which re-raises
+                # genuine model errors with the right message
+                import warnings
+                warnings.warn(f"compiled ring disabled, using the eager "
+                              f"fallback (no stage overlap): {e}")
                 self._ring = None
             else:
                 if lr_scheduler is not None:

@@ -138,14 +138,18 @@ class BertForPreTraining(nn.Layer):
                 masked_lm_labels=None, next_sentence_labels=None):
         seq_out, pooled = self.bert(input_ids, token_type_ids, attn_mask)
         x = self.transform_norm(F.gelu(self.transform(seq_out)))
-        mlm_logits = ops.matmul(x, self.bert.embeddings.word_embeddings.weight,
-                                transpose_y=True) + self.decoder_bias
         nsp_logits = self.nsp_head(pooled)
         if masked_lm_labels is None:
+            mlm_logits = ops.matmul(
+                x, self.bert.embeddings.word_embeddings.weight,
+                transpose_y=True) + self.decoder_bias
             return mlm_logits, nsp_logits
-        mlm_loss = F.cross_entropy(
-            mlm_logits.reshape([-1, self.config.vocab_size]),
-            masked_lm_labels.reshape([-1]), ignore_index=-100)
+        # fused head+CE (gpt.py lm_head_ce): the [B,S,V] fp32 logits never
+        # materialize on the loss path — at BERT-base that's a 2GB tensor
+        from ..ops._helpers import _op
+        mlm_loss = _op("lm_head_ce", x, self.bert.embeddings.word_embeddings
+                       .weight, masked_lm_labels, self.decoder_bias,
+                       transpose_w=True, has_bias=True)
         loss = mlm_loss
         if next_sentence_labels is not None:
             loss = loss + F.cross_entropy(nsp_logits,

@@ -27,10 +27,11 @@ from ..core.dispatch import register_op
 from ..ops._helpers import _op
 
 
-def _lm_head_ce_fwd(hidden, weight, labels, transpose_w=True, ignore_index=-100):
+def _lm_head_ce_fwd(hidden, weight, labels, *rest, transpose_w=True,
+                    ignore_index=-100, has_bias=False):
     """Fused LM-head + next-token CE: hidden [B,S,H] (pre-shifted), weight
     [V,H] (tied embedding) or [H,V], labels [B,S] → scalar mean loss over
-    non-ignored tokens.
+    non-ignored tokens. Optional trailing bias [V] (BERT's MLM decoder).
 
     One executable computes matmul → logsumexp → label-gather; the [B,S,V]
     logits never round-trip HBM in fp32 and no log-softmax tensor is formed
@@ -39,6 +40,8 @@ def _lm_head_ce_fwd(hidden, weight, labels, transpose_w=True, ignore_index=-100)
     dims = (((2,), (1,)), ((), ())) if transpose_w else (((2,), (0,)), ((), ()))
     logits = jax.lax.dot_general(hidden, weight, dims,
                                  preferred_element_type=jnp.float32)
+    if has_bias:
+        logits = logits + rest[0].astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     lbl = labels.astype(jnp.int32)
     valid = lbl != ignore_index
