@@ -8,7 +8,7 @@ collectives over ICI/DCN instead of NCCL.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 # Multi-host bootstrap MUST precede any XLA-backend touch (jax.distributed rule),
 # and importing the core modules below initializes the backend — so when the

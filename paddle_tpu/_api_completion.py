@@ -163,21 +163,32 @@ def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
 
 
 register_op("quantile_op", lambda x, *, q=0.5, axis=None, keepdim=False,
-            nan_aware=False:
+            nan_aware=False, method="linear":
             (jnp.nanquantile if nan_aware else jnp.quantile)(
-                x, q, axis=axis, keepdims=keepdim))
+                x, q, axis=axis, keepdims=keepdim, method=method))
+
+_QUANTILE_METHODS = ("linear", "lower", "higher", "nearest", "midpoint")
 
 
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    if interpolation not in _QUANTILE_METHODS:
+        raise ValueError(f"interpolation must be one of {_QUANTILE_METHODS}, "
+                         f"got {interpolation!r}")
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
     return _op("quantile_op", x, q=float(q) if np.isscalar(q) else tuple(q),
-               axis=ax, keepdim=keepdim, nan_aware=False)
+               axis=ax, keepdim=keepdim, nan_aware=False,
+               method=str(interpolation))
 
 
-def nanquantile(x, q, axis=None, keepdim=False, name=None):
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    if interpolation not in _QUANTILE_METHODS:
+        raise ValueError(f"interpolation must be one of {_QUANTILE_METHODS}, "
+                         f"got {interpolation!r}")
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
     return _op("quantile_op", x, q=float(q) if np.isscalar(q) else tuple(q),
-               axis=ax, keepdim=keepdim, nan_aware=True)
+               axis=ax, keepdim=keepdim, nan_aware=True,
+               method=str(interpolation))
 
 
 register_op("nanmedian_op", lambda x, *, axis=None, keepdim=False:
@@ -326,17 +337,67 @@ def batch(reader, batch_size, drop_last=False):
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False) -> int:
-    """Rough FLOPs count over Linear/Conv2D (reference paddle.flops)."""
-    from .nn import Conv2D, Linear
-    total = 0
-    for _, layer in [("", net)] + list(net.named_sublayers()):
-        if isinstance(layer, Linear):
-            total += 2 * int(np.prod(layer.weight.shape))
-        elif isinstance(layer, Conv2D):
-            w = layer.weight
-            total += 2 * int(np.prod(w.shape))
-    batch_elems = int(np.prod(input_size[:1])) if input_size else 1
-    return total * max(batch_elems, 1)
+    """FLOPs for one forward pass at input_size (reference paddle.flops).
+
+    Counted by TRACING the real forward — jaxpr dot/conv dimension math via
+    the cost model — so attention, embeddings and every composed op are
+    included (a per-layer-type table would miss them).
+
+    custom_ops deviates from the reference's forward-hook contract
+    (fn(module, input, output) REPLACING the default count): here each
+    {LayerType: fn(layer) -> flops} entry ADDS host-side extras per matching
+    sublayer on top of the traced total (the trace already counts every
+    matmul/conv, so replacement is neither needed nor possible)."""
+    from .cost_model import CostModel
+    from .core import dispatch
+    from .core.tensor import Tensor as _T
+    from .nn import Embedding as _Emb
+
+    shape = tuple(int(s) for s in input_size)
+    was_training = net.training
+    net.eval()
+
+    def fwd(arr):
+        ctx = dispatch.TraceContext()
+        dispatch.push_trace(ctx)
+        try:
+            out = net(_T(arr))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o.value() for o in outs if o is not None)
+        finally:
+            dispatch.pop_trace()
+            ctx.restore()
+
+    # probe dtype: models containing an Embedding take token ids;
+    # float otherwise
+    int_first = any(isinstance(l, _Emb)
+                    for _, l in net.named_sublayers())
+    dtypes_to_try = (np.int32, np.float32) if int_first \
+        else (np.float32, np.int32)
+    try:
+        rows = None
+        first_err = None
+        for dt in dtypes_to_try:
+            try:
+                rows, _ = CostModel().static_cost(fwd, np.zeros(shape, dt))
+                break
+            except Exception as e:
+                first_err = first_err or e
+        if rows is None:
+            raise first_err   # surface the ORIGINAL model error
+    finally:
+        if was_training:
+            net.train()
+    total = int(sum(r.flops for r in rows
+                    if r.op in ("dot_general", "conv_general_dilated")))
+    if custom_ops:
+        for _, layer in [("", net)] + list(net.named_sublayers()):
+            fn = custom_ops.get(type(layer))
+            if fn is not None:
+                total += int(fn(layer))
+    if print_detail:
+        print(CostModel().summary(rows))
+    return total
 
 
 # ------------------------------------------------- Tensor method completion

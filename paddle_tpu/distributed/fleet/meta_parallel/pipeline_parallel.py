@@ -322,6 +322,11 @@ class PipelineParallel(InnerLayerDelegate, Layer):
                     f"stages)")
             inputs, labels = data if isinstance(data, (tuple, list)) \
                 else (data, None)
+            if inputs.shape[0] % n != 0:
+                # genuine config/data error — same message the eager path
+                # raises; must NOT permanently disable the ring below
+                raise ValueError(f"batch {inputs.shape[0]} not divisible "
+                                 f"by accumulate_steps {n}")
             try:
                 loss = self._ring_step(inputs, labels, optimizer, scaler)
             except (ValueError, TypeError) as e:

@@ -1,9 +1,9 @@
 """paddle.version — build metadata (reference: generated version module)."""
 from __future__ import annotations
 
-full_version = "0.1.0"
+full_version = "0.3.0"
 major = "0"
-minor = "1"
+minor = "3"
 patch = "0"
 rc = "0"
 istaged = False

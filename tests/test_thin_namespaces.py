@@ -1,0 +1,135 @@
+"""Behavior tests for the round-2 'namespace parity != capability' modules
+(VERDICT weak #3/#6): signal stft/istft round-trip, text viterbi_decode vs a
+hand-computed example, hub local-repo load, flops() vs analytic counts,
+quantile interpolation modes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_stft_istft_round_trip():
+    """istft(stft(x)) == x on the interior (COLA-satisfying hann window)."""
+    import paddle_tpu.signal as signal
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2048).astype("float32")
+    n_fft, hop = 256, 64
+    win = paddle.to_tensor(np.hanning(n_fft + 1)[:-1].astype("float32"))
+    spec = signal.stft(paddle.to_tensor(x), n_fft=n_fft, hop_length=hop,
+                       window=win, center=True)
+    back = signal.istft(spec, n_fft=n_fft, hop_length=hop, window=win,
+                        center=True, length=2048).numpy()
+    # interior samples reconstruct; edges lose window overlap
+    np.testing.assert_allclose(back[:, n_fft:-n_fft], x[:, n_fft:-n_fft],
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_stft_matches_numpy_reference():
+    import paddle_tpu.signal as signal
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(512).astype("float32")
+    n_fft, hop = 128, 32
+    win = np.hanning(n_fft + 1)[:-1].astype("float32")
+    spec = signal.stft(paddle.to_tensor(x[None]), n_fft=n_fft,
+                       hop_length=hop, window=paddle.to_tensor(win),
+                       center=False).numpy()[0]
+    # numpy reference frame-by-frame
+    frames = (len(x) - n_fft) // hop + 1
+    want = np.stack([np.fft.rfft(x[i * hop:i * hop + n_fft] * win)
+                     for i in range(frames)], axis=-1)
+    np.testing.assert_allclose(spec, want, atol=1e-3)
+
+
+def test_viterbi_decode_hand_example():
+    """2-step, 2-tag HMM decoded by hand."""
+    import paddle_tpu.text as text
+
+    # emissions [B=1, T=2, K=2]; transitions [K, K] (trans[i, j]: i -> j)
+    emis = np.array([[[1.0, 0.0], [0.0, 1.5]]], "float32")
+    trans = np.array([[0.0, -10.0], [0.0, 0.0]], "float32")
+    lengths = np.array([2], "int64")
+    scores, path = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=False)
+    # paths: start tag0 (1.0 > 0.0); tag0->tag1 costs -10, so best is
+    # 0 -> 0? score(0,0)=1+0+0=1; (0,1)=1-10+1.5=-7.5; (1,1)=0+0+1.5=1.5
+    # -> best path [1, 1] with score 1.5
+    assert path.numpy().ravel().tolist() == [1, 1]
+    np.testing.assert_allclose(scores.numpy().ravel(), [1.5], atol=1e-6)
+
+
+def test_hub_local_repo_load(tmp_path):
+    """hub.load from a local directory with hubconf.py (reference
+    paddle.hub source='local')."""
+    repo = tmp_path / "myrepo"
+    repo.mkdir()
+    (repo / "hubconf.py").write_text(
+        "dependencies = []\n"
+        "def tiny_model(out_features=3):\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(4, out_features)\n")
+    models = paddle.hub.list(str(repo), source="local")
+    assert "tiny_model" in models
+    m = paddle.hub.load(str(repo), "tiny_model", source="local",
+                        out_features=5)
+    assert list(m.weight.shape) == [4, 5]
+    doc = paddle.hub.help(str(repo), "tiny_model", source="local")
+    assert doc is None or isinstance(doc, str)
+
+
+def test_flops_gpt_tiny_within_5pct_of_analytic():
+    """flops() must count attention + lm-head, not just Linear/Conv."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    V, d, L, S, H = 128, 64, 2, 16, 4
+    cfg = GPTConfig(vocab_size=V, hidden_size=d, num_layers=L, num_heads=H,
+                    max_position_embeddings=S, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    got = paddle.flops(model, [1, S])
+    # analytic (fwd, batch 1): blocks 2*12*L*d^2 per token + attention dots
+    # 2*2*L*S*d per token + lm head 2*V*d per token
+    per_tok = 2 * 12 * L * d * d + 4 * L * S * d + 2 * V * d
+    want = per_tok * S
+    assert abs(got - want) / want < 0.05, (got, want)
+
+
+def test_flops_linear_and_custom_ops():
+    m = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                             paddle.nn.Linear(16, 4))
+    got = paddle.flops(m, [2, 8])
+    want = 2 * (2 * 8 * 16 + 2 * 16 * 4)      # batch 2
+    assert abs(got - want) / want < 0.01
+    got2 = paddle.flops(m, [2, 8],
+                        custom_ops={paddle.nn.ReLU: lambda l: 1000})
+    assert got2 == got + 1000
+
+
+def test_flops_restores_training_mode():
+    """Review regression: flops() must not leave the model in eval mode."""
+    m = paddle.nn.Sequential(paddle.nn.Linear(4, 4), paddle.nn.Dropout(0.5))
+    m.train()
+    paddle.flops(m, [1, 4])
+    assert m.training and m[1].training
+    m.eval()
+    paddle.flops(m, [1, 4])
+    assert not m.training
+
+
+def test_quantile_interpolation_modes():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], "float32"))
+    for mode in ("linear", "lower", "higher", "nearest", "midpoint"):
+        got = float(paddle.quantile(x, 0.4, interpolation=mode))
+        want = float(np.quantile(np.array([1., 2., 3., 4.]), 0.4,
+                                 method=mode))
+        assert got == pytest.approx(want), mode
+    with pytest.raises(ValueError, match="interpolation"):
+        paddle.quantile(x, 0.4, interpolation="cubic")
+    # nanquantile honors interpolation too
+    xn = paddle.to_tensor(np.array([1.0, np.nan, 3.0, 4.0], "float32"))
+    got = float(paddle.nanquantile(xn, 0.5, interpolation="lower"))
+    assert got == 3.0
