@@ -14,5 +14,12 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# persistent executable cache: the suite's wall-time is dominated by XLA
+# compiles of the same tiny programs every run (round-2 verdict weak #7);
+# cache hits across runs cut repeat suite time substantially
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("PADDLE_TEST_CACHE",
+                                 "/tmp/paddle_tpu_test_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
