@@ -21,7 +21,15 @@ import numpy as np
 
 from ..tcp_store import TCPStore
 
-__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient"]
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "SSDSparseTable", "CtrAccessor", "CtrSparseTable"]
+
+
+class _PSError:
+    """Server-side failure shipped back to the calling client."""
+
+    def __init__(self, message: str):
+        self.message = message
 
 
 class DenseTable:
@@ -145,27 +153,37 @@ class PSServer:
             req_key = self._store.get(slot).decode()
             blob = self._store.get(req_key)
             op, table, payload = pickle.loads(blob)
-            t = self._tables[table]
-            if op == "pull":
-                result = t.pull(payload)
-            elif op == "push":
-                ids, grads = payload
-                t.push(ids, grads)
-                result = True
-            elif op == "pull_dense":
-                result = t.pull()
-            elif op == "push_dense":
-                t.push(payload)
-                result = True
-            elif op == "set_dense":
-                t.set(payload)
-                result = True
-            elif op == "size":
-                result = t.size()
-            elif op == "save":
-                result = t.state_dict()
-            else:
-                result = None
+            # a bad request must answer with an error, never kill the serve
+            # thread (which would hang every other client on the 60s wait)
+            try:
+                t = self._tables[table]
+                if op == "pull":
+                    result = t.pull(payload)
+                elif op == "push":
+                    ids, grads = payload
+                    t.push(ids, grads)
+                    result = True
+                elif op == "pull_dense":
+                    result = t.pull()
+                elif op == "push_dense":
+                    t.push(payload)
+                    result = True
+                elif op == "set_dense":
+                    t.set(payload)
+                    result = True
+                elif op == "size":
+                    result = t.size()
+                elif op == "save":
+                    result = t.state_dict()
+                elif op == "shrink":
+                    result = t.shrink()       # CtrSparseTable only
+                elif op == "day_end":
+                    t.day_end()
+                    result = True
+                else:
+                    result = _PSError(f"unknown op {op!r}")
+            except Exception as e:            # AttributeError for wrong table
+                result = _PSError(f"{type(e).__name__}: {e}")
             self._store.set(req_key + "/resp", pickle.dumps(result))
             self._store.delete_key(req_key)
             self._store.delete_key(slot)
@@ -198,7 +216,11 @@ class PSClient:
             self._store.wait([req_key + "/resp"], timeout=60)
             blob = self._store.get(req_key + "/resp")
             self._store.delete_key(req_key + "/resp")
-        return pickle.loads(blob)
+        result = pickle.loads(blob)
+        if isinstance(result, _PSError):
+            raise RuntimeError(f"PS server error for op {op!r} on table "
+                               f"{table!r}: {result.message}")
+        return result
 
     def pull_sparse(self, table: str, ids: Sequence[int]) -> np.ndarray:
         return self._call("pull", table, [int(i) for i in ids])
@@ -222,3 +244,14 @@ class PSClient:
 
     def save_table(self, table: str) -> dict:
         return self._call("save", table, None)
+
+    def shrink_table(self, table: str) -> int:
+        """Drop low-score/stale rows (CtrSparseTable)."""
+        return self._call("shrink", table, None)
+
+    def day_end(self, table: str) -> bool:
+        """Advance the CTR decay/staleness clock (CtrSparseTable)."""
+        return self._call("day_end", table, None)
+
+
+from .scale import SSDSparseTable, CtrAccessor, CtrSparseTable  # noqa: F401,E402

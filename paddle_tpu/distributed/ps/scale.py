@@ -1,0 +1,358 @@
+"""PS scale features: SSD-backed sparse tables + CTR accessors.
+
+Reference analog: `fluid/distributed/ps/table/ssd_sparse_table.cc` (rocksdb
+cold storage under a hot in-memory cache) and `ctr_accessor.cc` /
+`ctr_double_accessor.cc` (per-feature show/click statistics driving feature
+entry, time decay, and shrink).
+
+TPU-native shape: these tables live host-side in the PS server process (the
+TPU never sees them — trainers pull dense row blocks). The "SSD" tier is a
+fixed-record binary file with an in-memory offset index and a free-slot list
+(the role rocksdb plays in the reference, without the dependency); rows
+LRU-evict from the hot dict to disk and promote back on access. The CTR
+accessor keeps (show, click, unseen_days) per row with the reference's
+semantics: probabilistic-entry threshold before a row materializes, a decay
+step, and score-based shrink.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from . import SparseTable
+
+__all__ = ["SSDSparseTable", "CtrAccessor", "CtrSparseTable"]
+
+
+class _DiskStore:
+    """Fixed-record binary file: id -> record bytes. Append or reuse a freed
+    slot; index and freelist live in memory, REBUILT by scanning the file on
+    open (that is what the per-record id header is for). Ids are stored
+    unsigned 64-bit (uint64 feature hashes are the common case); the
+    sentinel ~0 marks a freed slot."""
+
+    _FREE = (1 << 64) - 1
+
+    def __init__(self, path: str, record_bytes: int):
+        self._path = path
+        self._rec = record_bytes
+        # "r+b" honors seeks on write ("a" mode appends regardless of seek)
+        if not os.path.exists(path):
+            open(path, "wb").close()
+        self._f = open(path, "r+b")
+        self._index: Dict[int, int] = {}      # id -> slot
+        self._free: list = []
+        self._slots = 0
+        self._rebuild()
+
+    def _rebuild(self):
+        self._f.seek(0, os.SEEK_END)
+        size = self._f.tell()
+        stride = 8 + self._rec
+        self._slots = max(size - 8, 0) // stride if size >= 8 else 0
+        for slot in range(self._slots):
+            self._f.seek(8 + slot * stride)
+            (rid,) = struct.unpack("<Q", self._f.read(8))
+            if rid == self._FREE:
+                self._free.append(slot)
+            else:
+                self._index[int(rid)] = slot
+
+    def put(self, rid: int, blob: bytes):
+        assert len(blob) == self._rec
+        if not (0 <= rid < self._FREE):
+            raise ValueError(f"row id {rid} out of uint64 range")
+        slot = self._index.get(rid)
+        if slot is None:
+            slot = self._free.pop() if self._free else self._slots
+            if slot == self._slots:
+                self._slots += 1
+            self._index[rid] = slot
+        self._f.seek(8 + slot * (8 + self._rec))
+        self._f.write(struct.pack("<Q", rid) + blob)
+
+    def get(self, rid: int) -> Optional[bytes]:
+        slot = self._index.get(rid)
+        if slot is None:
+            return None
+        self._f.seek(8 + slot * (8 + self._rec) + 8)
+        return self._f.read(self._rec)
+
+    def _mark_free(self, slot: int):
+        self._f.seek(8 + slot * (8 + self._rec))
+        self._f.write(struct.pack("<Q", self._FREE))
+        self._free.append(slot)
+
+    def pop(self, rid: int) -> Optional[bytes]:
+        blob = self.get(rid)
+        if blob is not None:
+            self._mark_free(self._index.pop(rid))
+        return blob
+
+    def delete(self, rid: int):
+        slot = self._index.pop(rid, None)
+        if slot is not None:
+            self._mark_free(slot)
+
+    def __len__(self):
+        return len(self._index)
+
+    def ids(self):
+        return list(self._index)
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class SSDSparseTable(SparseTable):
+    """Sparse table with a bounded hot cache + disk cold tier (reference
+    ssd_sparse_table: memory shards over rocksdb).
+
+    mem_capacity: max rows held hot; LRU overflow spills (row, g2) to disk.
+    Reads of cold rows promote them back. Everything else (lazy init,
+    sgd/adagrad apply, state_dict) behaves exactly like SparseTable.
+    """
+
+    def __init__(self, dim: int, path: str, mem_capacity: int = 100_000,
+                 initializer_std: float = 0.01, optimizer: str = "adagrad",
+                 lr: float = 0.05, seed: int = 0):
+        super().__init__(dim, initializer_std, optimizer, lr, seed)
+        self._rows = OrderedDict()            # LRU: most-recent at the end
+        self.mem_capacity = int(mem_capacity)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # record = row fp32[dim] + g2 fp32[dim]
+        self._disk = _DiskStore(path, record_bytes=8 * dim)
+
+    # --------------------------------------------------------- tiering
+
+    def _load_cold(self, rid: int) -> Optional[np.ndarray]:
+        blob = self._disk.pop(rid)
+        if blob is None:
+            return None
+        arr = np.frombuffer(blob, np.float32).copy()
+        row, g2 = arr[:self.dim], arr[self.dim:]
+        self._rows[rid] = row
+        if g2.any():
+            self._g2[rid] = g2
+        return row
+
+    def _evict_overflow(self):
+        while len(self._rows) > self.mem_capacity:
+            rid, row = self._rows.popitem(last=False)   # LRU head
+            g2 = self._g2.pop(rid, None)
+            blob = np.concatenate(
+                [row, g2 if g2 is not None
+                 else np.zeros(self.dim, np.float32)]).tobytes()
+            self._disk.put(rid, blob)
+
+    def _touch(self, rid: int):
+        self._rows.move_to_end(rid)
+
+    # ------------------------------------------------------------ api
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._mu:
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    row = self._load_cold(rid)
+                if row is None:
+                    row = self._rng.normal(
+                        0, self.std, self.dim).astype(np.float32)
+                    self._rows[rid] = row
+                else:
+                    self._touch(rid)
+                out[i] = row
+            self._evict_overflow()
+        return out
+
+    def push(self, ids: Sequence[int], grads: np.ndarray):
+        with self._mu:
+            for rid, g in zip(ids, grads):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is None:
+                    row = self._load_cold(rid)
+                if row is None:
+                    continue
+                self._touch(rid)
+                if self.opt == "adagrad":
+                    acc = self._g2.setdefault(
+                        rid, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                else:
+                    row -= self.lr * g
+            self._evict_overflow()
+
+    def size(self) -> int:
+        with self._mu:
+            return len(self._rows) + len(self._disk)
+
+    def mem_size(self) -> int:
+        with self._mu:
+            return len(self._rows)
+
+    def disk_size(self) -> int:
+        with self._mu:
+            return len(self._disk)
+
+    def flush(self):
+        """Spill every hot row to disk and fsync — the persistence point
+        (reference ssd table save): after flush, a new process reopening the
+        same path sees the full table."""
+        with self._mu:
+            cap, self.mem_capacity = self.mem_capacity, 0
+            self._evict_overflow()
+            self.mem_capacity = cap
+            self._disk.flush()
+            os.fsync(self._disk._f.fileno())
+
+    def state_dict(self) -> dict:
+        with self._mu:
+            rows = dict(self._rows)
+            g2 = dict(self._g2)
+            for rid in self._disk.ids():
+                arr = np.frombuffer(self._disk.get(rid), np.float32).copy()
+                rows[rid] = arr[:self.dim]
+                if arr[self.dim:].any():
+                    g2[rid] = arr[self.dim:]
+            return {"dim": self.dim, "rows": rows, "g2": g2}
+
+    def load_state_dict(self, state: dict):
+        # the base class would swap in a plain dict and break the LRU;
+        # rebuild the OrderedDict and spill overflow straight to disk
+        with self._mu:
+            self._rows = OrderedDict(
+                (int(k), np.asarray(v, np.float32))
+                for k, v in state["rows"].items())
+            self._g2 = {int(k): np.asarray(v, np.float32)
+                        for k, v in state.get("g2", {}).items()}
+            self._evict_overflow()
+
+
+class CtrAccessor:
+    """Per-row CTR statistics (reference ctr_accessor.cc): show/click with
+    time decay, probabilistic feature entry, and score-based shrink."""
+
+    def __init__(self, show_coeff: float = 0.2, click_coeff: float = 1.0,
+                 entry_threshold: float = 0.0, decay_rate: float = 0.98,
+                 delete_threshold: float = 0.8,
+                 delete_after_unseen_days: int = 30):
+        self.show_coeff = show_coeff
+        self.click_coeff = click_coeff
+        self.entry_threshold = entry_threshold
+        self.decay_rate = decay_rate
+        self.delete_threshold = delete_threshold
+        self.delete_after_unseen_days = delete_after_unseen_days
+        # rid -> [show, click, unseen_days]
+        self._stats: Dict[int, list] = {}
+
+    def update(self, rid: int, show: float = 1.0, click: float = 0.0):
+        st = self._stats.setdefault(int(rid), [0.0, 0.0, 0])
+        st[0] += show
+        st[1] += click
+        st[2] = 0
+
+    def score(self, rid: int) -> float:
+        st = self._stats.get(int(rid))
+        if st is None:
+            return 0.0
+        return self.show_coeff * st[0] + self.click_coeff * st[1]
+
+    def passes_entry(self, rid: int) -> bool:
+        """reference probabilistic entry: a feature only materializes an
+        embedding once its accumulated score clears the threshold."""
+        return self.score(rid) >= self.entry_threshold
+
+    def day_end(self):
+        """One decay step (reference update_time_decay): shows/clicks decay,
+        unseen counters advance."""
+        for st in self._stats.values():
+            st[0] *= self.decay_rate
+            st[1] *= self.decay_rate
+            st[2] += 1
+
+    def shrink_ids(self):
+        """Rows to delete: score below the delete threshold or unseen too
+        long (reference CtrCommonAccessor::Shrink)."""
+        out = []
+        for rid, st in self._stats.items():
+            if (self.score(rid) < self.delete_threshold
+                    or st[2] > self.delete_after_unseen_days):
+                out.append(rid)
+        return out
+
+    def forget(self, rid: int):
+        self._stats.pop(int(rid), None)
+
+    def stats(self, rid: int):
+        st = self._stats.get(int(rid))
+        return None if st is None else {"show": st[0], "click": st[1],
+                                        "unseen_days": st[2]}
+
+
+class CtrSparseTable(SparseTable):
+    """SparseTable + CtrAccessor wired together (reference
+    memory_sparse_table with a ctr accessor): pulls report shows, pushes can
+    report clicks, rows only materialize past the entry threshold, and
+    shrink() drops low-score/stale rows."""
+
+    def __init__(self, dim: int, accessor: Optional[CtrAccessor] = None,
+                 **kw):
+        super().__init__(dim, **kw)
+        self.accessor = accessor or CtrAccessor()
+
+    def pull(self, ids: Sequence[int], shows: Optional[Sequence[float]] = None
+             ) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        acc = self.accessor
+        with self._mu:
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                acc.update(rid, show=1.0 if shows is None else shows[i])
+                row = self._rows.get(rid)
+                if row is None:
+                    if acc.passes_entry(rid):
+                        row = self._rng.normal(
+                            0, self.std, self.dim).astype(np.float32)
+                        self._rows[rid] = row
+                    else:
+                        out[i] = 0.0     # below entry: serve zeros, no row
+                        continue
+                out[i] = row
+        return out
+
+    def push(self, ids: Sequence[int], grads: np.ndarray,
+             clicks: Optional[Sequence[float]] = None):
+        if clicks is not None:
+            with self._mu:   # accessor stats share the table's lock
+                for rid, c in zip(ids, clicks):
+                    self.accessor.update(int(rid), show=0.0, click=float(c))
+        super().push(ids, grads)
+
+    def day_end(self):
+        with self._mu:
+            self.accessor.day_end()
+
+    def shrink(self) -> int:
+        """Drop low-score/stale rows; returns how many were deleted."""
+        with self._mu:
+            victims = self.accessor.shrink_ids()
+            n = 0
+            for rid in victims:
+                self.accessor.forget(rid)
+                if self._rows.pop(rid, None) is not None:
+                    n += 1
+                self._g2.pop(rid, None)
+            return n
