@@ -231,8 +231,11 @@ class SSDSparseTable(SparseTable):
 
     def load_state_dict(self, state: dict):
         # the base class would swap in a plain dict and break the LRU;
-        # rebuild the OrderedDict and spill overflow straight to disk
+        # rebuild the OrderedDict and spill overflow straight to disk.
+        # FULL-replacement contract: stale disk rows must not resurrect.
         with self._mu:
+            for rid in self._disk.ids():
+                self._disk.delete(rid)
             self._rows = OrderedDict(
                 (int(k), np.asarray(v, np.float32))
                 for k, v in state["rows"].items())

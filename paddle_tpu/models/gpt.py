@@ -24,6 +24,7 @@ from .. import nn
 from ..nn import functional as F
 from .. import ops
 from ..core.dispatch import register_op
+from ..core.tensor import Tensor
 from ..ops._helpers import _op
 
 
@@ -188,7 +189,9 @@ class GPTAttention(nn.Layer):
         self.dropout_p = config.attention_dropout_prob
         self.use_flash = config.use_flash_attention
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, kv_cache=None):
+        if kv_cache is not None:
+            return self._forward_cached(x, kv_cache)
         b, s, h = x.shape
         drop = self.dropout_p if self.training else 0.0
         from ..kernels.pallas.flash_attention import packed_layout_supported
@@ -218,6 +221,34 @@ class GPTAttention(nn.Layer):
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 
+    def _forward_cached(self, x, kv_cache):
+        """KV-cache attention (serving): write this chunk's K/V into the
+        static [B, M, nh, hd] buffers at `pos` and attend the queries over
+        every cached position <= their own (reference: the cache tensors
+        fused_multi_transformer threads through generation). Inference-only
+        math on raw arrays — no tape, runs inside the jitted generate loop
+        with static shapes throughout."""
+        k_buf, v_buf, pos = kv_cache          # jnp arrays + scalar int32
+        b, s, h = x.shape
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv_proj(x).reshape([b, s, 3, nh, hd]).value()
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_buf = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype),
+                                             (0, pos, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype),
+                                             (0, pos, 0, 0))
+        m = k_buf.shape[1]
+        scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                            k_buf.astype(jnp.float32)) / math.sqrt(hd)
+        key_pos = jnp.arange(m)[None, None, None, :]
+        q_pos = (pos + jnp.arange(s))[None, None, :, None]
+        scores = jnp.where(key_pos <= q_pos, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bnqk,bknd->bqnd", probs,
+                         v_buf.astype(jnp.float32)).astype(q.dtype)
+        out = self.out_proj(Tensor(ctx.reshape(b, s, h)))
+        return out, (k_buf, v_buf)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -240,7 +271,12 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, kv_cache=None):
+        if kv_cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), kv_cache=kv_cache)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln_1(x), attn_mask))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return x
@@ -336,8 +372,21 @@ class GPTModel(nn.Layer):
                                                       "fc_out.weight")) else normal)
                 p.set_value(init(tuple(p.shape), p.dtype))
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, kv_caches=None,
+                start_pos=None):
         b, s = input_ids.shape
+        if kv_caches is not None:
+            if isinstance(self.h, GPTScannedBlocks):
+                raise NotImplementedError(
+                    "KV-cache generation requires scan_layers=False")
+            p0 = start_pos if start_pos is not None else jnp.int32(0)
+            pos_ids = Tensor((p0 + jnp.arange(s, dtype=jnp.int32))[None, :])
+            x = self.wte(input_ids) + self.wpe(pos_ids)
+            new_caches = []
+            for block, cache in zip(self.h, kv_caches):
+                x, nc = block(x, kv_cache=(cache[0], cache[1], p0))
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
@@ -378,3 +427,117 @@ class GPTForCausalLM(nn.Layer):
         if self.lm_head is None:
             return ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
         return self.lm_head(hidden)
+
+    # ------------------------------------------------------------ generation
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, do_sample: bool = False,
+                 top_k: int = 0, eos_token_id=None, seed: int = 0,
+                 max_length=None):
+        """KV-cache incremental decoding, the WHOLE loop in one executable.
+
+        Reference analog: generation over fused_multi_transformer's CacheKV
+        tensors (incubate/nn/layer/fused_transformer.py:1021). TPU-native:
+        prefill writes the prompt's K/V into static [B, M, nh, hd] buffers,
+        then a lax.scan of single-token steps decodes max_new_tokens — one
+        compiled program per (prompt_shape, max_new_tokens), no per-token
+        Python or recompiles. Greedy by default; do_sample=True draws from
+        softmax(logits/temperature) with optional top-k. After an EOS the
+        sequence keeps emitting EOS (standard finished-row semantics).
+        Requires scan_layers=False (cache threads through discrete blocks).
+        """
+        from ..core import dispatch
+
+        cfg = self.config
+        if cfg.scan_layers:
+            raise NotImplementedError(
+                "generate() requires scan_layers=False")
+        ids_arr = input_ids.value() if isinstance(input_ids, Tensor)             else jnp.asarray(input_ids)
+        b, s0 = ids_arr.shape
+        m = int(max_length or cfg.max_position_embeddings)
+        if s0 + max_new_tokens > m:
+            raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
+                             f"exceeds max_length {m}")
+        params = [p for _, p in self.named_parameters()]
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        dtype = params[0].value().dtype
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        def head(hidden_last):
+            w = (self.gpt.wte.weight if self.lm_head is None
+                 else self.lm_head.weight).value()
+            if self.lm_head is None:
+                return hidden_last.astype(jnp.float32) @ w.astype(
+                    jnp.float32).T
+            return hidden_last.astype(jnp.float32) @ w.astype(jnp.float32)
+
+        def pick(logits, key):
+            if do_sample:
+                lg = logits / jnp.maximum(temperature, 1e-6)
+                if top_k and top_k > 0:
+                    kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                    lg = jnp.where(lg < kth, -1e30, lg)
+                return jax.random.categorical(key, lg, axis=-1)
+            return jnp.argmax(logits, axis=-1)
+
+        def gen_fn(param_arrays, ids, key0):
+            ctx = dispatch.TraceContext()
+            saved = [p._data for p in params]
+            dispatch.push_trace(ctx)
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                caches = [(jnp.zeros((b, m, nh, hd), dtype),
+                           jnp.zeros((b, m, nh, hd), dtype))
+                          for _ in range(cfg.num_layers)]
+                hidden, caches = self.gpt(Tensor(ids), kv_caches=caches,
+                                          start_pos=jnp.int32(0))
+                logits0 = head(hidden.value()[:, -1])
+                tok0 = pick(logits0, key0)
+                done0 = tok0 == eos
+
+                def step(carry, i):
+                    caches, tok, done, key = carry
+                    key, sub = jax.random.split(key)
+                    hidden, caches = self.gpt(
+                        Tensor(tok[:, None].astype(jnp.int32)),
+                        kv_caches=caches, start_pos=(s0 + i).astype(jnp.int32))
+                    nxt = pick(head(hidden.value()[:, -1]), sub)
+                    nxt = jnp.where(done, eos, nxt)      # finished rows: EOS
+                    done = done | (nxt == eos)
+                    return (caches, nxt, done, key), tok
+
+                (_, last, _, _), toks = jax.lax.scan(
+                    step, (caches, tok0, done0, key0),
+                    jnp.arange(max_new_tokens - 1))
+                out = jnp.concatenate(
+                    [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+                return out
+            finally:
+                dispatch.pop_trace()
+                ctx.restore()
+                for p, d in zip(params, saved):
+                    p._data = d
+
+        # per-INSTANCE executable cache (dies with the model; bounded so
+        # shape churn cannot grow it without limit)
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        cache_key = (b, s0, max_new_tokens, m, do_sample, top_k,
+                     float(temperature), eos)
+        jitted = self._gen_cache.get(cache_key)
+        if jitted is None:
+            if len(self._gen_cache) >= 8:
+                self._gen_cache.pop(next(iter(self._gen_cache)))
+            jitted = jax.jit(gen_fn)
+            self._gen_cache[cache_key] = jitted
+        new_tokens = jitted(tuple(p.value() for p in params),
+                            ids_arr.astype(jnp.int32),
+                            jax.random.PRNGKey(seed))
+        return Tensor(jnp.concatenate(
+            [ids_arr.astype(jnp.int32), new_tokens.astype(jnp.int32)],
+            axis=1))
+
+
+
