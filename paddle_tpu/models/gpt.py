@@ -440,104 +440,122 @@ class GPTForCausalLM(nn.Layer):
         tensors (incubate/nn/layer/fused_transformer.py:1021). TPU-native:
         prefill writes the prompt's K/V into static [B, M, nh, hd] buffers,
         then a lax.scan of single-token steps decodes max_new_tokens — one
-        compiled program per (prompt_shape, max_new_tokens), no per-token
-        Python or recompiles. Greedy by default; do_sample=True draws from
-        softmax(logits/temperature) with optional top-k. After an EOS the
-        sequence keeps emitting EOS (standard finished-row semantics).
-        Requires scan_layers=False (cache threads through discrete blocks).
-        """
-        from ..core import dispatch
-
+        compiled program per (prompt_shape, max_new_tokens, sampling config),
+        no per-token Python or recompiles. Greedy by default;
+        do_sample=True draws from softmax(logits/temperature) with optional
+        top-k. After an EOS a row keeps emitting EOS. Requires
+        scan_layers=False (the cache threads through discrete blocks)."""
         cfg = self.config
         if cfg.scan_layers:
             raise NotImplementedError(
                 "generate() requires scan_layers=False")
-        ids_arr = input_ids.value() if isinstance(input_ids, Tensor)             else jnp.asarray(input_ids)
-        b, s0 = ids_arr.shape
-        m = int(max_length or cfg.max_position_embeddings)
-        if s0 + max_new_tokens > m:
-            raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
-                             f"exceeds max_length {m}")
-        params = [p for _, p in self.named_parameters()]
-        nh = cfg.num_heads
-        hd = cfg.hidden_size // nh
-        dtype = params[0].value().dtype
-        eos = -1 if eos_token_id is None else int(eos_token_id)
-
-        def head(hidden_last):
-            w = (self.gpt.wte.weight if self.lm_head is None
-                 else self.lm_head.weight).value()
-            if self.lm_head is None:
-                return hidden_last.astype(jnp.float32) @ w.astype(
-                    jnp.float32).T
-            return hidden_last.astype(jnp.float32) @ w.astype(jnp.float32)
-
-        def pick(logits, key):
-            if do_sample:
-                lg = logits / jnp.maximum(temperature, 1e-6)
-                if top_k and top_k > 0:
-                    kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                    lg = jnp.where(lg < kth, -1e30, lg)
-                return jax.random.categorical(key, lg, axis=-1)
-            return jnp.argmax(logits, axis=-1)
-
-        def gen_fn(param_arrays, ids, key0):
-            ctx = dispatch.TraceContext()
-            saved = [p._data for p in params]
-            dispatch.push_trace(ctx)
-            try:
-                for p, a in zip(params, param_arrays):
-                    p._data = a
-                caches = [(jnp.zeros((b, m, nh, hd), dtype),
-                           jnp.zeros((b, m, nh, hd), dtype))
-                          for _ in range(cfg.num_layers)]
-                hidden, caches = self.gpt(Tensor(ids), kv_caches=caches,
-                                          start_pos=jnp.int32(0))
-                logits0 = head(hidden.value()[:, -1])
-                tok0 = pick(logits0, key0)
-                done0 = tok0 == eos
-
-                def step(carry, i):
-                    caches, tok, done, key = carry
-                    key, sub = jax.random.split(key)
-                    hidden, caches = self.gpt(
-                        Tensor(tok[:, None].astype(jnp.int32)),
-                        kv_caches=caches, start_pos=(s0 + i).astype(jnp.int32))
-                    nxt = pick(head(hidden.value()[:, -1]), sub)
-                    nxt = jnp.where(done, eos, nxt)      # finished rows: EOS
-                    done = done | (nxt == eos)
-                    return (caches, nxt, done, key), tok
-
-                (_, last, _, _), toks = jax.lax.scan(
-                    step, (caches, tok0, done0, key0),
-                    jnp.arange(max_new_tokens - 1))
-                out = jnp.concatenate(
-                    [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
-                return out
-            finally:
-                dispatch.pop_trace()
-                ctx.restore()
-                for p, d in zip(params, saved):
-                    p._data = d
-
-        # per-INSTANCE executable cache (dies with the model; bounded so
-        # shape churn cannot grow it without limit)
-        if not hasattr(self, "_gen_cache"):
-            self._gen_cache = {}
-        cache_key = (b, s0, max_new_tokens, m, do_sample, top_k,
-                     float(temperature), eos)
-        jitted = self._gen_cache.get(cache_key)
-        if jitted is None:
-            if len(self._gen_cache) >= 8:
-                self._gen_cache.pop(next(iter(self._gen_cache)))
-            jitted = jax.jit(gen_fn)
-            self._gen_cache[cache_key] = jitted
-        new_tokens = jitted(tuple(p.value() for p in params),
-                            ids_arr.astype(jnp.int32),
-                            jax.random.PRNGKey(seed))
-        return Tensor(jnp.concatenate(
-            [ids_arr.astype(jnp.int32), new_tokens.astype(jnp.int32)],
-            axis=1))
+        if max_length and max_length > cfg.max_position_embeddings:
+            # GPT-specific: the LEARNED position table clamps past its end
+            raise ValueError(
+                f"max_length {max_length} exceeds the learned position "
+                f"table ({cfg.max_position_embeddings}); positions past it "
+                f"would silently clamp")
+        return _generate_with_cache(
+            self, self.gpt, cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_position_embeddings,
+            head_weight=(self.gpt.wte.weight if self.lm_head is None
+                         else self.lm_head.weight),
+            head_transpose=self.lm_head is None,
+            input_ids=input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, do_sample=do_sample, top_k=top_k,
+            eos_token_id=eos_token_id, seed=seed, max_length=max_length)
 
 
 
+def _generate_with_cache(lm, backbone, num_layers: int, n_kv_heads: int,
+                         head_dim: int, max_pos: int, head_weight,
+                         head_transpose: bool, input_ids, max_new_tokens,
+                         temperature, do_sample, top_k, eos_token_id, seed,
+                         max_length):
+    """Shared compiled prefill+scan decode loop (GPT and LLaMA): see
+    GPTForCausalLM.generate for the contract. `backbone(ids, kv_caches=...,
+    start_pos=...)` must return (hidden, new_caches)."""
+    from ..core import dispatch
+
+    ids_arr = input_ids.value() if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    b, s0 = ids_arr.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return Tensor(ids_arr.astype(jnp.int32))   # same dtype as n>0 paths
+    m = int(max_length or max_pos)
+    if s0 + max_new_tokens > m:
+        raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
+                         f"exceeds max_length {m}")
+    params = [p for _, p in lm.named_parameters()]
+    dtype = params[0].value().dtype
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def head(hidden_last):
+        w = head_weight.value().astype(jnp.float32)
+        hl = hidden_last.astype(jnp.float32)
+        return hl @ (w.T if head_transpose else w)
+
+    def pick(logits, key):
+        if do_sample:
+            lg = logits / jnp.maximum(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                lg = jnp.where(lg < kth, -1e30, lg)
+            return jax.random.categorical(key, lg, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def gen_fn(param_arrays, ids, key0):
+        ctx = dispatch.TraceContext()
+        saved = [p._data for p in params]
+        dispatch.push_trace(ctx)
+        try:
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            caches = [(jnp.zeros((b, m, n_kv_heads, head_dim), dtype),
+                       jnp.zeros((b, m, n_kv_heads, head_dim), dtype))
+                      for _ in range(num_layers)]
+            hidden, caches = backbone(Tensor(ids), kv_caches=caches,
+                                      start_pos=jnp.int32(0))
+            tok0 = pick(head(hidden.value()[:, -1]), key0)
+            done0 = tok0 == eos
+
+            def step(carry, i):
+                caches, tok, done, key = carry
+                key, sub = jax.random.split(key)
+                hidden, caches = backbone(
+                    Tensor(tok[:, None].astype(jnp.int32)),
+                    kv_caches=caches, start_pos=(s0 + i).astype(jnp.int32))
+                nxt = pick(head(hidden.value()[:, -1]), sub)
+                nxt = jnp.where(done, eos, nxt)      # finished rows: EOS
+                done = done | (nxt == eos)
+                return (caches, nxt, done, key), tok
+
+            (_, last, _, _), toks = jax.lax.scan(
+                step, (caches, tok0, done0, key0),
+                jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate(
+                [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+        finally:
+            dispatch.pop_trace()
+            ctx.restore()
+            for p, d in zip(params, saved):
+                p._data = d
+
+    # per-INSTANCE executable cache (dies with the model; bounded so shape
+    # churn cannot grow it without limit)
+    if not hasattr(lm, "_gen_cache"):
+        lm._gen_cache = {}
+    cache_key = (b, s0, max_new_tokens, m, do_sample, top_k,
+                 float(temperature), eos)
+    jitted = lm._gen_cache.get(cache_key)
+    if jitted is None:
+        if len(lm._gen_cache) >= 8:
+            lm._gen_cache.pop(next(iter(lm._gen_cache)))
+        jitted = jax.jit(gen_fn)
+        lm._gen_cache[cache_key] = jitted
+    new_tokens = jitted(tuple(p.value() for p in params),
+                        ids_arr.astype(jnp.int32), jax.random.PRNGKey(seed))
+    return Tensor(jnp.concatenate(
+        [ids_arr.astype(jnp.int32), new_tokens.astype(jnp.int32)], axis=1))

@@ -61,10 +61,13 @@ def llama_tiny(**overrides) -> LlamaConfig:
     return LlamaConfig(**cfg)
 
 
-def _rope_fwd(q, k, *, theta=10000.0):
-    """Rotary embedding applied to q,k [B,S,H,D] (interleaved-pair form)."""
+def _rope_fwd(q, k, *rest, theta=10000.0, has_pos=False):
+    """Rotary embedding applied to q,k [B,S,H,D] (interleaved-pair form).
+    Optional trailing scalar position offset (KV-cache decoding: the chunk
+    starts at an absolute position, not 0)."""
     B, S, H, D = q.shape
-    pos = jnp.arange(S, dtype=jnp.float32)
+    p0 = rest[0].astype(jnp.float32) if has_pos else 0.0
+    pos = p0 + jnp.arange(S, dtype=jnp.float32)
     inv = theta ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
     ang = pos[:, None] * inv[None, :]                      # [S, D/2]
     cos = jnp.cos(ang)[None, :, None, :]
@@ -101,7 +104,9 @@ class LlamaAttention(nn.Layer):
                                 bias_attr=False)
         self.o_proj = nn.Linear(H, H, bias_attr=False)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None):
+        if kv_cache is not None:
+            return self._forward_cached(x, kv_cache)
         b, s, h = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv, self.head_dim])
@@ -118,6 +123,39 @@ class LlamaAttention(nn.Layer):
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  training=self.training)
         return self.o_proj(out.reshape([b, s, h]))
+
+    def _forward_cached(self, x, kv_cache):
+        """KV-cache attention with RoPE at absolute positions and GQA
+        (queries fold onto their KV head). Inference-only raw-array math —
+        mirrors GPTAttention._forward_cached."""
+        from ..core.tensor import Tensor
+
+        k_buf, v_buf, pos = kv_cache        # [B, M, n_kv, hd], scalar int32
+        b, s, h = x.shape
+        nh, nkv, hd = self.num_heads, self.num_kv, self.head_dim
+        q = self.q_proj(x).reshape([b, s, nh, hd])
+        k = self.k_proj(x).reshape([b, s, nkv, hd])
+        v = self.v_proj(x).reshape([b, s, nkv, hd])
+        q, k = _op("rope", q, k, Tensor(jnp.asarray(pos)), theta=self.theta,
+                   has_pos=True)
+        qv, kv_, vv = q.value(), k.value(), v.value()
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, kv_.astype(k_buf.dtype), (0, pos, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, vv.astype(v_buf.dtype), (0, pos, 0, 0))
+        m = k_buf.shape[1]
+        group = nh // nkv
+        qg = qv.reshape(b, s, nkv, group, hd)
+        scores = jnp.einsum("bqkgd,bmkd->bkgqm", qg.astype(jnp.float32),
+                            k_buf.astype(jnp.float32)) / math.sqrt(hd)
+        key_pos = jnp.arange(m)[None, None, None, None, :]
+        q_pos = (pos + jnp.arange(s))[None, None, None, :, None]
+        scores = jnp.where(key_pos <= q_pos, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bkgqm,bmkd->bqkgd", probs,
+                         v_buf.astype(jnp.float32)).astype(qv.dtype)
+        out = self.o_proj(Tensor(ctx.reshape(b, s, h)))
+        return out, (k_buf, v_buf)
 
 
 class LlamaMLP(nn.Layer):
@@ -144,7 +182,11 @@ class LlamaBlock(nn.Layer):
                                                    epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None):
+        if kv_cache is not None:
+            a, nc = self.self_attn(self.input_layernorm(x), kv_cache=kv_cache)
+            x = x + a
+            return x + self.mlp(self.post_attention_layernorm(x)), nc
         x = x + self.self_attn(self.input_layernorm(x))
         return x + self.mlp(self.post_attention_layernorm(x))
 
@@ -172,8 +214,15 @@ class LlamaModel(nn.Layer):
                         else normal)
                 p.set_value(init(tuple(p.shape), p.dtype))
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, start_pos=None):
         x = self.embed_tokens(input_ids)
+        if kv_caches is not None:
+            p0 = start_pos if start_pos is not None else jnp.int32(0)
+            new_caches = []
+            for block, cache in zip(self.layers, kv_caches):
+                x, nc = block(x, kv_cache=(cache[0], cache[1], p0))
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for block in self.layers:
             x = block(x)
         return self.norm(x)
@@ -209,6 +258,26 @@ class LlamaForCausalLM(nn.Layer):
             return ops.matmul(hidden, self.model.embed_tokens.weight,
                               transpose_y=True)
         return self.lm_head(hidden)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, do_sample: bool = False,
+                 top_k: int = 0, eos_token_id=None, seed: int = 0,
+                 max_length=None):
+        """KV-cache incremental decoding — same compiled prefill+scan
+        machinery as GPTForCausalLM.generate (RoPE positions offset by the
+        cache cursor, GQA K/V buffers sized [B, M, n_kv, hd])."""
+        from .gpt import _generate_with_cache
+        cfg = self.config
+        return _generate_with_cache(
+            self, self.model, cfg.num_layers, cfg.num_kv_heads,
+            cfg.hidden_size // cfg.num_heads,
+            cfg.max_position_embeddings,
+            head_weight=(self.model.embed_tokens.weight
+                         if self.lm_head is None else self.lm_head.weight),
+            head_transpose=self.lm_head is None,
+            input_ids=input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, do_sample=do_sample, top_k=top_k,
+            eos_token_id=eos_token_id, seed=seed, max_length=max_length)
 
 
 def shard_llama_tp(model: LlamaForCausalLM, mesh=None, axis: str = "model"):

@@ -99,3 +99,41 @@ def test_generate_guards():
     with pytest.raises(NotImplementedError, match="scan_layers"):
         scanned.generate(paddle.to_tensor(np.zeros((1, 4), np.int32)),
                          max_new_tokens=2)
+
+
+def test_llama_cached_greedy_equals_naive():
+    """LLaMA generation (RoPE offset + GQA buffers) vs naive decode."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(5)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2,
+                      max_position_embeddings=64,
+                      use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = np.random.RandomState(5).randint(1, 64, (2, 6))
+    out = m.generate(paddle.to_tensor(ids.astype("int32")),
+                     max_new_tokens=7).numpy()
+    np.testing.assert_array_equal(out, _naive_greedy(m, ids, 7))
+
+
+def test_llama_rope_offset_matters():
+    """The cached path must apply RoPE at ABSOLUTE positions: decoding the
+    same token at different cursor positions gives different K."""
+    import jax.numpy as jnp
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(6)
+    cfg = LlamaConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                      num_heads=2, num_kv_heads=2,
+                      max_position_embeddings=16, use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    attn = m.model.layers[0].self_attn
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 1, 16).astype("float32"))
+    mk = lambda: (jnp.zeros((1, 16, 2, 8), jnp.float32),
+                  jnp.zeros((1, 16, 2, 8), jnp.float32))
+    _, (k0, _) = attn(x, kv_cache=(*mk(), jnp.int32(0)))
+    _, (k5, _) = attn(x, kv_cache=(*mk(), jnp.int32(5)))
+    assert not np.allclose(np.asarray(k0[:, 0]), np.asarray(k5[:, 5]))
