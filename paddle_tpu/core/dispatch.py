@@ -217,6 +217,8 @@ def _explicit_bwd_exec(name: str, attr_key: Tuple):
     op = _REGISTRY[name]
     attrs = dict((k, v) for k, v in attr_key)
     fn = functools.partial(op.bwd, **attrs) if attrs else op.bwd
+    if op.no_jit:
+        return fn   # host kernels (plugin C backwards) cannot live in jit
     return jax.jit(fn)
 
 

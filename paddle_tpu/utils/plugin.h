@@ -1,0 +1,62 @@
+/* paddle_tpu kernel-plugin C API.
+ *
+ * Reference analog: phi/capi (the C ABI that lets out-of-tree kernels be
+ * written against PHI without C++ ABI coupling) and
+ * phi/backends/device_ext.h's C-struct seam.
+ *
+ * A plugin kernel is a C function:
+ *
+ *     #include "plugin.h"
+ *     int my_kernel(const PTK_Tensor* ins, int n_in,
+ *                   PTK_Tensor* outs, int n_out) {
+ *         // read ins[i].data/shape/dtype, write outs[j].data (preallocated
+ *         // by the framework from the registered output spec)
+ *         return 0;              // nonzero -> raises RuntimeError in Python
+ *     }
+ *
+ * Registered from Python with
+ *     paddle.utils.cpp_extension.load_kernel_plugin(
+ *         "ext_name", sources=[...],
+ *         kernels={"my_kernel": dict(n_in=2, out=lambda *ins: [ins[0]])})
+ * where `out` maps input (shape, dtype) specs to output specs (the InferMeta
+ * role). Kernels run on HOST memory (no_jit ops): the TPU compute path for
+ * custom kernels is Pallas; this seam is for CPU pre/post-processing exactly
+ * like the reference's custom CPU kernels.
+ */
+#ifndef PADDLE_TPU_PLUGIN_H_
+#define PADDLE_TPU_PLUGIN_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* dtype codes (numpy kind/itemsize pairs the Python side understands) */
+typedef enum {
+  PTK_FLOAT32 = 0,
+  PTK_FLOAT64 = 1,
+  PTK_INT32 = 2,
+  PTK_INT64 = 3,
+  PTK_UINT8 = 4,
+  PTK_BOOL = 5,
+} PTK_Dtype;
+
+#define PTK_MAX_NDIM 8
+
+typedef struct {
+  void* data;                 /* contiguous buffer */
+  int64_t ndim;
+  int64_t shape[PTK_MAX_NDIM];
+  int32_t dtype;              /* PTK_Dtype */
+} PTK_Tensor;
+
+/* kernel signature: return 0 on success */
+typedef int (*PTK_Kernel)(const PTK_Tensor* inputs, int n_inputs,
+                          PTK_Tensor* outputs, int n_outputs);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_PLUGIN_H_ */
