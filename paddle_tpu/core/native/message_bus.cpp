@@ -163,6 +163,11 @@ void reader_loop(Bus* bus, int fd) {
                      "[message_bus] rank %d: peer presented an auth token but "
                      "this bus has none (PADDLE_BUS_TOKEN mismatch between "
                      "ranks); closing link\n", bus->rank);
+      else
+        std::fprintf(stderr,
+                     "[message_bus] rank %d: peer auth token mismatch "
+                     "(PADDLE_BUS_TOKEN differs between ranks); closing "
+                     "link\n", bus->rank);
       ::close(fd);
       return;
     }

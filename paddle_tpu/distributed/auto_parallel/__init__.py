@@ -267,6 +267,11 @@ class Engine:
                 # restart from batch 0 on the epoch loop anyway
                 loader = itertools.chain([first], it)
         self._ensure_step()
+        if epochs > 1 and iter(loader) is loader:
+            # a one-shot iterator would be exhausted after epoch 1 and later
+            # epochs would silently train nothing — materialize so every
+            # epoch sees the full data
+            loader = list(loader)
         history = []
         for _ in range(epochs):
             last = None

@@ -387,10 +387,11 @@ def all_gather_object(object_list: List, obj, group: Optional[Group] = None):
         be = _MPBackend.get()
         blob = np.frombuffer(pickle.dumps(obj), np.uint8)
         n = np.asarray([blob.size], np.int64)
-        max_n = int(be.allgather_np(n).max())
+        sizes_all = be.allgather_np(n)
+        max_n = int(sizes_all.max())
         padded = np.zeros(max_n, np.uint8)
         padded[:blob.size] = blob
-        sizes = be.allgather_np(n)[:, 0]
+        sizes = sizes_all[:, 0]
         blobs = be.allgather_np(padded)
         for r in range(be.world):
             object_list.append(pickle.loads(blobs[r][:sizes[r]].tobytes()))

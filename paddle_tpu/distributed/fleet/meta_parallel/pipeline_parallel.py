@@ -338,6 +338,10 @@ class PipelineParallel(InnerLayerDelegate, Layer):
                 warnings.warn(f"compiled ring disabled, using the eager "
                               f"fallback (no stage overlap): {e}")
                 self._ring = None
+                # _ring_step may have landed (partial) grads before failing;
+                # the eager loop below re-runs the same batch, so start clean
+                # or the batch would be double-applied
+                optimizer.clear_grad()
             else:
                 if lr_scheduler is not None:
                     lr_scheduler.step()

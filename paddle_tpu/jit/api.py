@@ -279,10 +279,11 @@ def save(layer, path, input_spec=None, **configs):
     Reference: paddle.jit.save → *.pdmodel (ProgramDesc) + *.pdiparams.
 
     configs["passes"]: ordered pre-lowering pass names
-    (inference/passes.py) applied to the layer IN PLACE before export —
-    the reference runs its pass list at Predictor-load time
-    (paddle_pass_builder.cc); here semantic rewrites (int8 quant, dropout
-    removal) happen before XLA lowers the graph.
+    (inference/passes.py) applied to a deep COPY of the layer before
+    export — the caller's live model is never mutated. The reference runs
+    its pass list at Predictor-load time (paddle_pass_builder.cc); here
+    semantic rewrites (int8 quant, dropout removal) happen before XLA
+    lowers the graph.
     """
     from jax import export as jax_export
     from ..framework import io as fio
@@ -308,6 +309,7 @@ def save(layer, path, input_spec=None, **configs):
         specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
                  for s in input_spec]
 
+        was_training = getattr(layer, "training", False)
         layer.eval()
         raw_forward = (layer.forward._fn if isinstance(layer.forward, StaticFunction)
                        else layer.forward)
@@ -371,6 +373,8 @@ def save(layer, path, input_spec=None, **configs):
                   "buffers": {name: b for name, b in layer.named_buffers()},
                   "input_specs": [(tuple(s.shape), str(s.dtype)) for s in specs]},
                  path + ".pdiparams")
+        if was_training:
+            layer.train()  # restore the caller's mode (export forced eval)
         return
     raise ValueError("jit.save expects a Layer")
 
