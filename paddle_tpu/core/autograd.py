@@ -131,14 +131,36 @@ class GradNode:
         return f"GradNode({self.name})"
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
+def _cached_fill_small(shape, dt, v):
+    return jnp.full(shape, v, dt)
+
+
+def _cached_fill(shape, dt, v):
+    # zero/one cotangent seeds are immutable constants; through a remote PJRT
+    # tunnel each uncached jnp.zeros is a ~0.3ms device op and the backward
+    # walk seeds one per unused output slot (e.g. BN's mean/var outputs).
+    # Only SMALL seeds are cached — caching activation-sized buffers would pin
+    # arbitrary HBM for the process lifetime under shape-diverse workloads.
+    n = dt.itemsize
+    for s in shape:
+        n *= s
+    if n <= (1 << 20):
+        return _cached_fill_small(shape, dt, v)
+    return jnp.full(shape, v, dt)
+
+
 def _ones_like_meta(meta):
     shape, dt = meta
-    return jnp.ones(shape, dt)
+    return _cached_fill(tuple(shape), jnp.dtype(dt), 1)
 
 
 def _zeros_like_meta(meta):
     shape, dt = meta
-    return jnp.zeros(shape, dt)
+    return _cached_fill(tuple(shape), jnp.dtype(dt), 0)
 
 
 def _build_indegree(roots: Sequence[GradNode]) -> Dict[GradNode, int]:
@@ -224,7 +246,7 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                 raise RuntimeError(
                     "grad must be provided for non-scalar backward roots "
                     f"(shape {t.shape})")
-            g_arr = jnp.ones(t.shape, t.dtype)
+            g_arr = _ones_like_meta((tuple(t.shape), t.dtype))
         else:
             g_arr = g.value() if isinstance(g, Tensor) and not create_graph \
                 else (g if isinstance(g, Tensor) else jnp.asarray(g))

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtypes
+from . import lazy
 from .flags import flag
 
 
@@ -193,6 +194,14 @@ def _fwd_exec(name: str, attr_key: Tuple):
 
 
 @functools.lru_cache(maxsize=None)
+def _raw_fwd(name: str, attr_key: Tuple):
+    """Unjitted fwd with attrs baked — the lazy-graph node function."""
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+    return functools.partial(op.fwd, **attrs) if attrs else op.fwd
+
+
+@functools.lru_cache(maxsize=None)
 def _bwd_exec(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
     """Generic backward executable: recompute-vjp of fwd w.r.t. diff_idx inputs."""
     op = _REGISTRY[name]
@@ -210,6 +219,76 @@ def _bwd_exec(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
         return vjp_fn(tuple(cotangents))
 
     return jax.jit(bwd)
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_raw(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
+    """Flat-args unjitted generic vjp — the lazy-graph node function."""
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+
+    def raw(*flat):
+        primals, cts = flat[:n_in], flat[n_in:]
+
+        def f(*diff_primals):
+            full = list(primals)
+            for slot, p in zip(diff_idx, diff_primals):
+                full[slot] = p
+            out = op.fwd(*full, **attrs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        _, vjp_fn = jax.vjp(f, *[primals[i] for i in diff_idx])
+        return vjp_fn(tuple(cts))
+
+    return raw
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_call(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
+    """Mode-agnostic generic-backward entry: records lazily when deferred-eager
+    is active (the whole bwd walk fuses into the flush executable), otherwise
+    runs the cached jitted vjp."""
+
+    def call(primals, cotangents):
+        if lazy.enabled():
+            raw = _bwd_raw(name, attr_key, diff_idx, n_in)
+            return lazy.record(("gbwd", name, attr_key, diff_idx, n_in), raw,
+                               tuple(primals) + tuple(cotangents))
+        primals = tuple(lazy.concrete(p) for p in primals)
+        cotangents = tuple(lazy.concrete(c) for c in cotangents)
+        return _bwd_exec(name, attr_key, diff_idx, n_in)(primals, cotangents)
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _ebwd_raw(name: str, attr_key: Tuple, n_p: int, n_o: int):
+    op = _REGISTRY[name]
+    attrs = dict((k, v) for k, v in attr_key)
+
+    def raw(*flat):
+        ps, os_, cts = flat[:n_p], flat[n_p:n_p + n_o], flat[n_p + n_o:]
+        return op.bwd(ps, os_, cts, **attrs)
+
+    return raw
+
+
+@functools.lru_cache(maxsize=None)
+def _explicit_bwd_call(name: str, attr_key: Tuple):
+    op = _REGISTRY[name]
+
+    def call(primals, outs, cotangents):
+        if lazy.enabled() and not op.no_jit:
+            raw = _ebwd_raw(name, attr_key, len(primals), len(outs))
+            return lazy.record(
+                ("ebwd", name, attr_key, len(primals), len(outs)), raw,
+                tuple(primals) + tuple(outs) + tuple(cotangents))
+        primals = tuple(lazy.concrete(p) for p in primals)
+        outs = tuple(lazy.concrete(o) for o in outs)
+        cotangents = tuple(lazy.concrete(c) for c in cotangents)
+        return _explicit_bwd_exec(name, attr_key)(primals, outs, cotangents)
+
+    return call
 
 
 @functools.lru_cache(maxsize=None)
@@ -254,11 +333,19 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
     in_tensors = []
     for a in tensor_args:
         if isinstance(a, Tensor):
-            arrays.append(a.value())
+            arrays.append(a._data)  # lazy-capable (value() would force)
             requires.append((not a.stop_gradient) and dtypes.is_differentiable(a.dtype))
             in_tensors.append(a)
         else:
-            arrays.append(a if isinstance(a, jax.Array) else jnp.asarray(a))
+            if isinstance(a, (jax.Array, lazy.LazyArray)):
+                arrays.append(a)
+            elif isinstance(a, (bool, int, float)) and not in_trace():
+                # device constants, transferred once — a bare jnp.asarray(2.0)
+                # is a ~3ms host→device RPC through the tunnel, and scalar
+                # operands (BN momentum, scale factors) appear on every op
+                arrays.append(lazy.scalar_const(a))
+            else:
+                arrays.append(jnp.asarray(a))
             requires.append(False)
             in_tensors.append(None)
 
@@ -275,8 +362,15 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
         # Inside a to_static trace: call the raw function so everything inlines into the
         # enclosing jit; no per-op executables, no autograd tape (grad via whole-graph vjp).
         # no_jit ops (host kernels) also run raw: they cannot live in an executable.
+        if op.no_jit:
+            arrays = [lazy.concrete(a) for a in arrays]
         outs = op.fwd(*arrays, **attrs)
+    elif lazy.enabled():
+        # deferred eager: record into the lazy graph; one fused executable
+        # materializes the whole pending stream on first observation
+        outs = lazy.record(("fwd", name, key), _raw_fwd(name, key), arrays)
     else:
+        arrays = [lazy.concrete(a) for a in arrays]
         outs = _fwd_exec(name, key)(*arrays)
     if hook is not None:
         # host-side dispatch cost (the reference host tracer's op event analog;
@@ -296,10 +390,10 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
                          if r and i not in op.nondiff_inputs)
         if diff_idx:
             if op.bwd is not None:
-                bwd_fn = _explicit_bwd_exec(name, key)
+                bwd_fn = _explicit_bwd_call(name, key)
                 mode = "explicit"
             else:
-                bwd_fn = _bwd_exec(name, key, diff_idx, len(arrays))
+                bwd_fn = _bwd_call(name, key, diff_idx, len(arrays))
                 mode = "generic"
             node = GradNode(name=name, bwd_fn=bwd_fn, mode=mode,
                             saved_primals=tuple(arrays),

@@ -1,0 +1,361 @@
+"""Deferred-eager execution: batch the eager op stream into fused XLA executables.
+
+SURVEY.md §7 hard part (a) — per-op "eager" dispatch on an AOT-compiled device pays
+one executable launch per op, and through a remote PJRT tunnel each launch costs
+~0.5 ms regardless of compute. The reference hides per-op latency with a C++ async
+dispatch queue (fluid/eager + phi kernels are microseconds on CUDA); the TPU-native
+equivalent is *deferral*: record ops into a graph, materialize on observation, and
+compile the whole pending region into ONE cached executable (the torch/XLA
+"LazyTensor" design, rebuilt on jax primitives).
+
+How it works:
+  - `record(key, fn, args)` appends a node (a pure jax-traceable `fn` over flat
+    array args) and returns `LazyArray` placeholders whose shapes/dtypes come from
+    a cached `jax.eval_shape` — no device work at op time.
+  - Any observation (`Tensor.value()`, `.numpy()`, `float()`, jit entry, …) calls
+    `LazyArray.force()`, which flushes the WHOLE pending graph: all still-alive
+    LazyArrays become outputs of one `jax.jit`-compiled replay function, cached by
+    the graph's structural signature. A steady-state training loop hits the cache
+    and runs fwd+bwd as a single executable per step — intermediates whose
+    GradNodes were released during backward are dead by flush time, so XLA DCEs
+    and fuses them exactly like a compiled train step.
+  - Python scalars become device constants through `scalar_const` (cached): through
+    the tunnel a single `jnp.asarray(2.0)` is a ~3 ms host→device transfer.
+
+Enabled when FLAGS_eager_fusion is set, the process sees a single device (multi-
+device eager keeps explicit per-op placement semantics), FLAGS_check_nan_inf is
+off, and no to_static trace is active. Everything else (autograd tape, hooks,
+version counters) is unchanged — laziness lives strictly below the Tensor layer.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .flags import flag
+
+_tls = threading.local()
+
+# (key, input avals) -> (out_treedef, [ShapeDtypeStruct]) — eval_shape is ~0.3 ms,
+# far too slow to run per op; steady-state loops hit this cache.
+_SHAPE_CACHE: Dict[Tuple, Tuple] = {}
+
+# graph structural signature -> compiled replay executable
+_EXEC_CACHE: Dict[Tuple, Any] = {}
+
+# python scalar -> device constant (dedups the per-op host→device transfer)
+_CONST_CACHE: Dict[Tuple, jax.Array] = {}
+
+_SINGLE_DEVICE: Optional[bool] = None
+
+_MAX_NODES = 8192  # safety valve: unobserved streams flush periodically
+
+
+def enabled() -> bool:
+    if not flag("FLAGS_eager_fusion") or flag("FLAGS_check_nan_inf"):
+        return False
+    global _SINGLE_DEVICE
+    if _SINGLE_DEVICE is None:
+        _SINGLE_DEVICE = jax.device_count() == 1
+    return _SINGLE_DEVICE
+
+
+def scalar_const(v) -> jax.Array:
+    """Device constant for a python/numpy scalar, transferred once per value."""
+    import jax.numpy as jnp
+    key = (type(v).__name__, v)
+    c = _CONST_CACHE.get(key)
+    if c is None:
+        if len(_CONST_CACHE) > 65536:
+            _CONST_CACHE.clear()
+        c = _CONST_CACHE[key] = jnp.asarray(v)
+    return c
+
+
+class _Node:
+    __slots__ = ("key", "fn", "args", "out_refs", "sig")
+
+    def __init__(self, key, fn, args, n_out):
+        self.key = key
+        self.fn = fn          # pure traceable: fn(*flat_arrays) -> pytree
+        self.args = args      # [('l', leaf_idx) | ('n', node_idx, out_pos)]
+        self.out_refs: List = [None] * n_out
+        self.sig = (key, tuple(args))
+
+
+class LazyGraph:
+    __slots__ = ("nodes", "leaves", "leaf_ids", "flushed")
+
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        self.leaves: List[jax.Array] = []
+        self.leaf_ids: Dict[int, int] = {}
+        self.flushed = False
+
+    def _leaf(self, arr) -> Tuple:
+        i = self.leaf_ids.get(id(arr))
+        if i is None:
+            i = len(self.leaves)
+            self.leaves.append(arr)
+            self.leaf_ids[id(arr)] = i
+        return ("l", i)
+
+    def flush(self):
+        if self.flushed:
+            return
+        self.flushed = True
+        if _tls.__dict__.get("graph") is self:
+            _tls.graph = None
+        if not self.nodes:
+            return
+        out_slots = []
+        targets = []
+        for ni, node in enumerate(self.nodes):
+            for pos, ref in enumerate(node.out_refs):
+                la = ref() if ref is not None else None
+                if la is not None:
+                    out_slots.append((ni, pos))
+                    targets.append(la)
+        leaf_avals = tuple((a.shape, a.dtype) for a in self.leaves)
+        sig = (tuple(n.sig for n in self.nodes), leaf_avals, tuple(out_slots))
+        exe = _EXEC_CACHE.get(sig)
+        if exe is None:
+            exe = _EXEC_CACHE[sig] = jax.jit(_build_replay(self.nodes, out_slots))
+        results = exe(self.leaves)
+        for la, r in zip(targets, results):
+            la._concrete = r
+        # free the recorded graph (saved activations live on as jax Arrays only
+        # where a LazyArray target still holds them)
+        self.nodes = []
+        self.leaves = []
+        self.leaf_ids = {}
+
+
+def _build_replay(nodes, out_slots):
+    tree_leaves = jax.tree_util.tree_leaves
+
+    def replay(leaves):
+        env = []
+        for node in nodes:
+            args = [leaves[e[1]] if e[0] == "l" else env[e[1]][e[2]]
+                    for e in node.args]
+            env.append(tree_leaves(node.fn(*args)))
+        return [env[i][p] for i, p in out_slots]
+
+    return replay
+
+
+class LazyArray:
+    """Placeholder for a pending op output; quacks like a jax.Array for the
+    Tensor layer (shape/dtype/astype), materializes on observation."""
+
+    __slots__ = ("_graph", "_node", "_pos", "aval", "_concrete", "__weakref__")
+
+    def __init__(self, graph, node, pos, aval):
+        self._graph = graph
+        self._node = node
+        self._pos = pos
+        self.aval = aval
+        self._concrete = None
+
+    # ---------------------------------------------------------------- metadata
+    @property
+    def shape(self):
+        return self.aval.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.aval.shape:
+            n *= s
+        return n
+
+    # ---------------------------------------------------------------- observe
+    @property
+    def weak_type(self):
+        return getattr(self.aval, "weak_type", False)
+
+    def force(self) -> jax.Array:
+        if self._concrete is None:
+            self._graph.flush()
+            if self._concrete is None:
+                raise RuntimeError(
+                    "deferred-eager value lost: its graph was flushed earlier "
+                    "without materializing it (a previous flush raised, or the "
+                    "graph was flushed from another thread before this value "
+                    "was recorded)")
+        return self._concrete
+
+    def block_until_ready(self):
+        return self.force().block_until_ready()
+
+    def devices(self):
+        return self.force().devices()
+
+    def __jax_array__(self):
+        return self.force()
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self.force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.asarray(self.force()))
+
+    def __int__(self):
+        return int(np.asarray(self.force()))
+
+    def __bool__(self):
+        return bool(np.asarray(self.force()))
+
+    def __repr__(self):
+        state = "pending" if self._concrete is None else "ready"
+        return f"LazyArray({self.aval.shape}, {self.aval.dtype}, {state})"
+
+    # ------------------------------------------------------------- lazy math
+    # (the Tensor layer routes math through dispatch; these cover raw-array
+    # touch points like gradient accumulation `a + b` in the autograd walk)
+    def astype(self, dt):
+        try:
+            if self.dtype == np.dtype(dt):
+                return self
+        except TypeError:
+            pass
+        return record(("cast", str(dt)), lambda a: a.astype(dt), (self,))
+
+    def _binop(self, name, fn, other, reverse=False):
+        if isinstance(other, (int, float, bool)):
+            other = scalar_const(other)
+        elif not isinstance(other, (jax.Array, LazyArray)):
+            return NotImplemented
+        args = (other, self) if reverse else (self, other)
+        return record((name, reverse), fn, args)
+
+    def __add__(self, o):
+        import jax.numpy as jnp
+        return self._binop("ladd", jnp.add, o)
+
+    def __radd__(self, o):
+        import jax.numpy as jnp
+        return self._binop("ladd", jnp.add, o, reverse=True)
+
+    def __mul__(self, o):
+        import jax.numpy as jnp
+        return self._binop("lmul", jnp.multiply, o)
+
+    def __rmul__(self, o):
+        import jax.numpy as jnp
+        return self._binop("lmul", jnp.multiply, o, reverse=True)
+
+    def __sub__(self, o):
+        import jax.numpy as jnp
+        return self._binop("lsub", jnp.subtract, o)
+
+    def __rsub__(self, o):
+        import jax.numpy as jnp
+        return self._binop("lsub", jnp.subtract, o, reverse=True)
+
+    def __truediv__(self, o):
+        import jax.numpy as jnp
+        return self._binop("ldiv", jnp.divide, o)
+
+    def __neg__(self):
+        import jax.numpy as jnp
+        return record(("lneg",), jnp.negative, (self,))
+
+
+def concrete(x):
+    """Materialize if lazy; pass anything else through."""
+    return x.force() if type(x) is LazyArray else x
+
+
+def _current_graph() -> LazyGraph:
+    g = _tls.__dict__.get("graph")
+    if g is None or g.flushed:
+        # g.flushed: another thread forced this graph (flush() clears only the
+        # OWNER's thread-local); recording into a flushed graph would strand
+        # the new nodes — they'd never execute
+        g = _tls.graph = LazyGraph()
+    return g
+
+
+def flush_all():
+    """Materialize every pending op on this thread (profiling/debug aid)."""
+    g = _tls.__dict__.get("graph")
+    if g is not None:
+        g.flush()
+
+
+def record(key, fn: Callable, args: Sequence):
+    """Record fn(*args) as a lazy node; returns fn's output pytree with
+    LazyArray leaves. `key` must capture fn's behavior completely (it is the
+    unit of the executable cache signature). `args` are jax Arrays, LazyArrays,
+    or numpy arrays (anything np/python is promoted to a leaf)."""
+    import jax.numpy as jnp
+
+    g = _current_graph()
+    if len(g.nodes) >= _MAX_NODES:
+        g.flush()
+        g = _current_graph()
+
+    encoded = []
+    avals = []
+    for a in args:
+        if type(a) is LazyArray:
+            if a._concrete is not None or a._graph is not g:
+                arr = a.force()
+                encoded.append(g._leaf(arr))
+                avals.append((arr.shape, arr.dtype, arr.weak_type))
+            else:
+                encoded.append(("n", a._node, a._pos))
+                avals.append((a.aval.shape, a.aval.dtype, False))
+        else:
+            if not isinstance(a, jax.Array):
+                a = jnp.asarray(a)
+            encoded.append(g._leaf(a))
+            # weak_type matters: jnp.asarray(2.0) is weak f32, and
+            # bf16 * weak-f32 stays bf16 — dropping weakness here would make
+            # the recorded dtype diverge from the flushed value
+            avals.append((a.shape, a.dtype, getattr(a, "weak_type", False)))
+
+    shape_key = (key, tuple(avals))
+    cached = _SHAPE_CACHE.get(shape_key)
+    if cached is None:
+        structs = [jax.core.ShapedArray(s, d, weak_type=w) for s, d, w in avals]
+        out_struct = jax.eval_shape(fn, *structs)
+        leaves, treedef = jax.tree_util.tree_flatten(out_struct)
+        cached = _SHAPE_CACHE[shape_key] = (treedef, tuple(leaves))
+    treedef, out_avals = cached
+
+    node_idx = len(g.nodes)
+    node = _Node(key, fn, tuple(encoded), len(out_avals))
+    g.nodes.append(node)
+    las = []
+    for pos, aval in enumerate(out_avals):
+        la = LazyArray(g, node_idx, pos, aval)
+        node.out_refs[pos] = weakref.ref(la)
+        las.append(la)
+    return jax.tree_util.tree_unflatten(treedef, las)
+
+
+def cache_stats():
+    return {"shape_cache": len(_SHAPE_CACHE), "exec_cache": len(_EXEC_CACHE),
+            "const_cache": len(_CONST_CACHE)}
+
+
+def clear_caches():
+    _SHAPE_CACHE.clear()
+    _EXEC_CACHE.clear()
+    _CONST_CACHE.clear()

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtypes
+from .lazy import LazyArray
 
 def _complex_transfer_ok(arr) -> bool:
     """TPU runtimes in this fleet cannot transfer complex buffers host-ward
@@ -45,7 +46,7 @@ class Tensor:
             arr = data.value()
             if dt is not None and arr.dtype != dt:
                 arr = arr.astype(dt)
-        elif isinstance(data, jax.Array):
+        elif isinstance(data, (jax.Array, LazyArray)):
             arr = data if dt is None or data.dtype == dt else data.astype(dt)
         else:
             np_arr = np.asarray(data)
@@ -75,9 +76,17 @@ class Tensor:
     # ------------------------------------------------------------- storage access
 
     def value(self) -> jax.Array:
-        return self._data
+        # the public boundary out of deferred-eager land: everything holding a
+        # .value() result (optimizers, jit entry, collectives, user code) gets
+        # a real jax.Array; internals that can stay lazy read ._data
+        d = self._data
+        if type(d) is LazyArray:
+            d = d.force()
+            self._data = d
+        return d
 
     def numpy(self) -> np.ndarray:
+        self.value()  # force + cache any pending lazy computation
         if jnp.iscomplexobj(self._data) and \
                 not _complex_transfer_ok(self._data):
             # this TPU runtime can't transfer complex buffers host-ward;
@@ -289,7 +298,7 @@ class Tensor:
     def set_value(self, value):
         if isinstance(value, Tensor):
             arr = value.value()
-        elif isinstance(value, jax.Array):
+        elif isinstance(value, (jax.Array, LazyArray)):
             arr = value  # keep on device — np.asarray here would round-trip HBM→host
         else:
             arr = jnp.asarray(np.asarray(value))

@@ -64,12 +64,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
                     if i != channel_axis:
                         n *= s
                 unbiased = batch_var * (n / max(n - 1, 1))
+                # Tensor-level arithmetic (not .value() math): under deferred
+                # eager the update records into the lazy graph instead of
+                # forcing a flush per BN layer
+                new_mean = running_mean * m + batch_mean * (1 - m)
+                new_var = running_var * m + unbiased * (1 - m)
                 running_mean._set_value_inplace(
-                    (running_mean.value() * m + batch_mean.value() * (1 - m))
-                    .astype(running_mean.dtype))
+                    new_mean._data.astype(running_mean.dtype))
                 running_var._set_value_inplace(
-                    (running_var.value() * m + unbiased.value() * (1 - m))
-                    .astype(running_var.dtype))
+                    new_var._data.astype(running_var.dtype))
         return out
     args = [x, running_mean, running_var] + ([weight, bias] if has_affine else [])
     return _op("batch_norm_infer", *args, epsilon=float(epsilon),

@@ -123,8 +123,20 @@ class Optimizer:
 
     def _scalars(self, lr):
         self._step_count += 1
-        return {"lr": jnp.asarray(lr, jnp.float32),
-                "step": jnp.asarray(self._step_count, jnp.float32)}
+        from ..core.lazy import scalar_const
+        # lr values repeat across steps (cached device constants — an uncached
+        # 8-byte host→device transfer is ~3ms through the tunnel); the step
+        # counter changes every call, so keep it on device and bump it there
+        dev = getattr(self, "_step_dev", None)
+        if dev is not None and getattr(self, "_step_dev_count", None) \
+                == self._step_count - 1:
+            step = dev + 1.0
+        else:  # first step, or _step_count was reset (state_dict load)
+            step = jnp.asarray(float(self._step_count), jnp.float32)
+        self._step_dev = step
+        self._step_dev_count = self._step_count
+        return {"lr": scalar_const(float(lr)).astype(jnp.float32),
+                "step": step}
 
     # ------------------------------------------------------------ step
 
@@ -136,7 +148,18 @@ class Optimizer:
                   if p.trainable and p._grad is not None]
         if not params:
             return
-        grads = [p._grad for p in params]
+        # deferred-eager boundary: concretizing the first grad flushes the whole
+        # pending fwd+bwd stream as ONE fused executable; the rest are ready
+        from ..core.lazy import concrete
+
+        def _conc(g):
+            if isinstance(g, SelectedRows):
+                g.rows = concrete(g.rows)
+                g.values = concrete(g.values)
+                return g
+            return concrete(g)
+
+        grads = [_conc(p._grad) for p in params]
         if self._grad_clip is not None:
             clipped = self._grad_clip(list(zip(params, grads)))
             grads = [g for _, g in clipped]
@@ -187,7 +210,9 @@ class Optimizer:
         static_key = self._static_config() + (("lr_scales", lr_scales),
                                               ("wd_scales", wd_scales))
         new_params, new_states = _jitted_update(type(self), static_key)(
-            param_vals, [g.astype(v.dtype) for g, v in zip(grads, param_vals)],
+            param_vals,
+            [g if g.dtype == v.dtype else g.astype(v.dtype)
+             for g, v in zip(grads, param_vals)],
             states, scalars)
 
         for p, newv, news, m in zip(params, new_params, new_states, use_master):
