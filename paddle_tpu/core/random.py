@@ -18,6 +18,27 @@ _lock = threading.Lock()
 _state = {"seed": 0}
 _rng_tensor = None  # the single source of truth for the key, once materialized
 
+# TPU-native PRNG: the default threefry key chain costs ~10 VPU ops/element
+# wherever jax.random draws inside a kernel (dropout masks, init); the "rbg"
+# impl rides the hardware generator (reference analog: curand Philox states in
+# phi dropout/init kernels). CPU keeps threefry (exact, splittable). Deferred
+# to first key creation: jax.default_backend() initializes XLA, which must not
+# happen at import time (launcher workers call jax.distributed.initialize()
+# first).
+_prng_impl_chosen = False
+
+
+def _ensure_prng_impl():
+    global _prng_impl_chosen
+    if _prng_impl_chosen:
+        return
+    _prng_impl_chosen = True
+    try:
+        if jax.default_backend() == "tpu":
+            jax.config.update("jax_default_prng_impl", "rbg")
+    except Exception:
+        pass
+
 
 def rng_state_tensor():
     """The global key as a Tensor, so to_static can thread it as program state.
@@ -29,6 +50,7 @@ def rng_state_tensor():
     global _rng_tensor
     if _rng_tensor is None:
         from .tensor import Tensor
+        _ensure_prng_impl()
         _rng_tensor = Tensor(jax.random.PRNGKey(_state["seed"]))
         _rng_tensor.name = "__global_rng_state__"
         _rng_tensor.persistable = True
@@ -37,6 +59,7 @@ def rng_state_tensor():
 
 def seed(value: int):
     import numpy as _np
+    _ensure_prng_impl()
     with _lock:
         _state["seed"] = int(value)
         rng_state_tensor()._data = jax.random.PRNGKey(int(value))
@@ -46,6 +69,13 @@ def seed(value: int):
 
 def get_seed() -> int:
     return _state["seed"]
+
+
+def int32_seed():
+    """Fresh int32 scalar from the global key chain — THE seed recipe for
+    in-kernel hardware-PRNG ops (pallas flash dropout, pallas dropout).
+    Kept in one place so every kernel's RNG stream derives identically."""
+    return jax.random.key_data(split_key()).ravel()[0].astype("int32")
 
 
 def split_key():

@@ -1,0 +1,114 @@
+"""Pallas TPU dropout: hardware-PRNG mask generation fused with apply.
+
+Reference analog: phi/kernels/gpu/dropout_kernel.cu (curand mask + scale in one
+kernel). The XLA path pays the counter-based threefry chain (~10 VPU ops per
+element) plus separate compare/select passes — measured ~3 ms per [64,512,768]
+dropout on a v5e, ~78 ms of a BERT-base train step. This kernel draws bits from
+the TPU hardware PRNG (pltpu.prng_random_bits), so mask-gen + apply is ~2 VPU
+passes. The backward regenerates the identical mask from the same seed — the
+mask never exists in HBM in either direction.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, scale):
+    i = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0], i)
+    bits = pltpu.prng_random_bits(x_ref.shape)
+    bits = jax.lax.bitwise_and(bits, jnp.int32(0x7FFFFFFF))
+    threshold = jnp.int32(int(rate * 2147483648.0))
+    keep = bits >= threshold
+    o_ref[:] = jnp.where(keep, x_ref[:] * scale, 0.0).astype(o_ref.dtype)
+
+
+def _row_block(rows, cols, itemsize):
+    """Pick a row-tile so each block stays ~1MB (VMEM-friendly, few grid steps)."""
+    target = max(1, (1 << 20) // max(1, cols * itemsize))
+    block = 1
+    while block * 2 <= target and block * 2 <= rows:
+        block *= 2
+    while rows % block:
+        block //= 2
+    return max(block, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("rate", "scale", "shape"))
+def _dropout_2d(x2, seed, rate, scale, shape):
+    rows, cols = x2.shape
+    block = _row_block(rows, cols, x2.dtype.itemsize)
+    out = pl.pallas_call(
+        functools.partial(_dropout_kernel, rate=rate, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(rows // block,),
+            in_specs=[pl.BlockSpec((block, cols), lambda i, *_: (i, 0))],
+            out_specs=pl.BlockSpec((block, cols), lambda i, *_: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x2.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )(seed, x2)
+    return out.reshape(shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def dropout_tpu(x, seed, rate: float, upscale: bool = True):
+    """dropout(x) with the mask drawn in-kernel from `seed` (int32 scalar
+    array). Deterministic per seed: calling twice with the same seed gives the
+    same mask — the backward relies on exactly this (the custom_vjp applies
+    the identical kernel to the cotangent; the mask never exists in HBM)."""
+    return _dropout_apply(x, seed, rate, upscale)
+
+
+def _dropout_vjp_fwd(x, seed, rate, upscale):
+    return _dropout_apply(x, seed, rate, upscale), seed
+
+
+def _dropout_vjp_bwd(rate, upscale, seed, g):
+    return _dropout_apply(g, seed, rate, upscale), None
+
+
+dropout_tpu.defvjp(_dropout_vjp_fwd, _dropout_vjp_bwd)
+
+
+def _dropout_apply(x, seed, rate: float, upscale: bool = True):
+    shape = tuple(x.shape)
+    n = 1
+    for s in shape:
+        n *= s
+    cols = shape[-1] if len(shape) >= 2 else n
+    if cols % 128 or (n // cols) < 1 or n % cols:
+        # lane-quantum fallback: flatten to a 128-wide 2D form when possible
+        cols = 128 if n % 128 == 0 else 0
+    if cols == 0:
+        raise ValueError(f"dropout_tpu needs size % 128 == 0, got shape {shape}")
+    x2 = x.reshape(n // cols, cols)
+    scale = (1.0 / (1.0 - rate)) if upscale else 1.0
+    return _dropout_2d(x2, jnp.atleast_1d(jnp.asarray(seed, jnp.int32)),
+                       float(rate), float(scale), shape)
+
+
+def dropout_path_available(x) -> bool:
+    """TPU placement + lane-quantum size check (no interpret lowering for the
+    hardware PRNG). Must NOT observe the value: under deferred eager a
+    .value() here would flush the pending graph at every dropout call."""
+    n = 1
+    for s in x.shape:
+        n *= s
+    if n == 0 or n % 128:
+        return False
+    arr = getattr(x, "_data", x)
+    if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
+        try:
+            return any(d.platform == "tpu" for d in arr.devices())
+        except Exception:
+            pass
+    # tracers and LazyArrays: decide by where the program will run
+    return jax.default_backend() == "tpu"

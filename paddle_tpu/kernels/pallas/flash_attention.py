@@ -59,25 +59,36 @@ def _dropout_mask(seed_ref, bh, qi, kb, shape, rate):
     return bits >= threshold
 
 
-def _valid_mask(qi, kb, *, causal, block_q, block_k, kv_len, causal_offset):
-    """Entry validity for a boundary tile: kv-padding columns off, and (for
-    causal) entries above the diagonal off. Shared by all three kernels so
-    fwd and bwd probabilities can never desynchronize."""
+def _valid_mask(qi, kb, *, causal, block_q, block_k, kv_len, causal_offset,
+                len_b=None, sq=None, sk=None):
+    """Entry validity for a boundary tile: kv-padding columns off, (for causal)
+    entries above the diagonal off, (with per-sequence lengths) columns at or
+    beyond this sequence's key count off, and (with segment ids) cross-segment
+    entries off. Shared by all three kernels so fwd and bwd probabilities can
+    never desynchronize."""
     cols = kb * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     valid = cols < kv_len
+    if len_b is not None:
+        valid = valid & (cols < len_b)
     if causal:
         rows = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         valid = valid & (rows + causal_offset >= cols)
+    if sq is not None:
+        # packed sequences: only same-segment entries attend (reference
+        # encoder semantics: attn over each packed example independently)
+        valid = valid & (sq[0, :][:, None] == sk[0, :][None, :])
     return valid
 
 
 def _tile_liveness(qi, kb, *, causal, block_q, block_k, kv_len, kv_pad,
-                   causal_offset):
+                   causal_offset, len_b=None, has_segs=False):
     """(live, interior): live = the tile has any valid entry; interior = every
     entry is valid, so masking can be skipped. Padding only exists in the last
-    kv tile and only when kv_len isn't a block multiple (static)."""
+    kv tile and only when kv_len isn't a block multiple (static). Per-sequence
+    lengths refine both at runtime; segment ids force masking (no cheap
+    interior test for arbitrary packings)."""
     if causal:
         live = kb * block_k <= (qi + 1) * block_q - 1 + causal_offset
         below_diag = qi * block_q + causal_offset >= (kb + 1) * block_k - 1
@@ -88,7 +99,13 @@ def _tile_liveness(qi, kb, *, causal, block_q, block_k, kv_len, kv_pad,
         unpadded = (kb + 1) * block_k <= kv_len
     else:
         unpadded = True
-    return live, below_diag & unpadded
+    interior = below_diag & unpadded
+    if len_b is not None:
+        live = live & (kb * block_k < len_b)
+        interior = interior & ((kb + 1) * block_k <= len_b)
+    if has_segs:
+        interior = False
+    return live, interior
 
 
 def _grid_ids(grid4d: bool):
@@ -102,15 +119,25 @@ def _grid_ids(grid4d: bool):
             pl.num_programs(2))
 
 
-def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      acc_ref, m_ref, l_ref, *,
+def _flash_fwd_kernel(seed_ref, lens_ref, *refs,
                       sm_scale, causal, block_q, block_k, kv_len, kv_pad,
-                      causal_offset, dropout_rate, grid4d=False):
+                      causal_offset, dropout_rate, has_lens=False,
+                      has_segs=False, n_heads=1, grid4d=False):
     # Grid (bh, q_blocks, kv_blocks), kv innermost: the online-softmax state
     # (acc, m, l) lives in VMEM scratch and carries across kv steps — only
     # O(block) VMEM regardless of sequence length. kv_len is the true key count
     # (inputs are padded); causal_offset = kv_len - q_len aligns the diagonal.
+    # lens_ref ([B] int32 scalar-prefetch) gives per-sequence key counts
+    # (encoder padding masks); sq/sk segment-id tiles gate packed sequences.
+    if has_segs:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, \
+            acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        sq_ref = sk_ref = None
     bh, qi, kb, n_kv = _grid_ids(grid4d)
+    b_idx = pl.program_id(0) if grid4d else bh // n_heads
+    len_b = lens_ref[b_idx] if has_lens else None
 
     @pl.when(kb == 0)
     def _init():
@@ -120,7 +147,8 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     live, interior = _tile_liveness(
         qi, kb, causal=causal, block_q=block_q, block_k=block_k,
-        kv_len=kv_len, kv_pad=kv_pad, causal_offset=causal_offset)
+        kv_len=kv_len, kv_pad=kv_pad, causal_offset=causal_offset,
+        len_b=len_b, has_segs=has_segs)
 
     def body(masked):
         # scale folded into the [block_q, D] query tile, not the score tile
@@ -130,7 +158,9 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         if masked:
             valid = _valid_mask(qi, kb, causal=causal, block_q=block_q,
                                 block_k=block_k, kv_len=kv_len,
-                                causal_offset=causal_offset)
+                                causal_offset=causal_offset, len_b=len_b,
+                                sq=sq_ref[:] if has_segs else None,
+                                sk=sk_ref[:] if has_segs else None)
             s = jnp.where(valid, s, _NEG_INF)
 
         m_prev = m_ref[:]
@@ -171,12 +201,20 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                         + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
 
 
-def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, dq_acc, *,
+def _flash_dq_kernel(seed_ref, lens_ref, *refs,
                      sm_scale, causal, block_q, block_k, kv_len, kv_pad,
-                     causal_offset, dropout_rate, grid4d=False):
+                     causal_offset, dropout_rate, has_lens=False,
+                     has_segs=False, n_heads=1, grid4d=False):
     # Grid (bh, q_blocks, kv_blocks), kv innermost; dq accumulates in VMEM.
+    if has_segs:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref, \
+            dq_ref, dq_acc = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        sq_ref = sk_ref = None
     bh, qi, kb, n_kv = _grid_ids(grid4d)
+    b_idx = pl.program_id(0) if grid4d else bh // n_heads
+    len_b = lens_ref[b_idx] if has_lens else None
 
     @pl.when(kb == 0)
     def _init():
@@ -184,7 +222,8 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     live, interior = _tile_liveness(
         qi, kb, causal=causal, block_q=block_q, block_k=block_k,
-        kv_len=kv_len, kv_pad=kv_pad, causal_offset=causal_offset)
+        kv_len=kv_len, kv_pad=kv_pad, causal_offset=causal_offset,
+        len_b=len_b, has_segs=has_segs)
 
     def body(masked):
         qs = (q_ref[:].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
@@ -195,7 +234,9 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if masked:
             valid = _valid_mask(qi, kb, causal=causal, block_q=block_q,
                                 block_k=block_k, kv_len=kv_len,
-                                causal_offset=causal_offset)
+                                causal_offset=causal_offset, len_b=len_b,
+                                sq=sq_ref[:] if has_segs else None,
+                                sk=sk_ref[:] if has_segs else None)
             p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -223,13 +264,22 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[:] = (dq_acc[:] * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_acc, dv_acc, *,
+def _flash_dkv_kernel(seed_ref, lens_ref, *refs,
                       sm_scale, causal, block_q, block_k, kv_len, kv_pad,
-                      causal_offset, dropout_rate, grid4d=False):
+                      causal_offset, dropout_rate, has_lens=False,
+                      has_segs=False, n_heads=1, grid4d=False):
     # Grid (bh, kv_blocks, q_blocks), q innermost; dk/dv accumulate in VMEM.
     # (under grid4d: (b, h, kv_blocks, q_blocks))
+    if has_segs:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+        sq_ref = sk_ref = None
     bh, kb, qi, n_q = _grid_ids(grid4d)
+    b_idx = pl.program_id(0) if grid4d else bh // n_heads
+    len_b = lens_ref[b_idx] if has_lens else None
 
     @pl.when(qi == 0)
     def _init():
@@ -238,7 +288,8 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     live, interior = _tile_liveness(
         qi, kb, causal=causal, block_q=block_q, block_k=block_k,
-        kv_len=kv_len, kv_pad=kv_pad, causal_offset=causal_offset)
+        kv_len=kv_len, kv_pad=kv_pad, causal_offset=causal_offset,
+        len_b=len_b, has_segs=has_segs)
 
     def body(masked):
         qs = (q_ref[:].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
@@ -249,7 +300,9 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if masked:
             valid = _valid_mask(qi, kb, causal=causal, block_q=block_q,
                                 block_k=block_k, kv_len=kv_len,
-                                causal_offset=causal_offset)
+                                causal_offset=causal_offset, len_b=len_b,
+                                sq=sq_ref[:] if has_segs else None,
+                                sk=sk_ref[:] if has_segs else None)
             p = jnp.where(valid, p, 0.0)
         keep_scale = None
         if dropout_rate > 0.0:
@@ -284,6 +337,62 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(seed_ref, lens_ref, *refs,
+                            sm_scale, causal, block_q, block_k, kv_len, kv_pad,
+                            causal_offset, dropout_rate, has_lens=False,
+                            has_segs=False, n_heads=1):
+    # Single-tile backward: when the whole sequence fits one (block_q, block_k)
+    # tile pair (the common encoder/decoder training shape: L <= 1024), dq, dk
+    # and dv come out of ONE kernel that computes s/p/ds once — the two-kernel
+    # flash backward recomputes the score matrix and its exp twice. Grid (bh,).
+    if has_segs:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref, \
+            dq_ref, dk_ref, dv_ref = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, \
+            dq_ref, dk_ref, dv_ref = refs
+        sq_ref = sk_ref = None
+    bh = pl.program_id(0)
+    b_idx = bh // n_heads
+    len_b = lens_ref[b_idx] if has_lens else None
+
+    qs = (q_ref[:].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+    s = jax.lax.dot_general(qs, k_ref[:], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    lse = lse_ref[0, :][:, None]
+    p = jnp.exp(s - lse)
+    needs_mask = causal or has_segs or has_lens or kv_len < kv_pad
+    if needs_mask:
+        valid = _valid_mask(0, 0, causal=causal, block_q=block_q,
+                            block_k=block_k, kv_len=kv_len,
+                            causal_offset=causal_offset, len_b=len_b,
+                            sq=sq_ref[:] if has_segs else None,
+                            sk=sk_ref[:] if has_segs else None)
+        p = jnp.where(valid, p, 0.0)
+    keep_scale = None
+    if dropout_rate > 0.0:
+        zero = jnp.int32(0)  # qi=kb=0: the single tile (ids must be traced)
+        keep = _dropout_mask(seed_ref, bh, zero, zero, (block_q, block_k),
+                             dropout_rate)
+        keep_scale = jnp.where(keep, 1.0 / (1.0 - dropout_rate), 0.0)
+    p_for_dv = p * keep_scale if keep_scale is not None else p
+    dv_ref[:] = jax.lax.dot_general(
+        p_for_dv.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if keep_scale is not None:
+        dp = dp * keep_scale
+    ds = p * (dp - delta_ref[0, :][:, None])
+    dsc = ds.astype(q_ref.dtype)
+    dq_ref[:] = (jax.lax.dot_general(
+        dsc, k_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale).astype(dq_ref.dtype)
+    dk_ref[:] = (jax.lax.dot_general(
+        dsc, q_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale).astype(dk_ref.dtype)
+
+
 def _round_up(n, m):
     return ((n + m - 1) // m) * m
 
@@ -316,40 +425,67 @@ def _kv_map(n_heads: int, kv_heads: int):
     return lambda b: (b // n_heads) * kv_heads + (b % n_heads) // group
 
 
+def _seg_pads(seg_q, seg_k, q_pad, kv_pad):
+    """Pad segment-id arrays ([B, L] int32) to the padded tile lengths with -1
+    (pad-pad matches are already masked by the static kv_len / lens tests) and
+    reshape to [B, 1, L] so Mosaic lane-tiles them."""
+    sq = _pad_len(seg_q[:, None, :].astype(jnp.int32), q_pad, axis=2)
+    sk = _pad_len(seg_k[:, None, :].astype(jnp.int32), kv_pad, axis=2)
+    return sq, sk
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "block_q",
                                              "block_k", "dropout_rate",
                                              "interpret", "n_heads",
                                              "kv_heads"))
 def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-               dropout_rate=0.0, interpret=False, n_heads=1, kv_heads=1):
+               dropout_rate=0.0, interpret=False, n_heads=1, kv_heads=1,
+               lens=None, seg_q=None, seg_k=None):
     # q: [B*H, Lq, D]; k,v: [B*Hkv, Lk, D] (GQA when Hkv < H; the index map
-    # folds q heads onto their KV head — repeated KV never materializes)
+    # folds q heads onto their KV head — repeated KV never materializes).
+    # lens: [B] int32 per-sequence key counts (encoder padding); seg_q/seg_k:
+    # [B, L] int32 packed-sequence ids (same-segment attention only).
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
     kvm = _kv_map(n_heads, kv_heads)
+    bq = lambda b: b // n_heads  # flat (b*h) -> batch row for lens/segs
     block_q, block_k = _norm_blocks(block_q, block_k, q_len, kv_len)
     q_pad = _round_up(q_len, block_q)
     kv_pad = _round_up(kv_len, block_k)
     q = _pad_len(q, q_pad)
     k = _pad_len(k, kv_pad)
     v = _pad_len(v, kv_pad)
+    has_lens = lens is not None
+    has_segs = seg_q is not None
+    if not has_lens:
+        lens = jnp.zeros((1,), jnp.int32)  # placeholder prefetch (unused)
     grid = (bh, q_pad // block_q, kv_pad // block_k)
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
-        causal_offset=kv_len - q_len, dropout_rate=dropout_rate)
+        causal_offset=kv_len - q_len, dropout_rate=dropout_rate,
+        has_lens=has_lens, has_segs=has_segs, n_heads=n_heads)
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda b, i, j, *_: (kvm(b), j, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda b, i, j, *_: (kvm(b), j, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_segs:
+        sq, sk = _seg_pads(seg_q, seg_k, q_pad, kv_pad)
+        in_specs += [
+            pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (bq(b), 0, i)),
+            pl.BlockSpec((None, 1, block_k), lambda b, i, j, *_: (bq(b), 0, j)),
+        ]
+        inputs += [sq, sk]
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((None, block_k, d),
-                             lambda b, i, j, *_: (kvm(b), j, 0)),
-                pl.BlockSpec((None, block_k, d),
-                             lambda b, i, j, *_: (kvm(b), j, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
                 pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
@@ -367,7 +503,7 @@ def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(seed, q, k, v)
+    )(seed, lens, *inputs)
     return out[:, :q_len], lse
 
 
@@ -376,10 +512,12 @@ def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
                                              "interpret", "n_heads",
                                              "kv_heads"))
 def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
-               dropout_rate=0.0, interpret=False, n_heads=1, kv_heads=1):
+               dropout_rate=0.0, interpret=False, n_heads=1, kv_heads=1,
+               lens=None, seg_q=None, seg_k=None):
     bh, q_len, d = q.shape
     kv_len = k.shape[1]
     kvm = _kv_map(n_heads, kv_heads)
+    bq_map = lambda b: b // n_heads
     block_q, block_k = _norm_blocks(block_q, block_k, q_len, kv_len)
     q_pad = _round_up(q_len, block_q)
     kv_pad = _round_up(kv_len, block_k)
@@ -397,25 +535,93 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
     # not mask. Keep the zero padding of gp/delta if this code changes.
     lsep = _pad_len(lse, q_pad, axis=2)
 
+    has_lens = lens is not None
+    has_segs = seg_q is not None
+    if not has_lens:
+        lens = jnp.zeros((1,), jnp.int32)
+    seg_inputs = []
+    if has_segs:
+        sq, sk = _seg_pads(seg_q, seg_k, q_pad, kv_pad)
+        seg_inputs = [sq, sk]
+
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                   block_k=block_k, kv_len=kv_len, kv_pad=kv_pad,
-                  causal_offset=kv_len - q_len, dropout_rate=dropout_rate)
+                  causal_offset=kv_len - q_len, dropout_rate=dropout_rate,
+                  has_lens=has_lens, has_segs=has_segs, n_heads=n_heads)
 
+    if q_pad == block_q and kv_pad == block_k:
+        # whole sequence in one tile pair: fused dq/dk/dv kernel (computes
+        # s/p once instead of twice across the dq and dkv kernels)
+        fused_common = dict(common)
+        fused_common.pop("grid4d", None)
+        in_specs = [
+            pl.BlockSpec((None, block_q, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, *_: (kvm(b), 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, *_: (kvm(b), 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, *_: (b, 0, 0)),
+        ]
+        if has_segs:
+            in_specs += [
+                pl.BlockSpec((None, 1, block_q),
+                             lambda b, *_: (bq_map(b), 0, 0)),
+                pl.BlockSpec((None, 1, block_k),
+                             lambda b, *_: (bq_map(b), 0, 0)),
+            ]
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, **fused_common),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh,),
+                in_specs=in_specs,
+                out_specs=[
+                    pl.BlockSpec((None, block_q, d), lambda b, *_: (b, 0, 0)),
+                    pl.BlockSpec((None, block_k, d), lambda b, *_: (b, 0, 0)),
+                    pl.BlockSpec((None, block_k, d), lambda b, *_: (b, 0, 0)),
+                ],
+            ),
+            out_shape=[jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                       jax.ShapeDtypeStruct((bh,) + kp.shape[1:], k.dtype),
+                       jax.ShapeDtypeStruct((bh,) + vp.shape[1:], v.dtype)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=interpret,
+        )(seed, lens, qp, kp, vp, gp, lsep, delta, *seg_inputs)
+        if kv_heads != n_heads:
+            group = n_heads // kv_heads
+            b_sz = bh // n_heads
+            dk = dk.reshape(b_sz, kv_heads, group, kv_pad, d) \
+                .astype(jnp.float32).sum(2) \
+                .reshape(b_sz * kv_heads, kv_pad, d).astype(k.dtype)
+            dv = dv.reshape(b_sz, kv_heads, group, kv_pad, d) \
+                .astype(jnp.float32).sum(2) \
+                .reshape(b_sz * kv_heads, kv_pad, d).astype(v.dtype)
+        return dq[:, :q_len], dk[:, :kv_len], dv[:, :kv_len]
+
+    dq_in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda b, i, j, *_: (kvm(b), j, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda b, i, j, *_: (kvm(b), j, 0)),
+        pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
+        pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
+    ]
+    if has_segs:
+        dq_in_specs += [
+            pl.BlockSpec((None, 1, block_q),
+                         lambda b, i, j, *_: (bq_map(b), 0, i)),
+            pl.BlockSpec((None, 1, block_k),
+                         lambda b, i, j, *_: (bq_map(b), 0, j)),
+        ]
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(bh, q_pad // block_q, kv_pad // block_k),
-            in_specs=[
-                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((None, block_k, d),
-                             lambda b, i, j, *_: (kvm(b), j, 0)),
-                pl.BlockSpec((None, block_k, d),
-                             lambda b, i, j, *_: (kvm(b), j, 0)),
-                pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
-                pl.BlockSpec((None, 1, block_q), lambda b, i, j, *_: (b, 0, i)),
-            ],
+            in_specs=dq_in_specs,
             out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
@@ -423,26 +629,34 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(seed, qp, kp, vp, gp, lsep, delta)
+    )(seed, lens, qp, kp, vp, gp, lsep, delta, *seg_inputs)
 
     # dk/dv are computed PER Q-HEAD (distinct grid rows may share a KV head
     # under GQA; parallel grid dims cannot accumulate into a shared output
     # block) and group-summed below in XLA.
+    dkv_in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda b, j, i, *_: (kvm(b), j, 0)),
+        pl.BlockSpec((None, block_k, d),
+                     lambda b, j, i, *_: (kvm(b), j, 0)),
+        pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
+        pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
+        pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
+    ]
+    if has_segs:
+        dkv_in_specs += [
+            pl.BlockSpec((None, 1, block_q),
+                         lambda b, j, i, *_: (bq_map(b), 0, i)),
+            pl.BlockSpec((None, 1, block_k),
+                         lambda b, j, i, *_: (bq_map(b), 0, j)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(bh, kv_pad // block_k, q_pad // block_q),
-            in_specs=[
-                pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
-                pl.BlockSpec((None, block_k, d),
-                             lambda b, j, i, *_: (kvm(b), j, 0)),
-                pl.BlockSpec((None, block_k, d),
-                             lambda b, j, i, *_: (kvm(b), j, 0)),
-                pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0)),
-                pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
-                pl.BlockSpec((None, 1, block_q), lambda b, j, i, *_: (b, 0, i)),
-            ],
+            in_specs=dkv_in_specs,
             out_specs=[
                 pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
                 pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0)),
@@ -455,7 +669,7 @@ def _flash_bwd(q, k, v, o, lse, g, seed, causal, sm_scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(seed, qp, kp, vp, gp, lsep, delta)
+    )(seed, lens, qp, kp, vp, gp, lsep, delta, *seg_inputs)
 
     if kv_heads != n_heads:
         group = n_heads // kv_heads
@@ -508,7 +722,7 @@ def _flash_fwd_packed(qkv, seed, heads, head_dim, causal, sm_scale,
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
             in_specs=[qs, ks, vs],
             out_specs=[
@@ -531,7 +745,7 @@ def _flash_fwd_packed(qkv, seed, heads, head_dim, causal, sm_scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(seed, qkv, qkv, qkv)
+    )(seed, jnp.zeros((1,), jnp.int32), qkv, qkv, qkv)
     return out[:, :L], lse
 
 
@@ -571,7 +785,7 @@ def _flash_bwd_packed(qkv, o, lse, g, seed, heads, head_dim, causal, sm_scale,
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(b, h, L_pad // block_q, kv_pad // block_k),
             in_specs=[qs, ks, vs, gs, ls, ls],
             out_specs=pl.BlockSpec((None, block_q, d),
@@ -583,7 +797,7 @@ def _flash_bwd_packed(qkv, o, lse, g, seed, heads, head_dim, causal, sm_scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(seed, qkvp, qkvp, qkvp, gp, lsep, delta)
+    )(seed, jnp.zeros((1,), jnp.int32), qkvp, qkvp, qkvp, gp, lsep, delta)
 
     # dkv grid: q innermost; kv-indexed specs use grid dim 2, q-indexed dim 3
     qs_i = pl.BlockSpec((None, block_q, d),
@@ -599,7 +813,7 @@ def _flash_bwd_packed(qkv, o, lse, g, seed, heads, head_dim, causal, sm_scale,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(b, h, kv_pad // block_k, L_pad // block_q),
             in_specs=[qs_i, ks_j, vs_j, gs_i, ls_i, ls_i],
             out_specs=[
@@ -617,7 +831,7 @@ def _flash_bwd_packed(qkv, o, lse, g, seed, heads, head_dim, causal, sm_scale,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(seed, qkvp, qkvp, qkvp, gp, lsep, delta)
+    )(seed, jnp.zeros((1,), jnp.int32), qkvp, qkvp, qkvp, gp, lsep, delta)
 
     # d(qkv): columns [dq | dk | dv]; the concat feeds qkv_proj's backward
     # matmul and fuses there
@@ -702,28 +916,32 @@ def _reference_attention(q, k, v, causal, sm_scale):
     return jnp.einsum("bqk,bkd->bqd", p, vf).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k, dropout_rate,
-           interpret, n_heads=1, kv_heads=1):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
+def _flash(q, k, v, seed, lens, seg_q, seg_k, causal, sm_scale, block_q,
+           block_k, dropout_rate, interpret, n_heads=1, kv_heads=1):
     out, _ = _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-                        dropout_rate, interpret, n_heads, kv_heads)
+                        dropout_rate, interpret, n_heads, kv_heads,
+                        lens=lens, seg_q=seg_q, seg_k=seg_k)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-                   dropout_rate, interpret, n_heads, kv_heads):
+def _flash_vjp_fwd(q, k, v, seed, lens, seg_q, seg_k, causal, sm_scale,
+                   block_q, block_k, dropout_rate, interpret, n_heads,
+                   kv_heads):
     out, lse = _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
-                          dropout_rate, interpret, n_heads, kv_heads)
-    return out, (q, k, v, out, lse, seed)
+                          dropout_rate, interpret, n_heads, kv_heads,
+                          lens=lens, seg_q=seg_q, seg_k=seg_k)
+    return out, (q, k, v, out, lse, seed, lens, seg_q, seg_k)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, dropout_rate, interpret,
                    n_heads, kv_heads, res, g):
-    q, k, v, out, lse, seed = res
+    q, k, v, out, lse, seed, lens, seg_q, seg_k = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, seed, causal, sm_scale,
                             block_q, block_k, dropout_rate, interpret,
-                            n_heads, kv_heads)
-    return dq, dk, dv, None
+                            n_heads, kv_heads,
+                            lens=lens, seg_q=seg_q, seg_k=seg_k)
+    return dq, dk, dv, None, None, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -762,8 +980,8 @@ def _tuned_blocks(bh, lq, lk, d, dtype, causal, sm_scale, dropout_rate):
         bq, bk = cand
 
         def run():
-            out = _flash(qm, km, vm, sd, causal, sm_scale, bq, bk,
-                         float(dropout_rate), False)
+            out = _flash(qm, km, vm, sd, None, None, None, causal, sm_scale,
+                         bq, bk, float(dropout_rate), False)
             jax.block_until_ready(out)
         return run
 
@@ -773,13 +991,28 @@ def _tuned_blocks(bh, lq, lk, d, dtype, causal, sm_scale, dropout_rate):
 def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
                          dropout_rate=0.0, seed=0,
                          block_q=None, block_k=None,
-                         interpret=False):
+                         interpret=False,
+                         kv_lens=None, q_segments=None, kv_segments=None):
     """Flash attention on [B, L, H, D] arrays (jax.Array or Tensor-like .value()).
+
+    kv_lens ([B] int32): per-sequence key counts — encoder padding-mask
+    attention (keys at positions >= kv_lens[b] are never attended; queries
+    keep attending the valid keys, matching additive-mask semantics).
+    q_segments/kv_segments ([B, L] int32): packed-sequence ids — only
+    same-segment pairs attend. Reference: phi/kernels/flash_attn_kernel.h
+    serves encoder (padded/packed) and decoder attention alike.
 
     block_q/block_k default to the autotuned choice when FLAGS_use_autotune is
     on (persistent measured cache), else DEFAULT_BLOCK_Q/K."""
     unwrap = lambda t: t.value() if hasattr(t, "value") else t
     q, k, v = unwrap(q), unwrap(k), unwrap(v)
+    if kv_lens is not None:
+        kv_lens = jnp.asarray(unwrap(kv_lens), jnp.int32)
+    if (q_segments is None) != (kv_segments is None):
+        raise ValueError("q_segments and kv_segments must be given together")
+    if q_segments is not None:
+        q_segments = jnp.asarray(unwrap(q_segments), jnp.int32)
+        kv_segments = jnp.asarray(unwrap(kv_segments), jnp.int32)
     b, lq, h, d = q.shape
     lk = k.shape[1]
     hkv = k.shape[2]
@@ -806,7 +1039,8 @@ def flash_attention_blhd(q, k, v, causal=False, sm_scale=None,
                                float(sm_scale), float(dropout_rate))
         block_q = block_q or (tb[0] if tb else DEFAULT_BLOCK_Q)
         block_k = block_k or (tb[1] if tb else DEFAULT_BLOCK_K)
-    out = _flash(qr, kr, vr, seed_arr, bool(causal), float(sm_scale),
+    out = _flash(qr, kr, vr, seed_arr, kv_lens, q_segments, kv_segments,
+                 bool(causal), float(sm_scale),
                  block_q, block_k, float(dropout_rate), bool(interpret),
                  h, hkv)
     return jnp.swapaxes(out.reshape(b, h, lq, d), 1, 2)

@@ -81,12 +81,12 @@ class BertLayer(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self.attn_dropout_p = config.attention_dropout_prob
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, seq_lens=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(2)
         attn = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
+            q, k, v, attn_mask=attn_mask, kv_lens=seq_lens,
             dropout_p=self.attn_dropout_p if self.training else 0.0)
         attn = self.out_proj(attn.reshape([b, s, h]))
         x = self.attn_norm(x + self.dropout(attn))
@@ -110,10 +110,13 @@ class BertModel(nn.Layer):
             if p.ndim >= 2:
                 p.set_value(normal(tuple(p.shape), p.dtype))
 
-    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None,
+                seq_lens=None):
+        # seq_lens ([B] int): per-sequence valid-token counts — the structured
+        # (Pallas-flash) form of the usual [B,1,1,L] padding attn_mask
         x = self.embeddings(input_ids, token_type_ids)
         for layer in self.encoder:
-            x = layer(x, attn_mask)
+            x = layer(x, attn_mask, seq_lens)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
@@ -135,8 +138,10 @@ class BertForPreTraining(nn.Layer):
         self.nsp_head = nn.Linear(config.hidden_size, 2)
 
     def forward(self, input_ids, token_type_ids=None, attn_mask=None,
-                masked_lm_labels=None, next_sentence_labels=None):
-        seq_out, pooled = self.bert(input_ids, token_type_ids, attn_mask)
+                masked_lm_labels=None, next_sentence_labels=None,
+                seq_lens=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids, attn_mask,
+                                    seq_lens)
         x = self.transform_norm(F.gelu(self.transform(seq_out)))
         nsp_logits = self.nsp_head(pooled)
         if masked_lm_labels is None:

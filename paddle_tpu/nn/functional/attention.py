@@ -83,6 +83,47 @@ register_op("flash_attn_qkv_packed", _flash_attn_packed_fwd,
             nondiff_inputs=(1,))
 
 
+def _flash_attn_lens_fwd(q, k, v, lens, *rest, causal=False, dropout_rate=0.0):
+    from ...kernels.pallas.flash_attention import flash_attention_blhd
+    seed = rest[0] if rest else 0
+    return flash_attention_blhd(q, k, v, causal=causal,
+                                dropout_rate=dropout_rate, seed=seed,
+                                kv_lens=lens)
+
+
+# encoder padding-mask flash: per-sequence kv lengths as a nondiff input
+register_op("flash_attn_pallas_lens", _flash_attn_lens_fwd,
+            nondiff_inputs=(3, 4))
+
+
+def _flash_attn_segs_fwd(q, k, v, sq, sk, *rest, causal=False,
+                         dropout_rate=0.0):
+    from ...kernels.pallas.flash_attention import flash_attention_blhd
+    seed = rest[0] if rest else 0
+    return flash_attention_blhd(q, k, v, causal=causal,
+                                dropout_rate=dropout_rate, seed=seed,
+                                q_segments=sq, kv_segments=sk)
+
+
+# packed-sequence flash: segment ids gate attention (same-segment only)
+register_op("flash_attn_pallas_segs", _flash_attn_segs_fwd,
+            nondiff_inputs=(3, 4, 5))
+
+
+def _flash_attn_segs_lens_fwd(q, k, v, lens, sq, sk, *rest, causal=False,
+                              dropout_rate=0.0):
+    from ...kernels.pallas.flash_attention import flash_attention_blhd
+    seed = rest[0] if rest else 0
+    return flash_attention_blhd(q, k, v, causal=causal,
+                                dropout_rate=dropout_rate, seed=seed,
+                                kv_lens=lens, q_segments=sq, kv_segments=sk)
+
+
+# padding lengths AND packed segments together (the kernel masks with both)
+register_op("flash_attn_pallas_segs_lens", _flash_attn_segs_lens_fwd,
+            nondiff_inputs=(3, 4, 5, 6))
+
+
 def flash_attention_qkv_packed(qkv, num_heads, dropout=0.0, causal=True,
                                training=True):
     """Flash attention on the fused projection output [B, L, 3*H*D] -> the
@@ -104,28 +145,86 @@ def flash_attention_qkv_packed(qkv, num_heads, dropout=0.0, causal=True,
         return out.reshape([b, L, num_heads * d])
     args = [qkv]
     if drop > 0.0:
-        seed = jax.random.key_data(rng.split_key()).ravel()[0].astype(jnp.int32)
+        seed = rng.int32_seed()
         args.append(Tensor(seed))
     return _op("flash_attn_qkv_packed", *args, num_heads=int(num_heads),
                causal=bool(causal), dropout_rate=drop)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, name=None):
+                                 is_causal=False, training=True, name=None,
+                                 kv_lens=None, q_segments=None,
+                                 kv_segments=None):
     """paddle.nn.functional.scaled_dot_product_attention parity: [B, L, H, D] layout.
+
+    TPU-native extensions: kv_lens ([B] int) — per-sequence key counts
+    (encoder padding mask, the structured form of attn_mask=[B,1,1,L]);
+    q_segments/kv_segments ([B, L] int) — packed-sequence attention. With
+    either of these, or with no attn_mask at all, the call routes to the
+    Pallas flash kernel on TPU (reference: phi/kernels/flash_attn_kernel.h
+    serves encoder and decoder attention alike); arbitrary additive attn_mask
+    takes the XLA softmax chain.
 
     Attention dropout follows the eager-dropout recipe (functional/common.py): the keep
     mask is drawn host-side from the global RNG chain and passed as a nondiff input, so
     the op stays a pure function of its inputs (cacheable executable)."""
+    drop = float(dropout_p) if training else 0.0
+    if attn_mask is None and _pallas_usable(query):
+        seed_args = []
+        if drop > 0.0:
+            seed = rng.int32_seed()
+            seed_args = [Tensor(seed)]
+        if q_segments is not None and kv_lens is not None:
+            return _op("flash_attn_pallas_segs_lens", query, key, value,
+                       kv_lens, q_segments, kv_segments, *seed_args,
+                       causal=bool(is_causal), dropout_rate=drop)
+        if q_segments is not None:
+            return _op("flash_attn_pallas_segs", query, key, value,
+                       q_segments, kv_segments, *seed_args,
+                       causal=bool(is_causal), dropout_rate=drop)
+        if kv_lens is not None:
+            return _op("flash_attn_pallas_lens", query, key, value, kv_lens,
+                       *seed_args, causal=bool(is_causal), dropout_rate=drop)
+        return _op("flash_attn_pallas", query, key, value, *seed_args,
+                   causal=bool(is_causal), dropout_rate=drop)
+    if kv_lens is not None or q_segments is not None:
+        # XLA fallback (or attn_mask given alongside the structured masks):
+        # lower lens/segments to an additive mask and COMBINE with any user
+        # mask — dropping either silently would attend padding keys
+        structured = _structured_to_additive(query, key, kv_lens, q_segments,
+                                             kv_segments)
+        if attn_mask is None:
+            attn_mask = structured
+        else:
+            am = attn_mask.value() if hasattr(attn_mask, "value") \
+                else jnp.asarray(attn_mask)
+            attn_mask = Tensor(structured.value() + am.astype(jnp.float32))
     args = [query, key, value]
     if attn_mask is not None:
         args.append(attn_mask)
-    drop = float(dropout_p) if training else 0.0
     if drop > 0.0:
         args.append(Tensor(jax.random.key_data(rng.split_key())))
     return _op("sdpa", *args, causal=bool(is_causal), scale=None,
                has_mask=attn_mask is not None, has_dropkey=drop > 0.0,
                dropout_p=drop)
+
+
+def _structured_to_additive(query, key, kv_lens, q_segments, kv_segments):
+    """[B] lens / [B, L] segment ids -> additive [B, 1, Lq, Lk] mask."""
+    lk = key.shape[1]
+    lq = query.shape[1]
+    unwrap = lambda t: t.value() if hasattr(t, "value") else jnp.asarray(t)
+    valid = None
+    if kv_lens is not None:
+        cols = jnp.arange(lk)[None, :] < unwrap(kv_lens)[:, None]
+        valid = jnp.broadcast_to(cols[:, None, :], (cols.shape[0], lq, lk))
+    if q_segments is not None:
+        sq = unwrap(q_segments)
+        sk = unwrap(kv_segments)
+        seg_ok = sq[:, :, None] == sk[:, None, :]
+        valid = seg_ok if valid is None else (valid & seg_ok)
+    add = jnp.where(valid, 0.0, jnp.float32(-1e30))[:, None, :, :]
+    return Tensor(add)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -145,7 +244,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         if drop > 0.0:
             # in-kernel counter-based dropout; seed drawn from the global RNG
             # chain so to_static replays give fresh masks (threaded state)
-            seed = jax.random.key_data(rng.split_key()).ravel()[0].astype(jnp.int32)
+            seed = rng.int32_seed()
             args.append(Tensor(seed))
         out = _op("flash_attn_pallas", *args, causal=bool(causal),
                   dropout_rate=drop)

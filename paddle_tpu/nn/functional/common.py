@@ -49,6 +49,24 @@ def _dropout_fwd(x, key, p=0.5, mode="upscale_in_train", mask_shape=None):
 register_op("dropout", _dropout_fwd, nondiff_inputs=(1,))
 
 
+def _dropout_pallas_fwd(x, seed, p=0.5, upscale=True):
+    from ...kernels.pallas.dropout import dropout_tpu
+    return dropout_tpu(x, seed, p, upscale)
+
+
+def _dropout_pallas_bwd(primals, outs, cts, p=0.5, upscale=True):
+    # dx = mask * scale * g — the identical kernel applied to the cotangent
+    # (same seed regenerates the same hardware-PRNG mask; nothing saved)
+    from ...kernels.pallas.dropout import dropout_tpu
+    x, seed = primals
+    (g,) = cts
+    return (dropout_tpu(g, seed, p, upscale), None)
+
+
+register_op("dropout_pallas", _dropout_pallas_fwd, bwd=_dropout_pallas_bwd,
+            nondiff_inputs=(1,))
+
+
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
         if mode == "downscale_in_infer" and not training:
@@ -58,6 +76,15 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     if axis is not None:
         axes = static_int_list(axis)
         mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    if mask_shape is None:
+        from ...kernels.pallas.dropout import dropout_path_available
+        if dropout_path_available(x):
+            # TPU fast path: hardware-PRNG mask generated inside the kernel
+            # (kernels/pallas/dropout.py) — ~2 VPU passes vs the ~12 of the
+            # XLA threefry chain; bwd regenerates the mask from the seed
+            seed = rng.int32_seed()
+            return _op("dropout_pallas", x, Tensor(seed), p=float(p),
+                       upscale=(mode == "upscale_in_train"))
     key = Tensor(jax.random.key_data(rng.split_key()))
     return _op("dropout", x, key, p=float(p), mode=str(mode),
                mask_shape=mask_shape)
