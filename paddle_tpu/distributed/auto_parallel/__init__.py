@@ -269,9 +269,13 @@ class Engine:
         self._ensure_step()
         if epochs > 1 and iter(loader) is loader:
             # a one-shot iterator would be exhausted after epoch 1 and later
-            # epochs would silently train nothing — materialize so every
-            # epoch sees the full data
-            loader = list(loader)
+            # epochs would silently train nothing; materializing could buffer
+            # an unbounded dataset on the host — make the caller decide
+            raise ValueError(
+                "Engine.fit(epochs>1) needs a re-iterable data source "
+                "(Dataset, DataLoader, or list); got a one-shot iterator "
+                "that would be exhausted after the first epoch. Materialize "
+                "it yourself (list(data)) or pass a re-iterable loader.")
         history = []
         for _ in range(epochs):
             last = None

@@ -7,7 +7,7 @@ import time
 from typing import List, Optional
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler"]
+           "LRScheduler", "VisualDL", "WandbCallback"]
 
 
 class Callback:
@@ -94,6 +94,79 @@ class ProgBarLogger(Callback):
             dt = time.time() - self._t0
             print(f"  epoch {epoch + 1} done in {dt:.1f}s: {items}",
                   file=sys.stderr)
+
+
+class VisualDL(Callback):
+    """Metrics streamer (reference: hapi/callbacks.py VisualDL).
+
+    The reference writes VisualDL scalar records; the TPU-native form streams
+    JSON-lines to ``log_dir/vdlrecords.jsonl`` — one record per logged scalar
+    ({"tag", "step", "value", "wall"}) — which any dashboard (or pandas) can
+    tail. Flushed per write so a watcher process sees records live."""
+
+    def __init__(self, log_dir: str = "./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._global_step = 0
+
+    def _ensure(self):
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "vdlrecords.jsonl"),
+                            "a", encoding="utf-8")
+        return self._fh
+
+    def _write(self, prefix, step, logs):
+        import json
+        fh = self._ensure()
+        wall = time.time()
+        for k, v in (logs or {}).items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            fh.write(json.dumps({"tag": f"{prefix}/{k}", "step": int(step),
+                                 "value": v, "wall": wall}) + "\n")
+        fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        self._write("train", self._global_step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("epoch", epoch, logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", self._global_step, logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class WandbCallback(VisualDL):
+    """reference hapi WandbCallback analog. If the ``wandb`` package is
+    importable, streams there; otherwise degrades to the VisualDL JSON-lines
+    file (this image ships no wandb — records stay local either way)."""
+
+    def __init__(self, project=None, dir="./wandb_logs", **init_kwargs):
+        super().__init__(log_dir=dir)
+        self._wandb = None
+        try:
+            import wandb  # noqa: F401
+            self._wandb = wandb
+            self._run = wandb.init(project=project, dir=dir, **init_kwargs)
+        except Exception:
+            self._run = None
+
+    def _write(self, prefix, step, logs):
+        if self._run is not None:
+            self._run.log({f"{prefix}/{k}": v for k, v in (logs or {}).items()},
+                          step=int(step))
+            return
+        super()._write(prefix, step, logs)
 
 
 class ModelCheckpoint(Callback):

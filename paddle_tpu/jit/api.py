@@ -4,11 +4,14 @@ Reference analog: the dy2static stack (python/paddle/jit/dy2static/
 program_translator.py:181 CacheKey, :303 StaticFunction.__call__, :974 ConcreteProgram;
 partial_program.py:211 run_program op). Differences by design:
 
-- Capture is TRACE-based (like ConcreteProgram's tracer), not AST transforms: the
-  python function runs once with jax tracers flowing through the same eager ops, and
-  the result is one XLA computation. Data-dependent python control flow must use
-  paddle_tpu.static.cond/while_loop (lax.cond/while) — the AST transformer row of the
-  reference is intentionally replaced by the compiler-friendly forms.
+- Capture is AST + trace: an AST pass (jit/dy2static.py, the analog of the
+  reference's ast_transformer.py) first rewrites data-dependent python
+  `if`/`while`/`for range()` into static.cond/while_loop (lax.cond/while), then
+  the function runs once with jax tracers flowing through the same eager ops,
+  and the result is one XLA computation. Control flow over plain python values
+  keeps exact python semantics (the rewrite dispatches on tensor-ness at
+  runtime); unsupported shapes (return/break inside a tensor branch) raise a
+  line-numbered error instead of silently tracing one path.
 - The traced program is registered as ONE dispatch op, so it embeds in eager code and
   the generic jit(vjp) backward differentiates the whole program — the exact analog of
   the run_program op with its grad.
@@ -98,7 +101,11 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  instance=None):
-        self._fn = fn
+        from .dy2static import convert_to_static
+        # AST pass first (reference: ast_transformer.py): tensor-valued
+        # if/while/for become lax control flow; plain-python control flow is
+        # untouched at runtime, so the converted fn is a drop-in
+        self._fn = convert_to_static(fn)
         self._input_spec = input_spec
         self._instance = instance  # Layer instance for methods
         self._cache = {}           # CacheKey -> ConcreteProgram

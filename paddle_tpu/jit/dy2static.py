@@ -1,0 +1,438 @@
+"""dy2static: AST capture of data-dependent Python control flow.
+
+Reference analog: python/paddle/jit/dy2static/ast_transformer.py (+
+convert_operators.py) — the reference rewrites `if`/`while`/`for` over tensors
+into ConditionalBlock/While ops before building its static Program. Here the
+rewrite targets the jax forms: `if` → static.cond (lax.cond), `while` →
+static.while_loop (lax.while_loop), `for i in range(tensor)` → a while carry.
+
+The transform is SEMANTICS-PRESERVING for plain Python: every rewritten
+construct dispatches at runtime — a non-Tensor condition takes the normal
+Python path (same objects, same truthiness), a Tensor condition lowers to the
+structured form. So the pass can run on every @to_static function by default.
+
+Deliberate subset (loud, line-numbered errors where it matters):
+  - `if`/`while`/`for` containing `return`/`break`/`continue` at the rewritten
+    level are NOT converted; their condition is wrapped in a guard that raises
+    a clear error if a traced Tensor reaches it (the reference's early-return
+    transformer has no jax analog — rewrite to a result variable instead).
+  - Only simple-`Name` bindings thread through branches/loops; attribute and
+    subscript mutation works via closure (same object).
+  - Functions with free variables (closures), generators, and async functions
+    fall back to trace-only capture.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import Callable, List, Sequence, Set
+
+import jax
+
+__all__ = ["convert_to_static", "cfg_convertible"]
+
+
+class _Undef:
+    """Placeholder for a name unbound before a branch/loop: using it inside a
+    converted region raises with the variable's name."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"dy2static: variable {self.name!r} is used in a converted "
+            f"if/while branch but was not defined before it on every path")
+
+    __call__ = __getattr__ = __add__ = __radd__ = __mul__ = _raise
+
+    def __repr__(self):
+        return f"<undef {self.name}>"
+
+
+def _is_traced_tensor(x) -> bool:
+    from ..core.dispatch import in_trace
+    from ..core.lazy import LazyArray
+    from ..core.tensor import Tensor
+    if not isinstance(x, Tensor):
+        return False
+    if isinstance(x._data, jax.core.Tracer):
+        return True
+    # deferred-eager values are still "eager": concretize for python branching
+    return False
+
+
+def _dy2s_maybe(thunk, name):
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return _Undef(name)
+
+
+def _dy2s_cond(test, true_fn, false_fn, args, names, lineno):
+    if _is_traced_tensor(test):
+        from .. import static
+
+        out = static.cond(test, lambda: tuple(true_fn(*args)),
+                          lambda: tuple(false_fn(*args)))
+        return tuple(out)
+    return true_fn(*args) if test else false_fn(*args)
+
+
+def _dy2s_while(cond_fn, body_fn, args, names, lineno):
+    test = cond_fn(*args)
+    if _is_traced_tensor(test):
+        from .. import static
+
+        out = static.while_loop(
+            lambda *vs: cond_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
+            list(args))
+        return tuple(out)
+    vs = tuple(args)
+    while test:
+        vs = tuple(body_fn(*vs))
+        test = cond_fn(*vs)
+    return vs
+
+
+def _dy2s_for_range(range_args, body_fn, args, names, lineno):
+    from ..core.tensor import Tensor
+
+    ra = list(range_args)
+    if len(ra) == 1:
+        start, stop, step = 0, ra[0], 1
+    elif len(ra) == 2:
+        start, stop, step = ra[0], ra[1], 1
+    else:
+        start, stop, step = ra
+    if any(_is_traced_tensor(x) for x in (start, stop, step)):
+        import jax.numpy as jnp
+
+        from .. import static
+
+        def as_t(x):
+            return x if isinstance(x, Tensor) \
+                else Tensor(jnp.asarray(x, jnp.int32))
+
+        i0 = as_t(start)
+        stop_t = as_t(stop)
+        step_t = as_t(step)
+
+        def cond(i, *vs):
+            return i < stop_t
+
+        def body(i, *vs):
+            out = body_fn(i, *vs)
+            return (i + step_t,) + tuple(out)
+
+        out = static.while_loop(cond, body, [i0] + list(args))
+        return tuple(out[1:])
+    vs = tuple(args)
+    for i in range(int(start) if not isinstance(start, int) else start,
+                   int(stop) if not isinstance(stop, int) else stop,
+                   int(step) if not isinstance(step, int) else step):
+        vs = tuple(body_fn(i, *vs))
+    return vs
+
+
+def _dy2s_bool(test, lineno, construct):
+    if _is_traced_tensor(test):
+        raise RuntimeError(
+            f"dy2static: the {construct} at line {lineno} branches on a "
+            f"traced Tensor but contains return/break/continue, which cannot "
+            f"be captured as lax control flow. Rewrite it to assign a result "
+            f"variable (converted automatically), or use "
+            f"paddle.static.cond/while_loop explicitly.")
+    return test
+
+
+_HELPERS = {
+    "__dy2s_cond": _dy2s_cond,
+    "__dy2s_while": _dy2s_while,
+    "__dy2s_for_range": _dy2s_for_range,
+    "__dy2s_bool": _dy2s_bool,
+    "__dy2s_maybe": _dy2s_maybe,
+}
+
+
+# ---------------------------------------------------------------- AST analysis
+
+
+_SCOPE_STOPS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _assigned_names(stmts: Sequence[ast.stmt]) -> Set[str]:
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+
+        def visit_NamedExpr(self, node):
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+            self.visit(node.value)
+
+        def generic_visit(self, node):
+            if isinstance(node, _SCOPE_STOPS):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    names.add(node.name)
+                return
+            super().generic_visit(node)
+
+    for s in stmts:
+        V().visit(s)
+    return names
+
+
+def _contains_jump(stmts: Sequence[ast.stmt]) -> bool:
+    """Return/break/continue that would escape this statement list."""
+
+    found = []
+
+    def walk(node, loop_depth):
+        if isinstance(node, _SCOPE_STOPS):
+            return
+        if isinstance(node, ast.Return):
+            found.append(node)
+            return
+        if isinstance(node, (ast.Break, ast.Continue)) and loop_depth == 0:
+            found.append(node)
+            return
+        inner = loop_depth + 1 if isinstance(node, (ast.For, ast.While)) else \
+            loop_depth
+        for child in ast.iter_child_nodes(node):
+            walk(child, inner)
+
+    for s in stmts:
+        walk(s, 0)
+    return bool(found)
+
+
+def _has_scope_decl(stmts) -> bool:
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------- transformer
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _maybe_arg(var: str) -> ast.expr:
+    # __dy2s_maybe(lambda: var, 'var') — UNDEF-safe capture of a
+    # possibly-unbound name
+    lam = ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=_name(var))
+    return ast.Call(func=_name("__dy2s_maybe"),
+                    args=[lam, ast.Constant(value=var)], keywords=[])
+
+
+def _branch_fn(fname: str, params: List[str], body: List[ast.stmt],
+               ret_names: List[str]) -> ast.FunctionDef:
+    ret = ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in ret_names], ctx=ast.Load()))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=(list(body) or [ast.Pass()]) + [ret],
+        decorator_list=[], type_params=[])
+
+
+def _names_tuple_store(names: List[str]) -> ast.expr:
+    # always a tuple target — helpers return tuples even for one name
+    return ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                     ctx=ast.Store())
+
+
+def _const_tuple(values) -> ast.expr:
+    return ast.Tuple(elts=[ast.Constant(value=v) for v in values],
+                     ctx=ast.Load())
+
+
+class _CFTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    def _fresh(self, kind):
+        self.n += 1
+        return f"__dy2s_{kind}{self.n}"
+
+    # ------------------------------------------------------------------ if
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+        if (_contains_jump(body) or _contains_jump(orelse)
+                or _has_scope_decl(body) or _has_scope_decl(orelse)):
+            node.test = ast.copy_location(
+                ast.Call(func=_name("__dy2s_bool"),
+                         args=[node.test, ast.Constant(value=node.lineno),
+                               ast.Constant(value="if")], keywords=[]),
+                node.test)
+            return node
+        mod = sorted(n for n in _assigned_names(body) | _assigned_names(orelse)
+                     if not n.startswith("__dy2s_"))
+        tname, fname = self._fresh("t"), self._fresh("f")
+        tdef = _branch_fn(tname, mod, body, mod)
+        fdef = _branch_fn(fname, mod, orelse, mod)
+        call = ast.Call(
+            func=_name("__dy2s_cond"),
+            args=[node.test, _name(tname), _name(fname),
+                  ast.Tuple(elts=[_maybe_arg(m) for m in mod], ctx=ast.Load()),
+                  _const_tuple(mod), ast.Constant(value=node.lineno)],
+            keywords=[])
+        if mod:
+            assign = ast.Assign(targets=[_names_tuple_store(mod)], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (tdef, fdef, assign)]
+
+    # --------------------------------------------------------------- while
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if (node.orelse or _contains_jump(node.body)
+                or _has_scope_decl(node.body)):
+            node.test = ast.copy_location(
+                ast.Call(func=_name("__dy2s_bool"),
+                         args=[node.test, ast.Constant(value=node.lineno),
+                               ast.Constant(value="while")], keywords=[]),
+                node.test)
+            return node
+        state = sorted(n for n in _assigned_names(node.body)
+                       if not n.startswith("__dy2s_"))
+        cname, bname = self._fresh("wc"), self._fresh("wb")
+        cdef = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in state],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], type_params=[])
+        bdef = _branch_fn(bname, state, node.body, state)
+        call = ast.Call(
+            func=_name("__dy2s_while"),
+            args=[_name(cname), _name(bname),
+                  ast.Tuple(elts=[_maybe_arg(m) for m in state],
+                            ctx=ast.Load()),
+                  _const_tuple(state), ast.Constant(value=node.lineno)],
+            keywords=[])
+        if state:
+            assign = ast.Assign(targets=[_names_tuple_store(state)],
+                                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (cdef, bdef, assign)]
+
+    # ----------------------------------------------------------------- for
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if (not is_range or node.orelse or _contains_jump(node.body)
+                or _has_scope_decl(node.body)):
+            return node  # python iteration (trace unrolls static loops)
+        state = sorted(n for n in _assigned_names(node.body)
+                       if not n.startswith("__dy2s_")
+                       and n != node.target.id)
+        bname = self._fresh("fb")
+        bdef = _branch_fn(bname, [node.target.id] + state, node.body, state)
+        call = ast.Call(
+            func=_name("__dy2s_for_range"),
+            args=[ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+                  _name(bname),
+                  ast.Tuple(elts=[_maybe_arg(m) for m in state],
+                            ctx=ast.Load()),
+                  _const_tuple(state), ast.Constant(value=node.lineno)],
+            keywords=[])
+        if state:
+            assign = ast.Assign(targets=[_names_tuple_store(state)],
+                                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (bdef, assign)]
+
+
+# ---------------------------------------------------------------- entry point
+
+
+def cfg_convertible(fn: Callable) -> bool:
+    code = getattr(fn, "__code__", None)
+    if code is None or code.co_freevars:
+        return False
+    if inspect.iscoroutinefunction(fn) or inspect.isgeneratorfunction(fn):
+        return False
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def _convert_cached(fn: Callable) -> Callable:
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fndef = tree.body[0]
+    if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ValueError("not a function definition")
+    fndef.decorator_list = []
+    new = _CFTransformer().visit(fndef)
+    mod = ast.Module(body=[new], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    code = compile(mod, f"<dy2static {getattr(fn, '__qualname__', fn)}>",
+                   "exec")
+    env = dict(fn.__globals__)
+    env.update(_HELPERS)
+    exec(code, env)
+    out = env[fndef.name]
+    out.__defaults__ = fn.__defaults__
+    out.__kwdefaults__ = fn.__kwdefaults__
+    out.__dict__.update(getattr(fn, "__dict__", {}))
+    out.__wrapped__ = fn
+    out.__dy2s_converted__ = True
+    return out
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert fn's data-dependent control flow; falls back to the
+    original function (trace-only capture) when conversion isn't possible."""
+    import types
+
+    if inspect.ismethod(fn):
+        conv = convert_to_static(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    if getattr(fn, "__dy2s_converted__", False):
+        return fn
+    if not cfg_convertible(fn):
+        return fn
+    try:
+        return _convert_cached(fn)
+    except Exception as e:  # source unavailable, exotic syntax, ...
+        warnings.warn(f"dy2static: AST conversion of "
+                      f"{getattr(fn, '__qualname__', fn)} failed ({e}); "
+                      f"falling back to trace-only capture")
+        return fn

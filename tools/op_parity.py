@@ -470,6 +470,7 @@ def main(write=False):
         print(f"{covered}/{total} ({pct:.1f}%) covered; missing:")
         for name, src in missing:
             print(f"  {name} ({src})")
+    return covered, total, missing
 
 
 if __name__ == "__main__":
