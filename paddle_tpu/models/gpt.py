@@ -484,7 +484,11 @@ def _generate_with_cache(lm, backbone, num_layers: int, n_kv_heads: int,
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     if max_new_tokens == 0:
         return Tensor(ids_arr.astype(jnp.int32))   # same dtype as n>0 paths
-    m = int(max_length or max_pos)
+    # cache buffers sized to the DECODE, not the model's position table:
+    # every step streams the whole [B, M, nh, hd] K/V pair per layer, and at
+    # GPT-medium M=1024 that 0.54 GB/step read was 2.6 of the 4.9 ms step
+    # (BASELINE.md round-4 decode table) — tight M more than doubled tok/s
+    m = int(max_length or min(s0 + max_new_tokens, max_pos))
     if s0 + max_new_tokens > m:
         raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
                          f"exceeds max_length {m}")

@@ -25,10 +25,15 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
 
 
 class SparseCooTensor:
-    """Minimal sparse tensor wrapper (indices [ndim, nnz], values [nnz])."""
+    """Minimal sparse tensor wrapper (indices [ndim, nnz], values [nnz]).
 
-    def __init__(self, bcoo: jsparse.BCOO):
+    When built from a LIVE Tensor of values (sparse conv/pool outputs), the
+    original Tensor is kept so `.values()` preserves its autograd history —
+    sparse layers train through the tape like dense ones."""
+
+    def __init__(self, bcoo: jsparse.BCOO, values_tensor=None):
         self._bcoo = bcoo
+        self._values_tensor = values_tensor
 
     # ------------------------------------------------------------ properties
 
@@ -48,6 +53,8 @@ class SparseCooTensor:
         return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
 
     def values(self) -> Tensor:
+        if self._values_tensor is not None:
+            return self._values_tensor
         return Tensor(self._bcoo.data)
 
     def crows(self) -> Tensor:
@@ -155,7 +162,13 @@ def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
     if shape is None:
         shape = tuple(int(m) + 1 for m in idx.max(axis=1))
     bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
-    return SparseCooTensor(bcoo)
+    vt = None
+    if (isinstance(values, Tensor) and values._grad_node is not None
+            and vals.dtype == values.value().dtype):
+        # keep the live tensor only when no cast happened — .values() must
+        # always agree with the stored sparse data
+        vt = values
+    return SparseCooTensor(bcoo, values_tensor=vt)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
@@ -205,15 +218,8 @@ def relu(x: SparseCooTensor) -> SparseCooTensor:
                                         shape=b.shape))
 
 
-class _SparseNN:
-    """paddle.sparse.nn subset (functional forms)."""
-
-    @staticmethod
-    def functional_relu(x):
-        return relu(x)
-
-
-nn = _SparseNN()
+# paddle.sparse.nn lives in sparse/nn.py (conv/pool layers + functionals);
+# imported at the END of this module (it needs the types above)
 
 
 # ------------------------------------------------------- elementwise value ops
@@ -321,3 +327,6 @@ def mv(x: SparseCooTensor, vec) -> Tensor:
 def addmm(input, x: SparseCooTensor, y, beta=1.0, alpha=1.0) -> Tensor:
     return Tensor(beta * _dense_value(input)
                   + alpha * (x._bcoo @ _dense_value(y)))
+
+
+from . import nn  # noqa: F401,E402  (sparse conv/pool layers; needs the types above)

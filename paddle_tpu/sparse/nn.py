@@ -1,0 +1,329 @@
+"""paddle.sparse.nn — submanifold/standard sparse 3-D conv and pooling.
+
+Reference analog: phi/kernels/sparse/gpu/conv_kernel.cu (gather-GEMM-scatter
+sparse conv with a rulebook) and pool_kernel.cu. The TPU-native design keeps
+the same structure but builds the rulebook with sort + searchsorted (XLA-
+friendly primitives) and turns the per-offset gather into ONE
+[nnz, K^3*Cin] @ [K^3*Cin, Cout] MXU matmul for the submanifold case:
+
+  - active sites are linearized to integer keys and sorted once;
+  - each kernel offset's neighbor lookup is a searchsorted into the sorted
+    keys (hit/miss mask — the "rulebook");
+  - gathered features contract with the flattened kernel on the MXU;
+  - standard (non-submanifold) conv scatter-adds per-offset contributions
+    into the unique set of output sites; pooling is a segment-max.
+
+Gradients flow through the gather/matmul/scatter ops via the dispatcher's
+generic vjp (indices/masks are nondiff rulebook inputs).
+
+Layout: paddle.sparse convention — activations [N, D, H, W, C] (channels
+last), kernel [kd, kh, kw, Cin, Cout].
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._helpers import _op
+from . import SparseCooTensor, sparse_coo_tensor
+
+__all__ = ["subm_conv3d", "conv3d", "max_pool3d",
+           "SubmConv3D", "Conv3D", "MaxPool3D"]
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * 3
+
+
+def _linearize(idx, dims):
+    """[4, nnz] (n, d, h, w) int -> scalar keys (int64 when x64 is enabled;
+    N*D*H*W can exceed 2^31 for realistic point-cloud grids — callers guard
+    with _check_key_space so int32 keys can never silently wrap)."""
+    n, d, h, w = (jnp.asarray(a, jnp.int64) for a in idx)
+    D, H, W = dims
+    return ((n * D + d) * H + h) * W + w
+
+
+def _check_key_space(N, dims):
+    total = int(N)
+    for s in dims:
+        total *= int(s)
+    key_bits = 63 if jax.config.jax_enable_x64 else 31
+    if total >= (1 << key_bits):
+        raise ValueError(
+            f"sparse conv/pool site space N*D*H*W = {total} overflows the "
+            f"{key_bits + 1}-bit linearized keys; enable jax_enable_x64 for "
+            f"64-bit keys or shard the volume")
+
+
+# ------------------------------------------------------------- dispatch ops
+
+
+def _subm_gather_conv_fwd(values, weight, gather_idx, valid, *rest,
+                          has_bias=False):
+    """values [nnz, Cin]; weight [K3, Cin, Cout]; gather_idx/valid [nnz, K3].
+    One gather + one MXU matmul: [nnz, K3*Cin] @ [K3*Cin, Cout]."""
+    nnz, cin = values.shape
+    k3 = gather_idx.shape[1]
+    feats = values[gather_idx]                       # [nnz, K3, Cin]
+    feats = jnp.where(valid[:, :, None], feats, 0.0)
+    out = jnp.matmul(feats.reshape(nnz, k3 * cin),
+                     weight.reshape(k3 * cin, -1))
+    if has_bias:
+        out = out + rest[0]
+    return out
+
+
+register_op("subm_gather_conv", _subm_gather_conv_fwd, nondiff_inputs=(2, 3))
+
+
+def _scatter_conv_fwd(values, weight, out_idx, valid, *rest, n_out=0,
+                      has_bias=False):
+    """Standard sparse conv: per-offset contributions scatter-add into the
+    output sites. values [nnz, Cin]; weight [K3, Cin, Cout];
+    out_idx/valid [K3, nnz] (output row fed by each input site per offset)."""
+    k3 = weight.shape[0]
+    cout = weight.shape[2]
+    out = jnp.zeros((n_out, cout), values.dtype)
+    for o in range(k3):
+        contrib = jnp.matmul(values, weight[o])      # [nnz, Cout]
+        contrib = jnp.where(valid[o][:, None], contrib, 0.0)
+        idx = jnp.where(valid[o], out_idx[o], n_out)  # OOB rows drop
+        out = out.at[idx].add(contrib, mode="drop")
+    if has_bias:
+        out = out + rest[0]
+    return out
+
+
+register_op("scatter_conv", _scatter_conv_fwd, nondiff_inputs=(2, 3))
+
+
+def _segment_max_fwd(values, seg_ids, n_out=0):
+    return jax.ops.segment_max(values, seg_ids, num_segments=n_out)
+
+
+register_op("sparse_segment_max", _segment_max_fwd, nondiff_inputs=(1,))
+
+
+# ------------------------------------------------------------ rulebook build
+
+
+def _sorted_keys(idx, dims):
+    keys = _linearize(idx, dims)
+    order = jnp.argsort(keys)
+    return keys[order], order
+
+
+def _lookup(sorted_keys, order, query_keys):
+    """index of each query among active sites, and a hit mask."""
+    pos = jnp.searchsorted(sorted_keys, query_keys)
+    pos = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
+    hit = sorted_keys[pos] == query_keys
+    return order[pos], hit
+
+
+def _offsets(k, dilation):
+    kd, kh, kw = k
+    dd, dh, dw = dilation
+    return [((a - kd // 2) * dd, (b - kh // 2) * dh, (c - kw // 2) * dw)
+            for a, b, c in itertools.product(range(kd), range(kh), range(kw))]
+
+
+def subm_conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=1,
+                dilation=1, key=None):
+    """Submanifold sparse conv3d: output active sites == input active sites
+    (reference: SubmConv3D / conv_kernel.cu subm path). stride must be 1."""
+    if _triple(stride) != (1, 1, 1):
+        raise ValueError("submanifold conv requires stride 1 (use conv3d)")
+    N, D, H, W, Cin = x.shape
+    _check_key_space(N, (D, H, W))
+    idx = x._bcoo.indices.T.astype(jnp.int32)        # [4, nnz]
+    dims = (D, H, W)
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+    kd, kh, kw = w.shape[0], w.shape[1], w.shape[2]
+    sorted_keys, order = _sorted_keys(idx, dims)
+    g_idx, g_valid = [], []
+    for (od, oh, ow) in _offsets((kd, kh, kw), _triple(dilation)):
+        nd, nh, nw = idx[1] + od, idx[2] + oh, idx[3] + ow
+        inb = ((nd >= 0) & (nd < D) & (nh >= 0) & (nh < H)
+               & (nw >= 0) & (nw < W))
+        qk = _linearize((idx[0], nd, nh, nw), dims)
+        j, hit = _lookup(sorted_keys, order, qk)
+        g_idx.append(j)
+        g_valid.append(hit & inb)
+    gather_idx = jnp.stack(g_idx, axis=1)            # [nnz, K3]
+    valid = jnp.stack(g_valid, axis=1)
+    k3 = gather_idx.shape[1]
+    args = [x.values(), w.reshape([k3, Cin, int(w.shape[-1])]),
+            Tensor(gather_idx), Tensor(valid)]
+    if bias is not None:
+        args.append(bias)
+    out_vals = _op("subm_gather_conv", *args, has_bias=bias is not None)
+    return sparse_coo_tensor(Tensor(idx), out_vals,
+                             [N, D, H, W, int(w.shape[-1])])
+
+
+def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=1,
+           dilation=1, key=None):
+    """Standard sparse conv3d: output sites are every site reached by an
+    input site through the kernel (gather-GEMM-scatter with a computed
+    rulebook; reference conv_kernel.cu non-subm path)."""
+    N, D, H, W, Cin = x.shape
+    _check_key_space(N, (D, H, W))
+    sd, sh, sw = _triple(stride)
+    pd, ph, pw = _triple(padding)
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+    kd, kh, kw = int(w.shape[0]), int(w.shape[1]), int(w.shape[2])
+    dd, dh, dw = _triple(dilation)
+    Do = (D + 2 * pd - dd * (kd - 1) - 1) // sd + 1
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    idx = x._bcoo.indices.T.astype(jnp.int32)
+    offs = list(itertools.product(range(kd), range(kh), range(kw)))
+    # candidate output coords per (site, offset): out*s = in + pad - off*dil
+    cand_keys, cand_valid = [], []
+    for (a, b, c) in offs:
+        td = idx[1] + pd - a * dd
+        th = idx[2] + ph - b * dh
+        tw = idx[3] + pw - c * dw
+        ok = ((td % sd == 0) & (th % sh == 0) & (tw % sw == 0))
+        od, oh, ow = td // sd, th // sh, tw // sw
+        ok = ok & ((od >= 0) & (od < Do) & (oh >= 0) & (oh < Ho)
+                   & (ow >= 0) & (ow < Wo))
+        cand_keys.append(jnp.where(
+            ok, _linearize((idx[0], od, oh, ow), (Do, Ho, Wo)), -1))
+        cand_valid.append(ok)
+    all_keys = jnp.stack(cand_keys)                  # [K3, nnz]
+    out_keys = jnp.unique(all_keys.ravel())
+    out_keys = out_keys[out_keys >= 0]               # eager: concrete nnz
+    n_out = int(out_keys.shape[0])
+    pos = jnp.searchsorted(out_keys, jnp.where(all_keys < 0, 0, all_keys))
+    pos = jnp.clip(pos, 0, max(n_out - 1, 0))
+    out_idx = pos.astype(jnp.int32)
+    valid = jnp.stack(cand_valid)
+    args = [x.values(), w.reshape([len(offs), Cin, int(w.shape[-1])]),
+            Tensor(out_idx), Tensor(valid)]
+    if bias is not None:
+        args.append(bias)
+    out_vals = _op("scatter_conv", *args, n_out=n_out,
+                   has_bias=bias is not None)
+    # unpack keys -> coords
+    ok = out_keys.astype(jnp.int32)
+    wn = ok // (Do * Ho * Wo)
+    rem = ok % (Do * Ho * Wo)
+    od = rem // (Ho * Wo)
+    oh = (rem % (Ho * Wo)) // Wo
+    ow = rem % Wo
+    out_indices = jnp.stack([wn, od, oh, ow]).astype(jnp.int32)
+    return sparse_coo_tensor(Tensor(out_indices), out_vals,
+                             [N, Do, Ho, Wo, int(w.shape[-1])])
+
+
+def max_pool3d(x: SparseCooTensor, kernel_size, stride=None, padding=0):
+    """Sparse max pooling: output sites = pooled coords of active sites;
+    values = per-site segment max (reference: pool_kernel.cu)."""
+    N, D, H, W, C = x.shape
+    _check_key_space(N, (D, H, W))
+    k = _triple(kernel_size)
+    s = _triple(stride if stride is not None else kernel_size)
+    p = _triple(padding)
+    Do = (D + 2 * p[0] - k[0]) // s[0] + 1
+    Ho = (H + 2 * p[1] - k[1]) // s[1] + 1
+    Wo = (W + 2 * p[2] - k[2]) // s[2] + 1
+    idx = x._bcoo.indices.T.astype(jnp.int32)
+    # window membership: with stride==kernel (the common case) each site has
+    # exactly one window; general overlap loops windows covering the site
+    covers = []
+    for (a, b, c) in itertools.product(range(k[0]), range(k[1]), range(k[2])):
+        td, th, tw = idx[1] + p[0] - a, idx[2] + p[1] - b, idx[3] + p[2] - c
+        ok = (td % s[0] == 0) & (th % s[1] == 0) & (tw % s[2] == 0)
+        od, oh, ow = td // s[0], th // s[1], tw // s[2]
+        ok = ok & (od >= 0) & (od < Do) & (oh >= 0) & (oh < Ho) \
+            & (ow >= 0) & (ow < Wo)
+        covers.append(jnp.where(
+            ok, _linearize((idx[0], od, oh, ow), (Do, Ho, Wo)), -1))
+    all_keys = jnp.stack(covers)                     # [K3, nnz]
+    out_keys = jnp.unique(all_keys.ravel())
+    out_keys = out_keys[out_keys >= 0]
+    n_out = int(out_keys.shape[0])
+    seg = jnp.searchsorted(out_keys, jnp.where(all_keys < 0, 0, all_keys))
+    seg = jnp.where(all_keys < 0, n_out, seg).astype(jnp.int32)  # drop rows
+    k3, nnz = all_keys.shape
+    vals = x.values()
+    rep_vals = _op("tile_rows", vals, reps=k3)       # [K3*nnz, C]
+    out_vals = _op("sparse_segment_max", rep_vals, Tensor(seg.ravel()),
+                   n_out=n_out + 1)
+    out_vals = out_vals[:n_out]
+    ok = out_keys.astype(jnp.int32)
+    wn = ok // (Do * Ho * Wo)
+    rem = ok % (Do * Ho * Wo)
+    od = rem // (Ho * Wo)
+    oh = (rem % (Ho * Wo)) // Wo
+    ow = rem % Wo
+    out_indices = jnp.stack([wn, od, oh, ow]).astype(jnp.int32)
+    return sparse_coo_tensor(Tensor(out_indices), out_vals,
+                             [N, Do, Ho, Wo, C])
+
+
+register_op("tile_rows", lambda v, reps=1: jnp.tile(v, (reps, 1)))
+
+
+# ------------------------------------------------------------------- layers
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=1, dilation=1, bias_attr=True):
+        super().__init__()
+        k = _triple(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        from ..nn import initializer
+        fan_in = in_channels * k[0] * k[1] * k[2]
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            shape=[k[0], k[1], k[2], in_channels, out_channels],
+            default_initializer=initializer.Uniform(-bound, bound))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], is_bias=True,
+                default_initializer=initializer.Uniform(-bound, bound))
+
+
+class SubmConv3D(_SparseConvBase):
+    """paddle.sparse.nn.SubmConv3D parity (submanifold: output sites ==
+    input sites). Reference: common_sparse_conv in conv_kernel.cu."""
+
+    def forward(self, x):
+        return subm_conv3d(x, self.weight, self.bias, self.stride,
+                           self.padding, self.dilation)
+
+
+class Conv3D(_SparseConvBase):
+    """paddle.sparse.nn.Conv3D parity (standard sparse conv)."""
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                      self.dilation)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+
+    def forward(self, x):
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+def functional_relu(x):
+    from . import relu as _relu
+    return _relu(x)

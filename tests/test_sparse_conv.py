@@ -1,0 +1,103 @@
+"""Sparse submanifold/standard conv3d + maxpool vs dense oracles.
+
+Reference: phi/kernels/sparse/gpu/conv_kernel.cu, pool_kernel.cu.
+Layout: [N, D, H, W, C], kernel [kd, kh, kw, Cin, Cout].
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.core.tensor import Tensor
+
+
+def _sparse_volume(seed, n=2, d=6, h=6, w=6, c=3, density=0.15):
+    rs = np.random.RandomState(seed)
+    dense = rs.randn(n, d, h, w, c).astype(np.float32)
+    mask = rs.rand(n, d, h, w) < density
+    dense = dense * mask[..., None]
+    st = paddle.to_tensor(dense).to_sparse_coo(4)
+    return dense, st
+
+
+def _dense_conv(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w),
+        window_strides=(stride,) * 3, padding=[(padding, padding)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+
+def test_subm_conv3d_matches_masked_dense():
+    dense, st = _sparse_volume(0)
+    rs = np.random.RandomState(1)
+    w = rs.randn(3, 3, 3, 3, 5).astype(np.float32) * 0.2
+    out = sparse.nn.subm_conv3d(st, Tensor(w), padding=1)
+    ref = np.asarray(_dense_conv(dense, w, 1, 1))
+    # submanifold: only input-active sites are produced; compare there
+    out_d = out.to_dense().numpy()
+    mask = (np.abs(dense).sum(-1) > 0)
+    np.testing.assert_allclose(out_d[mask], ref[mask], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out_d[~mask], 0.0)
+
+
+def test_conv3d_matches_dense_everywhere():
+    dense, st = _sparse_volume(2)
+    rs = np.random.RandomState(3)
+    w = rs.randn(3, 3, 3, 3, 4).astype(np.float32) * 0.2
+    out = sparse.nn.conv3d(st, Tensor(w), stride=2, padding=1)
+    ref = np.asarray(_dense_conv(dense, w, 2, 1))
+    np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_gradients():
+    dense, st = _sparse_volume(4, d=5, h=5, w=5)
+    rs = np.random.RandomState(5)
+    w = rs.randn(3, 3, 3, 3, 2).astype(np.float32) * 0.3
+    wt = Tensor(w, stop_gradient=False)
+    vals = st.values()
+    vals.stop_gradient = False
+    st2 = sparse.sparse_coo_tensor(st.indices(), vals, st.shape)
+    out = sparse.nn.subm_conv3d(st2, wt, padding=1)
+    out.values().sum().backward()
+    assert wt.grad is not None
+    # dense oracle gradient for the weight
+    def loss(wj):
+        o = _dense_conv(dense, wj, 1, 1)
+        m = (np.abs(dense).sum(-1) > 0)
+        return jnp.where(jnp.asarray(m)[..., None], o, 0.0).sum()
+    gw = np.asarray(jax.grad(loss)(jnp.asarray(w)))
+    np.testing.assert_allclose(np.asarray(wt.grad.numpy()), gw,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_max_pool3d_matches_dense():
+    dense, st = _sparse_volume(6, density=0.3)
+    out = sparse.nn.max_pool3d(st, 2, stride=2)
+    # dense maxpool oracle over NONZERO entries only (sparse pooling ignores
+    # implicit zeros; all-zero windows produce NO output site)
+    ref = jax.lax.reduce_window(
+        jnp.asarray(np.where(dense == 0, -np.inf, dense)),
+        -np.inf, jax.lax.max, (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID")
+    out_d = out.to_dense().numpy()
+    ref = np.asarray(ref)
+    active = np.isfinite(ref) & (ref != 0)
+    np.testing.assert_allclose(out_d[active], ref[active],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_layers_and_shapes():
+    paddle.seed(0)
+    _, st = _sparse_volume(7)
+    layer = sparse.nn.SubmConv3D(3, 8, 3)
+    out = layer(st)
+    assert out.shape == [2, 6, 6, 6, 8]
+    assert out.nnz == st.nnz
+    pool = sparse.nn.MaxPool3D(2)
+    pooled = pool(out)
+    assert pooled.shape == [2, 3, 3, 3, 8]
+    full = sparse.nn.Conv3D(3, 4, 3, stride=1, padding=1)
+    out2 = full(st)
+    assert out2.shape == [2, 6, 6, 6, 4]
+    assert out2.nnz >= st.nnz  # dilated active set
