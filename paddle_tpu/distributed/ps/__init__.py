@@ -180,6 +180,14 @@ class PSServer:
                 elif op == "day_end":
                     t.day_end()
                     result = True
+                elif op == "call":
+                    # generic table-method dispatch (graph tables etc.);
+                    # guarded: only public table methods are reachable
+                    method, args = payload
+                    if method.startswith("_"):
+                        result = _PSError(f"private method {method!r}")
+                    else:
+                        result = getattr(t, method)(*args)
                 else:
                     result = _PSError(f"unknown op {op!r}")
             except Exception as e:            # AttributeError for wrong table
@@ -253,5 +261,11 @@ class PSClient:
         """Advance the CTR decay/staleness clock (CtrSparseTable)."""
         return self._call("day_end", table, None)
 
+    def call_table(self, table: str, method: str, *args):
+        """Generic table-method call (graph tables: sample_neighbors,
+        pull_features, add_edges, ...)."""
+        return self._call("call", table, (method, args))
+
 
 from .scale import SSDSparseTable, CtrAccessor, CtrSparseTable  # noqa: F401,E402
+from .graph import GraphTable, GraphShardedClient  # noqa: F401,E402

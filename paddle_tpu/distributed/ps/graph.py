@@ -1,0 +1,197 @@
+"""PS graph (GNN) tables: sharded node/edge storage + neighbor sampling.
+
+Reference analog: paddle/fluid/distributed/ps/table/common_graph_table.cc
+(graph storage, random_sample_neighbors, get_node_feat) and the graph RPC in
+ps/service/graph_brpc_*. The TPU-native shape keeps the same division of
+labor: the graph lives sharded across PS server processes (hash(node) %
+n_shards); trainers sample neighborhoods and pull node features over the PS
+transport, then the gathered sub-batch trains on the TPU as dense tensors
+(geometric.send_recv / sparse.nn message passing).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["GraphTable", "GraphShardedClient"]
+
+
+class GraphTable:
+    """One shard of the graph: adjacency (+ optional edge weights) and node
+    features. All methods take/return numpy — the PS server calls them via
+    the generic `call` op."""
+
+    def __init__(self, feat_dim: int = 0):
+        self.feat_dim = int(feat_dim)
+        self._adj: Dict[int, np.ndarray] = {}
+        self._w: Dict[int, np.ndarray] = {}
+        self._feat: Dict[int, np.ndarray] = {}
+        self._mu = threading.Lock()
+
+    # ------------------------------------------------------------- build
+    def add_edges(self, edges, weights=None):
+        """edges [E, 2] (src, dst) — stored on src's shard; weights [E]."""
+        e = np.asarray(edges, np.int64).reshape(-1, 2)
+        w = None if weights is None else np.asarray(weights, np.float32)
+        with self._mu:
+            order = np.argsort(e[:, 0], kind="stable")
+            e = e[order]
+            if w is not None:
+                w = w[order]
+            srcs, starts = np.unique(e[:, 0], return_index=True)
+            bounds = np.append(starts, len(e))
+            for i, s in enumerate(srcs):
+                nbrs = e[starts[i]:bounds[i + 1], 1]
+                old = self._adj.get(int(s))
+                self._adj[int(s)] = nbrs.copy() if old is None \
+                    else np.concatenate([old, nbrs])
+                if w is not None:
+                    ws = w[starts[i]:bounds[i + 1]]
+                    oldw = self._w.get(int(s))
+                    self._w[int(s)] = ws.copy() if oldw is None \
+                        else np.concatenate([oldw, ws])
+        return True
+
+    def add_nodes(self, ids, feats=None):
+        ids = np.asarray(ids, np.int64).ravel()
+        with self._mu:
+            for i, nid in enumerate(ids):
+                self._adj.setdefault(int(nid), np.empty(0, np.int64))
+                if feats is not None:
+                    self._feat[int(nid)] = np.asarray(feats[i], np.float32)
+        return True
+
+    # ------------------------------------------------------------ queries
+    def node_degrees(self, ids):
+        with self._mu:
+            return np.asarray([len(self._adj.get(int(i), ()))
+                               for i in np.asarray(ids).ravel()], np.int64)
+
+    def sample_neighbors(self, ids, k: int, strategy: str = "uniform",
+                         seed: int = 0):
+        """[len(ids), k] neighbor ids, -1 padded when degree < k.
+        uniform: without replacement up to degree; weighted: with
+        replacement, P(j) ∝ weight(j) (reference WeightedSampler)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.full((len(ids), int(k)), -1, np.int64)
+        rng = np.random.RandomState(seed)
+        with self._mu:
+            for r, nid in enumerate(ids):
+                nbrs = self._adj.get(int(nid))
+                if nbrs is None or len(nbrs) == 0:
+                    continue
+                if strategy == "weighted" and int(nid) in self._w:
+                    p = self._w[int(nid)].astype(np.float64)
+                    p = p / p.sum()
+                    out[r] = rng.choice(nbrs, size=int(k), replace=True, p=p)
+                elif len(nbrs) <= k:
+                    out[r, :len(nbrs)] = rng.permutation(nbrs)
+                else:
+                    out[r] = rng.choice(nbrs, size=int(k), replace=False)
+        return out
+
+    def pull_features(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.zeros((len(ids), self.feat_dim), np.float32)
+        with self._mu:
+            for i, nid in enumerate(ids):
+                f = self._feat.get(int(nid))
+                if f is not None:
+                    out[i] = f
+        return out
+
+    def random_nodes(self, n: int, seed: int = 0):
+        with self._mu:
+            all_ids = np.fromiter(self._adj.keys(), np.int64,
+                                  len(self._adj))
+        if len(all_ids) == 0:
+            return np.empty(0, np.int64)
+        rng = np.random.RandomState(seed)
+        return rng.choice(all_ids, size=min(int(n), len(all_ids)),
+                          replace=False)
+
+    def size(self):
+        with self._mu:
+            return len(self._adj)
+
+    def state_dict(self):
+        with self._mu:
+            return {"feat_dim": self.feat_dim, "adj": dict(self._adj),
+                    "w": dict(self._w), "feat": dict(self._feat)}
+
+    def load_state_dict(self, state):
+        with self._mu:
+            self.feat_dim = state["feat_dim"]
+            self._adj = dict(state["adj"])
+            self._w = dict(state.get("w", {}))
+            self._feat = dict(state.get("feat", {}))
+
+
+class GraphShardedClient:
+    """Trainer-side view over hash-sharded GraphTables on N PS servers.
+
+    Routing: node v lives on shard v % n_shards (reference: graph shard_num
+    partitioning). Batch queries split per shard, run over the PS transport,
+    and re-assemble in input order."""
+
+    def __init__(self, clients: Sequence, table: str = "graph"):
+        self._clients = list(clients)
+        self._table = table
+
+    @property
+    def n_shards(self):
+        return len(self._clients)
+
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        return [(s, np.nonzero(ids % self.n_shards == s)[0])
+                for s in range(self.n_shards)]
+
+    def _scatter_call(self, method, ids, *args, width=None, dtype=np.int64,
+                      fill=-1):
+        ids = np.asarray(ids, np.int64).ravel()
+        parts = self._shard(ids)
+        if width is None:
+            out = np.full(len(ids), fill, dtype)
+        else:
+            out = np.full((len(ids), width), fill, dtype)
+        for s, rows in parts:
+            if len(rows) == 0:
+                continue
+            res = self._clients[s].call_table(self._table, method,
+                                              ids[rows], *args)
+            out[rows] = res
+        return out
+
+    def add_edges(self, edges, weights=None):
+        e = np.asarray(edges, np.int64).reshape(-1, 2)
+        w = None if weights is None else np.asarray(weights, np.float32)
+        for s in range(self.n_shards):
+            rows = np.nonzero(e[:, 0] % self.n_shards == s)[0]
+            if len(rows):
+                self._clients[s].call_table(
+                    self._table, "add_edges", e[rows],
+                    None if w is None else w[rows])
+
+    def add_nodes(self, ids, feats=None):
+        ids = np.asarray(ids, np.int64).ravel()
+        feats = None if feats is None else np.asarray(feats, np.float32)
+        for s in range(self.n_shards):
+            rows = np.nonzero(ids % self.n_shards == s)[0]
+            if len(rows):
+                self._clients[s].call_table(
+                    self._table, "add_nodes", ids[rows],
+                    None if feats is None else feats[rows])
+
+    def sample_neighbors(self, ids, k, strategy="uniform", seed=0):
+        return self._scatter_call("sample_neighbors", ids, k, strategy, seed,
+                                  width=int(k))
+
+    def node_degrees(self, ids):
+        return self._scatter_call("node_degrees", ids, fill=0)
+
+    def pull_features(self, ids, feat_dim):
+        return self._scatter_call("pull_features", ids, width=int(feat_dim),
+                                  dtype=np.float32, fill=0.0)

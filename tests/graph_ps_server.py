@@ -1,0 +1,20 @@
+"""Worker: one PS server process hosting a GraphTable shard (test helper)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed.ps import GraphTable, PSServer  # noqa: E402
+
+
+def main():
+    feat_dim = int(sys.argv[1])
+    srv = PSServer({"graph": GraphTable(feat_dim=feat_dim)}, port=0)
+    print(f"PORT {srv.port}", flush=True)
+    while True:
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()
