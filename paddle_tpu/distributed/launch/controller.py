@@ -47,6 +47,9 @@ class LaunchContext:
     server_num: int = 0
     trainer_num: int = 0
     envs: Dict[str, str] = field(default_factory=dict)
+    elastic_level: int = 0                 # 1: scale world on worker loss
+    min_np: int = 1                        # elastic floor
+    max_np: int = 0                        # elastic ceiling (0: nproc_per_node)
 
 
 class PodController:
@@ -134,7 +137,7 @@ class PodController:
     def _build_env(self, node_rank: int, local_rank: int,
                    coordinator: str) -> Dict[str, str]:
         ctx = self.ctx
-        nproc = ctx.nproc_per_node
+        nproc = getattr(self, "_np_override", None) or ctx.nproc_per_node
         world = ctx.nnodes * nproc
         rank = node_rank * nproc + local_rank
         env = dict(os.environ)
@@ -164,8 +167,9 @@ class PodController:
 
     def _spawn(self, node_rank: int, coordinator: str):
         ctx = self.ctx
+        nproc = getattr(self, "_np_override", None) or ctx.nproc_per_node
         self.procs, self.logs = [], []
-        for local_rank in range(ctx.nproc_per_node):
+        for local_rank in range(nproc):
             env = self._build_env(node_rank, local_rank, coordinator)
             cmd = [sys.executable] + ctx.script
             log = None
@@ -263,9 +267,90 @@ class PodController:
         finally:
             self._terminate()  # also closes self.logs
 
+    def run_elastic(self) -> int:
+        """Elastic supervision (reference: fleet/elastic/manager.py:252-321 —
+        on node loss the manager rewrites PADDLE_TRAINER_ENDPOINTS and
+        relaunches trainers at the surviving world size).
+
+        Single-node semantics here: a dead worker scales the world IN
+        (np-1, down to --min_np); a control file `<log_dir>/elastic_np`
+        containing a larger np scales it OUT at the next boundary. Every
+        incarnation gets a FRESH coordinator (the old jax.distributed world
+        is unsalvageable once a member died) and fresh PADDLE_* envs; workers
+        are expected to resume from their own checkpoints — on TPU pods
+        checkpoint-restore is the preemption story, not live endpoint rewrite
+        (slices restore whole; see ElasticManager docstring)."""
+        ctx = self.ctx
+        if ctx.nnodes > 1:
+            raise ValueError("elastic_level=1 supervises a single node's "
+                             "workers (multi-node worlds restore from "
+                             "checkpoint via the watcher + rendezvous)")
+        np_now = ctx.nproc_per_node
+        incarnation = 0
+        ctl = os.path.join(ctx.log_dir, "elastic_np") if ctx.log_dir else None
+
+        np_max = ctx.max_np or ctx.nproc_per_node
+        # a deterministically-failing script must not restart forever: with
+        # --max_restart unset, elastic still stops after a default budget
+        budget = ctx.max_restart if ctx.max_restart > 0 else 10
+
+        def desired_np():
+            if ctl:
+                try:
+                    with open(ctl) as f:
+                        want = int(f.read().strip())
+                    return max(ctx.min_np, min(want, np_max))
+                except (OSError, ValueError):
+                    pass
+            return None
+
+        try:
+            while True:
+                self._np_override = np_now
+                coordinator = f"127.0.0.1:{free_port()}"
+                self._token = self._bus_token(0)
+                os.environ["PADDLE_ELASTIC_RESTART"] = str(incarnation)
+                ctx.envs["PADDLE_ELASTIC_RESTART"] = str(incarnation)
+                self._spawn(0, coordinator)
+                rc = None
+                while rc is None:
+                    time.sleep(0.3)
+                    rc = self._poll()
+                    want = desired_np()
+                    if rc is None and want is not None and want > np_now:
+                        print(f"[launch] elastic scale-OUT requested: "
+                              f"{np_now} -> {want}", file=sys.stderr)
+                        self._terminate()
+                        np_now = want
+                        incarnation += 1
+                        break
+                else:
+                    self._terminate()
+                    if rc == 0:
+                        return 0
+                    if incarnation >= budget:
+                        print(f"[launch] elastic: restart budget "
+                              f"({budget}) exhausted", file=sys.stderr)
+                        return rc
+                    if np_now - 1 >= ctx.min_np:
+                        print(f"[launch] worker lost (rc={rc}); elastic "
+                              f"scale-IN {np_now} -> {np_now - 1}",
+                              file=sys.stderr)
+                        np_now -= 1
+                    else:
+                        print(f"[launch] worker lost (rc={rc}) at the "
+                              f"--min_np floor; restarting at np={np_now}",
+                              file=sys.stderr)
+                    incarnation += 1
+                continue
+        finally:
+            self._terminate()
+
     def run(self) -> int:
         if self.ctx.run_mode == "ps":
             return self._run_ps()
+        if self.ctx.elastic_level > 0:
+            return self.run_elastic()
         if self.ctx.max_restart > 0 and self.ctx.nnodes > 1:
             # a local-pod restart would re-register a dead incarnation with the
             # still-live jax coordinator and hang the fleet; whole-job restart

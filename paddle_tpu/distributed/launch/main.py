@@ -40,6 +40,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ps mode: parameter-server process count")
     p.add_argument("--trainer_num", type=int, default=0,
                    help="ps mode: trainer process count")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="1: scale the world in/out on worker loss "
+                        "(reference fleet elastic manager semantics; workers "
+                        "resume from their checkpoints)")
+    p.add_argument("--min_np", type=int, default=1,
+                   help="elastic floor: never scale below this worker count")
+    p.add_argument("--max_np", type=int, default=0,
+                   help="elastic ceiling for scale-out (0: nproc_per_node)")
     p.add_argument("script", nargs=argparse.REMAINDER,
                    help="training script (or -m module) and its args")
     return p
@@ -59,7 +67,9 @@ def launch(argv: Optional[List[str]] = None) -> int:
                         log_dir=args.log_dir, devices=args.devices,
                         max_restart=args.max_restart, run_mode=args.run_mode,
                         server_num=args.server_num,
-                        trainer_num=args.trainer_num)
+                        trainer_num=args.trainer_num,
+                        elastic_level=args.elastic_level, min_np=args.min_np,
+                        max_np=args.max_np)
     return PodController(ctx).run()
 
 

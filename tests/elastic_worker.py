@@ -1,0 +1,72 @@
+"""Elastic-training worker (test_elastic_scale.py).
+
+Trains a convex least-squares problem data-parallel (grads all-reduced over
+the per-process backend), checkpointing every step. On restart it RESUMES
+from the checkpoint — the preemption-checkpoint story the elastic controller
+relies on. In incarnation 0, the LAST rank kills itself after a few steps to
+simulate a lost worker.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    outdir = sys.argv[1]
+    steps = int(sys.argv[2])
+    die_at = int(sys.argv[3])
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    incarnation = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+
+    # convex problem: minimize ||Xw - y||^2, X/y fixed per rank-count-agnostic
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype(np.float32)
+    true_w = rs.randn(8, 1).astype(np.float32)
+    y = X @ true_w
+
+    ckpt = os.path.join(outdir, "ckpt.npz")
+    if os.path.exists(ckpt):
+        state = np.load(ckpt)
+        w = state["w"]
+        start = int(state["step"])
+    else:
+        w = np.zeros((8, 1), np.float32)
+        start = 0
+
+    log = open(os.path.join(outdir, f"events.{incarnation}.{rank}.jsonl"), "a")
+    shard = slice(rank * (64 // world), (rank + 1) * (64 // world))
+    lr = 0.02
+    for step in range(start, start + steps):
+        Xs, ys = X[shard], y[shard]
+        grad = 2.0 * Xs.T @ (Xs @ w - ys) / len(Xs)
+        g = paddle.to_tensor(grad)
+        dist.all_reduce(g)
+        w = w - lr * (g.numpy() / world)
+        loss = float(np.mean((X @ w - y) ** 2))
+        log.write(json.dumps({"incarnation": incarnation, "rank": rank,
+                              "world": world, "step": step,
+                              "loss": loss}) + "\n")
+        log.flush()
+        if rank == 0:
+            np.savez(ckpt + ".tmp.npz", w=w, step=step + 1)
+            os.replace(ckpt + ".tmp.npz", ckpt)
+        if incarnation == 0 and rank == world - 1 and step - start + 1 >= die_at:
+            os._exit(17)  # simulated preemption of the last worker
+    log.close()
+
+
+if __name__ == "__main__":
+    main()

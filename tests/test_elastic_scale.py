@@ -1,0 +1,108 @@
+"""Elastic scale-in end-to-end (VERDICT r3 missing #3).
+
+Reference bar: fleet/elastic/manager.py:252-321 — on node loss the manager
+rewrites the trainer world and relaunches; training RESUMES and keeps
+improving. Here: launch 3 workers, worker 2 dies mid-run, the elastic
+controller relaunches the world at n=2 with fresh coordinator + PADDLE_*
+envs, and the workers continue from the checkpoint with loss still
+descending. The scale-up path (elastic_np control file) is covered at the
+controller level by test_elastic_scale_out_control_file.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _read_events(outdir):
+    evs = []
+    for f in sorted(os.listdir(outdir)):
+        if f.startswith("events."):
+            for line in open(os.path.join(outdir, f)):
+                evs.append(json.loads(line))
+    return evs
+
+
+def test_elastic_scale_in_resumes_training(tmp_path):
+    out = tmp_path / "out"
+    out.mkdir()
+    env = {k: v for k, v in os.environ.items() if not k.startswith("PADDLE_")}
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--elastic_level", "1", "--min_np", "2",
+         "--max_restart", "3", "--log_dir", str(tmp_path / "logs"),
+         WORKER, str(out), "6", "3"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.exists():
+        for f in sorted(logdir.iterdir()):
+            if f.is_file():
+                logs += f"\n--- {f.name} ---\n" + f.read_text()[-1500:]
+    assert proc.returncode == 0, (f"rc={proc.returncode}\n{proc.stdout[-1500:]}"
+                                  f"\n{proc.stderr[-1500:]}{logs}")
+    assert "elastic scale-IN 3 -> 2" in proc.stderr
+
+    evs = _read_events(str(out))
+    inc0 = [e for e in evs if e["incarnation"] == 0 and e["rank"] == 0]
+    inc1 = [e for e in evs if e["incarnation"] == 1 and e["rank"] == 0]
+    assert inc0 and inc1, evs[:5]
+    assert all(e["world"] == 3 for e in inc0)
+    assert all(e["world"] == 2 for e in inc1)
+    # resume: incarnation 1 starts where the checkpoint left off, not at 0
+    assert min(e["step"] for e in inc1) > 0
+    # training keeps descending across the scale event
+    assert inc1[-1]["loss"] < inc0[0]["loss"]
+    assert inc1[-1]["loss"] < inc1[0]["loss"]
+
+
+def test_elastic_scale_out_control_file(tmp_path):
+    """Controller-level scale-out: desired-np file grows the world at the
+    next boundary, training resumes from the checkpoint at the larger np."""
+    import time
+
+    out = tmp_path / "out"
+    out.mkdir()
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    env = {k: v for k, v in os.environ.items() if not k.startswith("PADDLE_")}
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_level", "1", "--min_np", "2",
+         "--max_restart", "3", "--max_np", "3", "--log_dir", str(logdir),
+         WORKER, str(out), "40", "999"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        # wait for incarnation 0 to make real progress, then request np=3
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            evs = _read_events(str(out))
+            if any(e["incarnation"] == 0 and e["step"] >= 2 for e in evs):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("incarnation 0 never progressed")
+        (logdir / "elastic_np").write_text("3")
+        stdout, stderr = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{stdout[-1500:]}\n" \
+                                 f"{stderr[-1500:]}"
+    assert "elastic scale-OUT requested: 2 -> 3" in stderr
+    evs = _read_events(str(out))
+    worlds = {e["incarnation"]: e["world"] for e in evs}
+    assert worlds.get(0) == 2
+    assert worlds.get(1) == 3
+    # scale-out also resumes from checkpoint
+    inc1 = [e for e in evs if e["incarnation"] == 1]
+    assert min(e["step"] for e in inc1) > 0
