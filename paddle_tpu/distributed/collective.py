@@ -113,6 +113,75 @@ class _MPBackend:
         return np.asarray(multihost_utils.process_allgather(
             np.asarray(arr), tiled=False))
 
+    # -------------------------------------------------- device fast path
+    #
+    # When every process addresses exactly one device (launcher CPU ranks;
+    # one-chip-per-host TPU), the ranks form a 1-D global mesh and eager
+    # all_reduce/all_gather can run as a jitted shard_map collective ON
+    # DEVICE (XLA cross-process runtime) instead of the host
+    # process_allgather round-trip — the reference's NCCL eager path analog.
+
+    def _mesh(self):
+        if not hasattr(self, "_mesh_cache"):
+            self._mesh_cache = None
+            try:
+                import numpy as np
+                from jax.sharding import Mesh
+                devs = sorted(jax.devices(), key=lambda d: d.process_index)
+                if (len(devs) == self.world
+                        and len(jax.local_devices()) == 1):
+                    self._mesh_cache = Mesh(np.array(devs), ("r",))
+            except Exception:
+                self._mesh_cache = None
+        return self._mesh_cache
+
+    def _dev_collective(self, kind, local, body):
+        """Shared device-collective machinery: assemble the global [world,...]
+        array from the local shard, run the cached jitted shard_map `body`,
+        return this rank's output shard. Returns None when unavailable —
+        and remembers a failure (nulls the mesh) so a runtime without
+        cross-process device collectives doesn't pay device_put + a raised
+        exception on EVERY eager collective call."""
+        mesh = self._mesh()
+        if mesh is None:
+            return None
+        try:
+            import jax.numpy as _jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+            local = _jnp.asarray(local)
+            sh = NamedSharding(mesh, P("r"))
+            garr = jax.make_array_from_single_device_arrays(
+                (self.world,) + tuple(local.shape), sh,
+                [jax.device_put(local[None], jax.local_devices()[0])])
+            key = (kind, tuple(local.shape), str(local.dtype))
+            fns = self.__dict__.setdefault("_dev_fns", {})
+            fn = fns.get(key)
+            if fn is None:
+                fn = jax.jit(shard_map(body, mesh=mesh,
+                                       in_specs=P("r"), out_specs=P("r")))
+                fns[key] = fn
+            out = fn(garr)
+            return out.addressable_shards[0].data[0]
+        except Exception:
+            self._mesh_cache = None  # sticky: don't retry per call
+            return None
+
+    def allreduce_dev(self, local, op):
+        """Device-side all-reduce of each rank's local array; returns the
+        reduced jax array, or None when the fast path is unavailable."""
+        if op == ReduceOp.PROD:
+            return None
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.AVG: jax.lax.pmean,
+               ReduceOp.MAX: jax.lax.pmax, ReduceOp.MIN: jax.lax.pmin}[op]
+        return self._dev_collective(("ar", op), local,
+                                    lambda x: red(x[0], "r")[None])
+
+    def allgather_dev(self, local):
+        """Device-side all-gather; [world, ...] jax array or None."""
+        return self._dev_collective(
+            "ag", local, lambda x: jax.lax.all_gather(x[0], "r")[None])
+
     def barrier(self):
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
@@ -268,6 +337,12 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
     slice of dim 0 becomes the reduction of all slices."""
     if _mp_mode(group):
         be = _MPBackend.get()
+        fast = be.allreduce_dev(_unwrap(tensor), op)
+        if fast is not None:      # device collective (see _MPBackend fast path)
+            if isinstance(tensor, Tensor):
+                tensor._data = fast
+                return tensor
+            return Tensor(fast)
         stacked = be.allgather_np(_unwrap(tensor))
         red = _red_np(op)(stacked, axis=0)
         if op == ReduceOp.AVG:
@@ -359,7 +434,10 @@ def all_gather(tensor_list: Optional[List] = None, tensor=None,
     if tensor is None and tensor_list is not None and not isinstance(tensor_list, list):
         tensor, tensor_list = tensor_list, None
     if _mp_mode(group):
-        gathered = _MPBackend.get().allgather_np(_unwrap(tensor))
+        be = _MPBackend.get()
+        gathered = be.allgather_dev(_unwrap(tensor))
+        if gathered is None:
+            gathered = be.allgather_np(_unwrap(tensor))
         if tensor_list is not None:
             for i in range(gathered.shape[0]):
                 tensor_list.append(Tensor(gathered[i]))

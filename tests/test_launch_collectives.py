@@ -49,3 +49,9 @@ def test_two_process_real_collectives(tmp_path):
                 r[key], r[f"{key}_want"],
                 err_msg=f"rank {rank} {key} mismatch")
         assert r["gather_obj_ok"], f"rank {rank} all_gather_object mismatch"
+        # bandwidth microbench ran; when the device fast path is available
+        # it must agree with the host reduction (see _MPBackend.allreduce_dev)
+        assert r["bw_host_MBps"] > 0
+        if r.get("device_path"):
+            assert r["device_allreduce_ok"], \
+                f"rank {rank} device all_reduce diverged from host path"
