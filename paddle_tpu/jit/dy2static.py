@@ -36,8 +36,9 @@ __all__ = ["convert_to_static", "cfg_convertible"]
 
 
 class _Undef:
-    """Placeholder for a name unbound before a branch/loop: using it inside a
-    converted region raises with the variable's name."""
+    """Placeholder for a name unbound before a branch/loop: USING it in any
+    value context raises with the variable's name (mirroring python's
+    UnboundLocalError at the use site)."""
 
     __slots__ = ("name",)
 
@@ -49,7 +50,13 @@ class _Undef:
             f"dy2static: variable {self.name!r} is used in a converted "
             f"if/while branch but was not defined before it on every path")
 
-    __call__ = __getattr__ = __add__ = __radd__ = __mul__ = _raise
+    __call__ = __getattr__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __bool__ = __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __getitem__ = __iter__ = __len__ = __neg__ = _raise
+    __format__ = __str__ = _raise
+    __hash__ = object.__hash__  # defining __eq__ would otherwise unset it
 
     def __repr__(self):
         return f"<undef {self.name}>"
@@ -158,6 +165,20 @@ _HELPERS = {
     "__dy2s_bool": _dy2s_bool,
     "__dy2s_maybe": _dy2s_maybe,
 }
+
+
+class _GlobalsProxy(dict):
+    """exec/function globals holding only the dy2static helpers; missing keys
+    resolve against the wrapped module globals LIVE (LOAD_GLOBAL honors
+    __missing__ on dict subclasses; a KeyError here falls through to
+    builtins, preserving normal NameError semantics)."""
+
+    def __init__(self, base, extra):
+        super().__init__(extra)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
 
 
 # ---------------------------------------------------------------- AST analysis
@@ -403,8 +424,12 @@ def _convert_cached(fn: Callable) -> Callable:
     ast.fix_missing_locations(mod)
     code = compile(mod, f"<dy2static {getattr(fn, '__qualname__', fn)}>",
                    "exec")
-    env = dict(fn.__globals__)
-    env.update(_HELPERS)
+    # live-globals proxy: only the __dy2s_* helpers are overlaid; every other
+    # lookup falls through to the ORIGINAL module globals at call time — so
+    # forward references, recursion, and post-decoration rebinding behave
+    # exactly as in the unconverted function (a dict snapshot would freeze
+    # decoration-time state)
+    env = _GlobalsProxy(fn.__globals__, _HELPERS)
     exec(code, env)
     out = env[fndef.name]
     out.__defaults__ = fn.__defaults__
