@@ -1,27 +1,92 @@
-"""paddle.onnx — ONNX export surface.
+"""paddle.onnx — ONNX export.
 
 Reference analog: python/paddle/onnx/export.py, which delegates to the
-external paddle2onnx converter. This environment ships no onnx runtime or
-converter, so `export` raises with the working alternative: `paddle.jit.save`
-emits a portable serialized StableHLO program (the TPU-native interchange
-format), loadable by `paddle.jit.load` / served via paddle.inference.
+external paddle2onnx converter (ProgramDesc -> ONNX). Here the converter is
+SELF-CONTAINED: the layer is traced to a jaxpr (the same capture jit.save
+uses) and mapped primitive-by-primitive to ONNX ops, serialized directly in
+the ONNX protobuf wire format (paddle_tpu/onnx/_proto.py — this image ships
+no `onnx` package, so the writer carries its own structural decoder for
+validation; runtime validation needs onnxruntime outside this image).
+
+Export an EVAL-mode model (dropout off); unsupported primitives raise with
+their name. paddle.jit.save (serialized StableHLO) remains the lossless
+TPU-native interchange format.
 """
 from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export `layer` to <path>.onnx (appends the suffix if missing).
+
+    input_spec: list of InputSpec/Tensors fixing input shapes (leading -1
+    exports with batch dimension 1)."""
+    import jax
+    import numpy as np
+
+    from ..core import dispatch
+    from ..core.tensor import Tensor
+    from ..jit.input_spec import InputSpec
+    from ..nn.layer import Layer
+    from ._convert import jaxpr_to_onnx
+
+    if not isinstance(layer, Layer):
+        raise ValueError("paddle.onnx.export expects a Layer")
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    if int(opset_version) < 13:
+        raise ValueError(
+            f"paddle.onnx.export emits opset-13 node forms (2-input "
+            f"ReduceSum/Squeeze, 5-input Slice); opset_version="
+            f"{opset_version} would stamp an invalid model — pass >= 13")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+
+    was_training = getattr(layer, "training", False)
+    layer.eval()
     try:
-        import onnx  # noqa: F401
-        import paddle2onnx  # noqa: F401
-    except ImportError as e:
-        raise RuntimeError(
-            "ONNX export needs the external onnx/paddle2onnx packages, which "
-            "are not part of this TPU image. Use paddle.jit.save(layer, path, "
-            "input_spec=...) — the .pdmodel holds serialized StableHLO, the "
-            "portable interchange format for XLA-compiled programs."
-        ) from e
-    raise NotImplementedError(
-        "paddle2onnx present but the converter bridge is not wired; "
-        "use paddle.jit.save (StableHLO) for interchange")
+        params = [p for _, p in layer.named_parameters()]
+        buffers = [b for _, b in layer.named_buffers()]
+
+        def pure(*input_arrays):
+            ctx = dispatch.TraceContext()
+            dispatch.push_trace(ctx)
+            saved_p = [p._data for p in params]
+            saved_b = [b._data for b in buffers]
+            try:
+                out = layer(*[Tensor(a) for a in input_arrays])
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                return tuple(t.value() for t in outs)
+            finally:
+                dispatch.pop_trace()
+                ctx.restore()
+                for p, d in zip(params, saved_p):
+                    p._data = d
+                for b, d in zip(buffers, saved_b):
+                    b._data = d
+
+        structs = []
+        for s in specs:
+            shape = tuple(1 if d == -1 else int(d) for d in s.shape)
+            structs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+        closed = jax.make_jaxpr(pure)(*structs)
+
+        in_names = [f"input_{i}" for i in range(len(specs))]
+        n_out = len(closed.jaxpr.outvars)
+        out_names = [f"output_{i}" for i in range(n_out)]
+        blob = jaxpr_to_onnx(closed, in_names, structs, out_names,
+                             opset=int(opset_version))
+    finally:
+        if was_training:
+            layer.train()
+
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path

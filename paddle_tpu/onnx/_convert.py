@@ -1,0 +1,277 @@
+"""jaxpr -> ONNX graph conversion.
+
+Reference analog: python/paddle/onnx/export.py delegates to the external
+paddle2onnx converter (ProgramDesc -> ONNX). Here the traced program IS a
+jaxpr, so conversion is a primitive-by-primitive mapping — self-contained,
+no external converter. Call-like primitives (pjit, custom_vjp/jvp, remat)
+are inlined recursively; an unsupported primitive raises naming it.
+
+Scope: inference graphs over the core math/NN primitive set (elementwise,
+matmul/Gemm-shaped dot_general, NCHW conv, reductions, shape ops, casts,
+where). Training/export of RNG-carrying graphs is out of scope — export an
+eval-mode model (dropout off), as with the reference converter.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from . import _proto as P
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "tanh": "Tanh", "exp": "Exp", "log": "Log", "logistic": "Sigmoid",
+    "erf": "Erf", "neg": "Neg", "abs": "Abs", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "sqrt": "Sqrt",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "and": "And", "or": "Or", "not": "Not", "xor": "Xor",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+    "ge": "GreaterOrEqual", "rem": "Mod",
+}
+
+_CALL_PRIMS = {"jit", "pjit", "closed_call", "custom_jvp_call", "custom_vjp_call",
+               "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "remat",
+               "checkpoint", "custom_vjp_call_jaxpr_p", "core_call"}
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.inits: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(jaxpr var) -> onnx name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def emit(self, op, ins, outs, **attrs):
+        self.nodes.append(P.node(op, ins, outs, name=self.fresh(op), **attrs))
+
+    def const(self, arr, hint="c"):
+        name = self.fresh(hint)
+        self.inits.append(P.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            return self.const(np.asarray(var.val), "lit")
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = self.fresh("v")
+        return self.names[key]
+
+    # ------------------------------------------------------------ primitives
+
+    def eqn(self, eqn):
+        prim = eqn.primitive.name
+        ins = [self.name_of(v) for v in eqn.invars]
+        outs = [self.name_of(v) for v in eqn.outvars]
+        p = eqn.params
+
+        if prim in _CALL_PRIMS or prim.endswith("_call"):
+            inner = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+            if inner is None:
+                raise NotImplementedError(
+                    f"ONNX export: call primitive {prim!r} without an "
+                    f"inlineable jaxpr")
+            closed = inner
+            core = getattr(closed, "jaxpr", closed)
+            consts = getattr(closed, "consts", [])
+            for var, cval in zip(core.constvars, consts):
+                self.names[id(var)] = self.const(np.asarray(cval), "w")
+            # skip leading const-style args? pjit passes all args in order
+            for var, name in zip(core.invars, ins):
+                self.names[id(var)] = name
+            for e in core.eqns:
+                self.eqn(e)
+            for outer, inner_v in zip(eqn.outvars, core.outvars):
+                self.names[id(outer)] = self.name_of(inner_v)
+            return
+
+        if prim in _ELEMENTWISE:
+            self.emit(_ELEMENTWISE[prim], ins, outs)
+        elif prim == "integer_pow":
+            y = int(p["y"])
+            self.emit("Pow", [ins[0],
+                              self.const(np.asarray(float(y), np.float32))],
+                      outs)
+        elif prim == "rsqrt":
+            t = self.fresh("sqrt")
+            self.emit("Sqrt", ins, [t])
+            self.emit("Reciprocal", [t], outs)
+        elif prim == "square":
+            self.emit("Mul", [ins[0], ins[0]], outs)
+        elif prim == "cbrt":
+            third = self.const(np.asarray(1.0 / 3.0, np.float32))
+            self.emit("Pow", [ins[0], third], outs)
+        elif prim == "is_finite":
+            t1, t2 = self.fresh("isnan"), self.fresh("isinf")
+            self.emit("IsNaN", ins, [t1])
+            self.emit("IsInf", ins, [t2])
+            t3 = self.fresh("or")
+            self.emit("Or", [t1, t2], [t3])
+            self.emit("Not", [t3], outs)
+        elif prim == "erfc":  # erfc(x) = 1 - erf(x)
+            t = self.fresh("erf")
+            self.emit("Erf", ins, [t])
+            one = self.const(
+                np.asarray(1.0, eqn.outvars[0].aval.dtype), "one")
+            self.emit("Sub", [one, t], outs)
+        elif prim == "select_n":
+            if len(ins) != 3:
+                raise NotImplementedError("select_n with >2 cases")
+            # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+            self.emit("Where", [ins[0], ins[2], ins[1]], outs)
+        elif prim == "convert_element_type":
+            self.emit("Cast", ins, outs,
+                      to=P._np_to_onnx_dtype(np.dtype(p["new_dtype"])))
+        elif prim == "stop_gradient" or prim == "copy":
+            self.emit("Identity", ins, outs)
+        elif prim == "reshape":
+            shp = self.const(np.asarray(p["new_sizes"], np.int64), "shape")
+            self.emit("Reshape", [ins[0], shp], outs)
+        elif prim == "squeeze":
+            axes = self.const(np.asarray(p["dimensions"], np.int64), "axes")
+            self.emit("Squeeze", [ins[0], axes], outs)
+        elif prim == "transpose":
+            self.emit("Transpose", ins, outs,
+                      perm=[int(x) for x in p["permutation"]])
+        elif prim == "broadcast_in_dim":
+            self._broadcast_in_dim(eqn, ins, outs)
+        elif prim == "concatenate":
+            self.emit("Concat", ins, outs, axis=int(p["dimension"]))
+        elif prim == "slice":
+            starts = self.const(np.asarray(p["start_indices"], np.int64))
+            ends = self.const(np.asarray(p["limit_indices"], np.int64))
+            axes = self.const(np.arange(len(p["start_indices"]), dtype=np.int64))
+            strides = p.get("strides") or [1] * len(p["start_indices"])
+            steps = self.const(np.asarray(strides, np.int64))
+            self.emit("Slice", [ins[0], starts, ends, axes, steps], outs)
+        elif prim == "rev":
+            raise NotImplementedError("ONNX export: lax.rev")
+        elif prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+            op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+                  "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+            axes = [int(a) for a in p["axes"]]
+            if op == "ReduceSum":                 # opset 13: axes is an input
+                ax = self.const(np.asarray(axes, np.int64), "axes")
+                self.emit(op, [ins[0], ax], outs, keepdims=0)
+            else:                                  # axes attr until opset 18
+                self.emit(op, ins, outs, axes=axes, keepdims=0)
+        elif prim == "reduce_and":
+            raise NotImplementedError("ONNX export: reduce_and")
+        elif prim == "dot_general":
+            self._dot_general(eqn, ins, outs)
+        elif prim == "conv_general_dilated":
+            self._conv(eqn, ins, outs)
+        elif prim == "iota":
+            aval = eqn.outvars[0].aval
+            vals = np.arange(aval.shape[p["dimension"]])
+            shape = [1] * len(aval.shape)
+            shape[p["dimension"]] = -1
+            arr = np.broadcast_to(vals.reshape(shape), aval.shape)
+            self.names[id(eqn.outvars[0])] = self.const(
+                np.asarray(arr, aval.dtype), "iota")
+        else:
+            raise NotImplementedError(
+                f"ONNX export: unsupported primitive {prim!r} (supported "
+                f"set: elementwise/matmul/conv/reduce/shape ops — see "
+                f"paddle_tpu/onnx/_convert.py)")
+
+    def _broadcast_in_dim(self, eqn, ins, outs):
+        p = eqn.params
+        out_shape = [int(s) for s in p["shape"]]
+        bdims = list(p["broadcast_dimensions"])
+        # Reshape the input so its dims sit at broadcast_dimensions (size-1
+        # everywhere else), then Expand to the target shape.
+        mid = [1] * len(out_shape)
+        in_aval = eqn.invars[0].aval
+        for d, s in zip(bdims, getattr(in_aval, "shape", ())):
+            mid[d] = int(s)
+        shp = self.const(np.asarray(mid, np.int64), "shape")
+        t = self.fresh("rsh")
+        self.emit("Reshape", [ins[0], shp], [t])
+        target = self.const(np.asarray(out_shape, np.int64), "shape")
+        self.emit("Expand", [t, target], outs)
+
+    def _dot_general(self, eqn, ins, outs):
+        p = eqn.params
+        (lc, rc), (lb, rb) = p["dimension_numbers"]
+        la = eqn.invars[0].aval
+        ra = eqn.invars[1].aval
+        ln, rn = len(la.shape), len(ra.shape)
+        # MatMul-shaped: batch dims leading and aligned, contraction =
+        # (last of lhs) x (second-to-last of rhs, or last for 1/2-D)
+        if (len(lb) == len(rb)
+                and tuple(lb) == tuple(range(len(lb)))
+                and tuple(rb) == tuple(range(len(rb)))
+                and list(lc) == [ln - 1] and ln == len(lb) + 2
+                and list(rc) == [len(rb)] and rn == len(rb) + 2):
+            # strictly [batch..., m, k] @ [batch..., k, n]: ONNX MatMul
+            # broadcasting right-aligns, so asymmetric batch ranks must NOT
+            # take this branch (they'd bind the wrong axes)
+            self.emit("MatMul", ins, outs)
+            return
+        # x @ W with W stored transposed ([out, in]): contraction on rhs LAST
+        if not lb and not rb and list(lc) == [ln - 1] and rn == 2 \
+                and list(rc) == [1]:
+            t = self.fresh("wT")
+            self.emit("Transpose", [ins[1]], [t], perm=[1, 0])
+            self.emit("MatMul", [ins[0], t], outs)
+            return
+        raise NotImplementedError(
+            f"ONNX export: dot_general with dimension_numbers "
+            f"{p['dimension_numbers']} (only MatMul-shaped contractions)")
+
+    def _conv(self, eqn, ins, outs):
+        p = eqn.params
+        if any(int(d) != 1 for d in p.get("lhs_dilation", ())) \
+                or int(p.get("batch_group_count", 1)) != 1:
+            raise NotImplementedError(
+                "ONNX export: input-dilated (transposed) or batch-grouped "
+                "convolutions are not supported")
+        dn = p["dimension_numbers"]
+        spec = (dn.lhs_spec, dn.rhs_spec, dn.out_spec)
+        nd = len(dn.lhs_spec) - 2
+        if spec != (tuple(range(nd + 2)), tuple(range(nd + 2)),
+                    tuple(range(nd + 2))):
+            raise NotImplementedError(
+                "ONNX export: conv dimension_numbers must be NCHW/OIHW")
+        pads_lo = [int(a) for a, _ in p["padding"]]
+        pads_hi = [int(b) for _, b in p["padding"]]
+        if int(p.get("feature_group_count", 1)) != 1:
+            group = int(p["feature_group_count"])
+        else:
+            group = 1
+        self.emit("Conv", ins, outs,
+                  strides=[int(s) for s in p["window_strides"]],
+                  pads=pads_lo + pads_hi,
+                  dilations=[int(d) for d in p["rhs_dilation"]],
+                  group=group)
+
+
+def jaxpr_to_onnx(closed_jaxpr, input_names, input_avals, output_names,
+                  graph_name="paddle_tpu_graph", opset=13):
+    conv = _Converter()
+    core = closed_jaxpr.jaxpr
+    for var, cval in zip(core.constvars, closed_jaxpr.consts):
+        conv.names[id(var)] = conv.const(np.asarray(cval), "w")
+    for var, name in zip(core.invars, input_names):
+        conv.names[id(var)] = name
+    for e in core.eqns:
+        conv.eqn(e)
+    out_actual = [conv.name_of(v) for v in core.outvars]
+    # bind requested output names via Identity (keeps graph IO stable)
+    for want, got in zip(output_names, out_actual):
+        conv.emit("Identity", [got], [want])
+    inputs = [P.value_info(n, a.dtype, a.shape)
+              for n, a in zip(input_names, input_avals)]
+    outputs = [P.value_info(n, v.aval.dtype, v.aval.shape)
+               for n, v in zip(output_names, core.outvars)]
+    g = P.graph(conv.nodes, graph_name, conv.inits, inputs, outputs)
+    return P.model(g, opset=opset)

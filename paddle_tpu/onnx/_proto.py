@@ -1,0 +1,273 @@
+"""Minimal ONNX protobuf wire-format writer/reader (no external deps).
+
+The ONNX IR (onnx.proto) is a stable public protobuf schema; this module
+encodes the subset the exporter emits — ModelProto / GraphProto / NodeProto /
+AttributeProto / TensorProto / ValueInfoProto — straight to wire format, and
+decodes it back for structural self-validation (this image ships no `onnx`
+package to check against; the reader keeps the writer honest).
+
+Field numbers follow onnx.proto (ONNX IR v8 / opset 13+):
+  ModelProto:   ir_version=1, producer_name=2, producer_version=3, graph=7,
+                opset_import=8 (OperatorSetIdProto: domain=1, version=2)
+  GraphProto:   node=1, name=2, initializer=5, input=11, output=12
+  NodeProto:    input=1, output=2, name=3, op_type=4, attribute=5
+  AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20
+                (enum FLOAT=1 INT=2 STRING=3 TENSOR=4 FLOATS=6 INTS=7)
+  TensorProto:  dims=1, data_type=2, name=8, raw_data=9
+                (elem enum: FLOAT=1 UINT8=2 INT8=3 INT32=6 INT64=7 BOOL=9
+                 FLOAT16=10 DOUBLE=11 BFLOAT16=16)
+  ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1
+                (Tensor: elem_type=1, shape=2; TensorShapeProto.dim=1,
+                 Dimension: dim_value=1, dim_param=2)
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# TensorProto.DataType
+F32, U8, I8, I32, I64, BOOL, F16, F64, BF16 = 1, 2, 3, 6, 7, 9, 10, 11, 16
+
+NP2ONNX = {
+    np.dtype(np.float32): F32, np.dtype(np.float64): F64,
+    np.dtype(np.int32): I32, np.dtype(np.int64): I64,
+    np.dtype(np.int8): I8, np.dtype(np.uint8): U8,
+    np.dtype(np.bool_): BOOL, np.dtype(np.float16): F16,
+}
+
+
+def _np_to_onnx_dtype(dt) -> int:
+    dt = np.dtype(dt)
+    if dt in NP2ONNX:
+        return NP2ONNX[dt]
+    if str(dt) == "bfloat16":
+        return BF16
+    raise ValueError(f"no ONNX dtype for {dt}")
+
+
+# ------------------------------------------------------------- wire encoding
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    if n < 0:
+        n += 1 << 64
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(int(v))
+
+
+def _float_field(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(v))
+
+
+def _str_field(field: int, s: str) -> bytes:
+    return _len_field(field, s.encode("utf-8"))
+
+
+# ----------------------------------------------------------------- builders
+
+
+def tensor_proto(name: str, arr) -> bytes:
+    a = np.asarray(arr)
+    out = b""
+    for d in a.shape:
+        out += _int_field(1, d)
+    out += _int_field(2, _np_to_onnx_dtype(a.dtype))
+    out += _str_field(8, name)
+    out += _len_field(9, np.ascontiguousarray(a).tobytes())
+    return out
+
+
+def value_info(name: str, dtype, shape: Sequence) -> bytes:
+    shp = b""
+    for d in shape:
+        if isinstance(d, str) or d is None or (isinstance(d, int) and d < 0):
+            dim = _str_field(2, str(d) if isinstance(d, str) else "batch")
+        else:
+            dim = _int_field(1, int(d))
+        shp += _len_field(1, dim)
+    tensor_type = _int_field(1, _np_to_onnx_dtype(dtype)) + _len_field(2, shp)
+    type_proto = _len_field(1, tensor_type)
+    return _str_field(1, name) + _len_field(2, type_proto)
+
+
+def attribute(name: str, value) -> bytes:
+    out = _str_field(1, name)
+    if isinstance(value, bool):
+        out += _int_field(3, int(value)) + _int_field(20, 2)
+    elif isinstance(value, int):
+        out += _int_field(3, value) + _int_field(20, 2)
+    elif isinstance(value, float):
+        out += _float_field(2, value) + _int_field(20, 1)
+    elif isinstance(value, str):
+        out += _len_field(4, value.encode()) + _int_field(20, 3)
+    elif isinstance(value, bytes):
+        out += _len_field(5, value) + _int_field(20, 4)   # TensorProto blob
+    elif isinstance(value, (list, tuple)) and value \
+            and isinstance(value[0], float):
+        for v in value:
+            out += _float_field(7, v)
+        out += _int_field(20, 6)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += _int_field(8, int(v))
+        out += _int_field(20, 7)
+    else:
+        raise ValueError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", **attrs) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _str_field(1, i)
+    for o in outputs:
+        out += _str_field(2, o)
+    if name:
+        out += _str_field(3, name)
+    out += _str_field(4, op_type)
+    for k in sorted(attrs):
+        out += _len_field(5, attribute(k, attrs[k]))
+    return out
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    out = b""
+    for n in nodes:
+        out += _len_field(1, n)
+    out += _str_field(2, name)
+    for t in initializers:
+        out += _len_field(5, t)
+    for i in inputs:
+        out += _len_field(11, i)
+    for o in outputs:
+        out += _len_field(12, o)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    opset_id = _str_field(1, "") + _int_field(2, opset)
+    return (_int_field(1, 8)                     # ir_version 8
+            + _str_field(2, producer)
+            + _str_field(3, "0.4")
+            + _len_field(7, graph_bytes)
+            + _len_field(8, opset_id))
+
+
+# ---------------------------------------------------------------- decoding
+# (structural self-validation: the image has no onnx package to load with)
+
+
+def _read_varint(buf: bytes, i: int):
+    n = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def parse_fields(buf: bytes) -> Dict[int, list]:
+    """field number -> list of raw values (int for varint/fixed, bytes for
+    length-delimited)."""
+    out: Dict[int, list] = {}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unexpected wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def decode_model(blob: bytes) -> dict:
+    """Parse a serialized ModelProto into a python structure (subset)."""
+    m = parse_fields(blob)
+    g = parse_fields(m[7][0])
+    nodes = []
+    for nb in g.get(1, []):
+        f = parse_fields(nb)
+        attrs = {}
+        for ab in f.get(5, []):
+            af = parse_fields(ab)
+            aname = af[1][0].decode()
+            atype = af.get(20, [0])[0]
+            if atype == 2:
+                attrs[aname] = af[3][0]
+            elif atype == 1:
+                attrs[aname] = af[2][0]
+            elif atype == 3:
+                attrs[aname] = af[4][0].decode()
+            elif atype == 7:
+                attrs[aname] = [int(v) for v in af.get(8, [])]
+            elif atype == 6:
+                attrs[aname] = af.get(7, [])
+        nodes.append({
+            "op_type": f[4][0].decode(),
+            "inputs": [s.decode() for s in f.get(1, [])],
+            "outputs": [s.decode() for s in f.get(2, [])],
+            "attrs": attrs,
+        })
+    inits = {}
+    for tb in g.get(5, []):
+        f = parse_fields(tb)
+        name = f[8][0].decode()
+        dims = [int(d) for d in f.get(1, [])]
+        dtype = int(f[2][0])
+        rev = {v: k for k, v in NP2ONNX.items()}
+        raw = f.get(9, [b""])[0]
+        if dtype in rev:
+            arr = np.frombuffer(raw, rev[dtype]).reshape(dims)
+        else:  # bfloat16: report raw
+            arr = np.frombuffer(raw, np.uint16).reshape(dims)
+        inits[name] = arr
+    def _vi(vb):
+        f = parse_fields(vb)
+        return f[1][0].decode()
+    return {
+        "ir_version": int(m[1][0]),
+        "producer": m[2][0].decode(),
+        "opset": int(parse_fields(m[8][0])[2][0]),
+        "nodes": nodes,
+        "initializers": inits,
+        "inputs": [_vi(v) for v in g.get(11, [])],
+        "outputs": [_vi(v) for v in g.get(12, [])],
+    }

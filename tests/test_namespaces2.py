@@ -100,8 +100,10 @@ def test_sysconfig_version():
     assert paddle.version.tpu == "ON"
 
 
-def test_onnx_export_points_to_stablehlo():
-    with pytest.raises(RuntimeError, match="StableHLO"):
+def test_onnx_export_works_without_spec_raises():
+    # r4: export is a real self-contained converter (tests/test_onnx_export.py);
+    # the surface contract checked here: input_spec is required
+    with pytest.raises(ValueError, match="input_spec"):
         paddle.onnx.export(paddle.nn.Linear(2, 2), "/tmp/x")
 
 
