@@ -22,7 +22,9 @@ import numpy as np
 from ..tcp_store import TCPStore
 
 __all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
-           "SSDSparseTable", "CtrAccessor", "CtrSparseTable"]
+           "SSDSparseTable", "CtrAccessor", "CtrSparseTable",
+           "GraphTable", "GraphShardedClient", "HBMCachedSparseTable",
+           "FLCoordinator", "FLClient"]
 
 
 class _PSError:
@@ -269,3 +271,5 @@ class PSClient:
 
 from .scale import SSDSparseTable, CtrAccessor, CtrSparseTable  # noqa: F401,E402
 from .graph import GraphTable, GraphShardedClient  # noqa: F401,E402
+from .heter import HBMCachedSparseTable  # noqa: F401,E402
+from .fl import FLCoordinator, FLClient  # noqa: F401,E402

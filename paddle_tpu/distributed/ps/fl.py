@@ -1,0 +1,98 @@
+"""Federated-learning coordinator over the PS transport.
+
+Reference analog: paddle/fluid/distributed/ps/coordinator (FLCoordinator /
+fl_client: clients train locally, push weight deltas, the coordinator
+aggregates FedAvg-style and serves the new global model; stragglers are
+dropped per round). The TPU-native form runs the coordinator as one more
+table on a PSServer (via the generic `call` op), so it shares the store
+transport, auth, and process model with the sparse/dense/graph tables.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["FLCoordinator", "FLClient"]
+
+
+class FLCoordinator:
+    """Server-side table: holds the global dense parameter vector and
+    aggregates one round's client updates by weighted average (FedAvg)."""
+
+    def __init__(self, init_params, min_clients: int = 1):
+        self._params = np.asarray(init_params, np.float32).ravel().copy()
+        self.min_clients = int(min_clients)
+        self.round = 0
+        self._updates: Dict[str, tuple] = {}
+        self._mu = threading.Lock()
+
+    # table api (reachable through PSClient.call_table)
+    def get_round(self):
+        return self.round
+
+    def pull_global(self):
+        with self._mu:
+            return self.round, self._params.copy()
+
+    def push_update(self, client_id: str, round_id: int, delta, n_samples: int):
+        """Accept a client's weight DELTA for the current round; stale-round
+        pushes are rejected (the reference drops straggler updates)."""
+        with self._mu:
+            if int(round_id) != self.round:
+                return {"accepted": False, "round": self.round}
+            self._updates[str(client_id)] = (
+                np.asarray(delta, np.float32).ravel(), int(n_samples))
+            return {"accepted": True, "round": self.round,
+                    "pending": len(self._updates)}
+
+    def try_aggregate(self):
+        """FedAvg when enough clients reported; advances the round."""
+        with self._mu:
+            if len(self._updates) < self.min_clients:
+                return {"aggregated": False, "pending": len(self._updates),
+                        "round": self.round}
+            total = sum(n for _, n in self._updates.values())
+            agg = np.zeros_like(self._params)
+            for delta, n in self._updates.values():
+                agg += delta * (n / total)
+            self._params += agg
+            self._updates.clear()
+            self.round += 1
+            return {"aggregated": True, "round": self.round}
+
+    def size(self):
+        return int(self._params.size)
+
+    def state_dict(self):
+        with self._mu:
+            return {"params": self._params.copy(), "round": self.round}
+
+    def load_state_dict(self, state):
+        with self._mu:
+            self._params = np.asarray(state["params"], np.float32).copy()
+            self.round = int(state["round"])
+
+
+class FLClient:
+    """Trainer-side: pull the global model, train locally, push the delta.
+
+    `local_steps(params) -> (new_params, n_samples)` is the user's local
+    training function — the coordinator only sees deltas and sample counts
+    (reference fl_client contract)."""
+
+    def __init__(self, ps_client, table: str = "fl", client_id: str = "c0"):
+        self._ps = ps_client
+        self._table = table
+        self.client_id = client_id
+
+    def pull_global(self):
+        return self._ps.call_table(self._table, "pull_global")
+
+    def run_round(self, local_steps):
+        round_id, params = self.pull_global()
+        new_params, n = local_steps(params)
+        delta = np.asarray(new_params, np.float32).ravel() - params
+        return self._ps.call_table(self._table, "push_update",
+                                   self.client_id, round_id, delta, n)

@@ -1,0 +1,113 @@
+"""HeterPS: device-cached hot tier over a host sparse table.
+
+Reference analog: paddle/fluid/framework/fleet/heter_ps/ (HBM cache of hot
+embedding rows in front of the host/SSD table; pull hits the cache, misses
+fault in from the host tier; push updates write-through). TPU-native shape:
+the hot tier is a single device-resident [capacity, dim] jax array + an id
+map; lookups for cached ids are one device gather (no host round trip),
+misses pull from the backing table and promote under LRU.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["HBMCachedSparseTable"]
+
+
+class HBMCachedSparseTable:
+    """Hot-row HBM cache in front of any table with pull/push(ids, ...).
+
+    pull(ids): cached rows come from the DEVICE buffer (one gather); misses
+    fault in from the backing table, promote (LRU evict), and the whole
+    result returns as a device array ready to feed a TPU step.
+    push(ids, grads): applied to the backing table (the optimizer state lives
+    there), then written through to cached rows so the cache never serves
+    stale values.
+    """
+
+    def __init__(self, backing, capacity: int = 4096):
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.backing = backing
+        self.capacity = int(capacity)
+        self.dim = backing.dim
+        self._buf = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        self._slots: "OrderedDict[int, int]" = OrderedDict()  # id -> slot
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- internals
+
+    def _evict_one(self) -> int:
+        old_id, slot = self._slots.popitem(last=False)   # LRU
+        return slot
+
+    def _promote(self, ids: np.ndarray, rows: np.ndarray):
+        """Install freshly-faulted rows; returns their slots."""
+        slots = []
+        for rid in ids:
+            rid = int(rid)
+            if rid in self._slots:
+                slots.append(self._slots[rid])
+                continue
+            slot = self._free.pop() if self._free else self._evict_one()
+            self._slots[rid] = slot
+            slots.append(slot)
+        self._buf = self._buf.at[np.asarray(slots)].set(
+            self._jnp.asarray(rows))
+        return slots
+
+    # ------------------------------------------------------------------ api
+
+    def pull(self, ids: Sequence[int]):
+        """Device [len(ids), dim] array; cache hits never touch the host.
+        Batches larger than the capacity still return correct values — only
+        the most recent `capacity` ids stay resident afterwards."""
+        ids = np.asarray(list(ids), np.int64)
+        hit_mask = np.asarray([int(i) in self._slots for i in ids])
+        self.hits += int(hit_mask.sum())
+        self.misses += int((~hit_mask).sum())
+        out = self._jnp.zeros((len(ids), self.dim), self._jnp.float32)
+        if hit_mask.any():
+            slots = np.asarray([self._slots[int(i)] for i in ids[hit_mask]])
+            out = out.at[np.nonzero(hit_mask)[0]].set(self._buf[slots])
+        miss_ids = ids[~hit_mask]
+        if len(miss_ids):
+            rows = np.asarray(self.backing.pull([int(i) for i in miss_ids]))
+            out = out.at[np.nonzero(~hit_mask)[0]].set(
+                self._jnp.asarray(rows))
+            keep = min(len(miss_ids), self.capacity)
+            self._promote(miss_ids[-keep:], rows[-keep:])
+        for i in ids:                       # LRU touch (resident ids only)
+            if int(i) in self._slots:
+                self._slots.move_to_end(int(i))
+        return out
+
+    def push(self, ids: Sequence[int], grads):
+        """Write-through: backing optimizer applies, cache refreshes."""
+        ids_l = [int(i) for i in ids]
+        self.backing.push(ids_l, np.asarray(grads, np.float32))
+        cached = [i for i in ids_l if i in self._slots]
+        if cached:
+            fresh = np.asarray(self.backing.pull(cached))
+            slots = np.asarray([self._slots[i] for i in cached])
+            self._buf = self._buf.at[slots].set(self._jnp.asarray(fresh))
+
+    def size(self) -> int:
+        return self.backing.size()
+
+    def cache_stats(self) -> Dict[str, int]:
+        return {"capacity": self.capacity, "resident": len(self._slots),
+                "hits": self.hits, "misses": self.misses}
+
+    def state_dict(self) -> dict:
+        return self.backing.state_dict()
+
+    def load_state_dict(self, state: dict):
+        self.backing.load_state_dict(state)
+        self._slots.clear()
+        self._free = list(range(self.capacity - 1, -1, -1))
