@@ -1,0 +1,62 @@
+// Package goapi mirrors the reference Go inference API
+// (paddle/fluid/inference/goapi/config.go) over the paddle_tpu C ABI
+// (inference/capi/paddle_inference_c.cpp).
+//
+// Build with the shared library on the cgo path:
+//
+//	CGO_LDFLAGS="-L${CAPI_DIR} -lpaddle_inference_c" go build ./...
+//
+// See README.md for the testing status in this repository.
+package goapi
+
+/*
+#cgo LDFLAGS: -lpaddle_inference_c
+#include <stdlib.h>
+
+typedef struct PD_Config PD_Config;
+PD_Config* PD_ConfigCreate();
+void PD_ConfigSetModel(PD_Config* c, const char* prog, const char* params);
+void PD_ConfigDestroy(PD_Config* c);
+const char* PD_GetVersion();
+*/
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+// Config mirrors paddle_infer.Config: model paths for the Predictor.
+type Config struct {
+	c *C.PD_Config
+}
+
+// NewConfig creates an empty config (reference: NewConfig).
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, func(x *Config) { x.Destroy() })
+	return cfg
+}
+
+// SetModel points the config at <model>.pdmodel / <params>.pdiparams
+// (reference: Config.SetModel).
+func (cfg *Config) SetModel(model, params string) {
+	cm := C.CString(model)
+	cp := C.CString(params)
+	defer C.free(unsafe.Pointer(cm))
+	defer C.free(unsafe.Pointer(cp))
+	C.PD_ConfigSetModel(cfg.c, cm, cp)
+}
+
+// Destroy releases the config (safe to call twice).
+func (cfg *Config) Destroy() {
+	if cfg.c != nil {
+		C.PD_ConfigDestroy(cfg.c)
+		cfg.c = nil
+	}
+}
+
+// Version reports the C ABI version string (reference: GetVersion).
+func Version() string {
+	return C.GoString(C.PD_GetVersion())
+}

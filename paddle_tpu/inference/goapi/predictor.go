@@ -1,0 +1,118 @@
+package goapi
+
+/*
+#cgo LDFLAGS: -lpaddle_inference_c
+#include <stdlib.h>
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+PD_Predictor* PD_PredictorCreate(PD_Config* c);
+PD_Predictor* PD_PredictorClone(PD_Predictor* p);
+void PD_PredictorDestroy(PD_Predictor* p);
+int PD_PredictorGetInputNames(PD_Predictor* p, char* buf, int cap);
+int PD_PredictorGetOutputNames(PD_Predictor* p, char* buf, int cap);
+int PD_PredictorSetInput(PD_Predictor* p, const char* name, const void* data,
+                         const long long* shape, int ndim, const char* dtype);
+int PD_PredictorRun(PD_Predictor* p);
+int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, long long* shape_out,
+                               int cap);
+long long PD_PredictorGetOutputData(PD_Predictor* p, int idx, void* buf,
+                                    long long cap);
+int PD_PredictorGetOutputDtype(PD_Predictor* p, int idx, char* buf, int cap);
+*/
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"strings"
+	"unsafe"
+)
+
+// Predictor mirrors paddle_infer.Predictor (reference: predictor.go).
+type Predictor struct {
+	p        *C.PD_Predictor
+	outNames []string
+}
+
+// NewPredictor compiles/loads the saved program named by cfg
+// (reference: NewPredictor).
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	if p == nil {
+		return nil, fmt.Errorf("goapi: predictor creation failed (see stderr)")
+	}
+	pred := &Predictor{p: p}
+	runtime.SetFinalizer(pred, func(x *Predictor) { x.Destroy() })
+	return pred, nil
+}
+
+// Clone shares weights with a new execution context (reference:
+// Predictor.Clone; the Python side serves each clone independently).
+func (pr *Predictor) Clone() (*Predictor, error) {
+	p := C.PD_PredictorClone(pr.p)
+	if p == nil {
+		return nil, fmt.Errorf("goapi: clone failed")
+	}
+	out := &Predictor{p: p}
+	runtime.SetFinalizer(out, func(x *Predictor) { x.Destroy() })
+	return out, nil
+}
+
+func (pr *Predictor) Destroy() {
+	if pr.p != nil {
+		C.PD_PredictorDestroy(pr.p)
+		pr.p = nil
+	}
+}
+
+func names(fn func(*C.char, C.int) C.int) []string {
+	buf := make([]byte, 4096)
+	n := fn((*C.char)(unsafe.Pointer(&buf[0])), C.int(len(buf)))
+	if n <= 0 {
+		return nil
+	}
+	return strings.Split(string(buf[:n]), "\n")
+}
+
+// GetInputNames lists the program's named inputs (reference parity).
+func (pr *Predictor) GetInputNames() []string {
+	return names(func(b *C.char, cap C.int) C.int {
+		return C.PD_PredictorGetInputNames(pr.p, b, cap)
+	})
+}
+
+// GetOutputNames lists the program's named outputs.
+func (pr *Predictor) GetOutputNames() []string {
+	if pr.outNames == nil {
+		pr.outNames = names(func(b *C.char, cap C.int) C.int {
+			return C.PD_PredictorGetOutputNames(pr.p, b, cap)
+		})
+	}
+	return pr.outNames
+}
+
+// GetInputHandle returns the named input tensor handle.
+func (pr *Predictor) GetInputHandle(name string) *Tensor {
+	return &Tensor{pred: pr, name: name, isInput: true}
+}
+
+// GetOutputHandle returns the named output tensor handle.
+func (pr *Predictor) GetOutputHandle(name string) *Tensor {
+	idx := -1
+	for i, n := range pr.GetOutputNames() {
+		if n == name {
+			idx = i
+		}
+	}
+	return &Tensor{pred: pr, name: name, outIdx: idx}
+}
+
+// Run executes the compiled program on the staged inputs
+// (reference: Predictor.Run).
+func (pr *Predictor) Run() error {
+	if n := C.PD_PredictorRun(pr.p); n < 0 {
+		return fmt.Errorf("goapi: run failed (see stderr)")
+	}
+	return nil
+}

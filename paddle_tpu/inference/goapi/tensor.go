@@ -1,0 +1,136 @@
+package goapi
+
+/*
+#cgo LDFLAGS: -lpaddle_inference_c
+#include <stdlib.h>
+
+typedef struct PD_Predictor PD_Predictor;
+int PD_PredictorSetInput(PD_Predictor* p, const char* name, const void* data,
+                         const long long* shape, int ndim, const char* dtype);
+int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, long long* shape_out,
+                               int cap);
+long long PD_PredictorGetOutputData(PD_Predictor* p, int idx, void* buf,
+                                    long long cap);
+int PD_PredictorGetOutputDtype(PD_Predictor* p, int idx, char* buf, int cap);
+*/
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+// Tensor mirrors paddle_infer.Tensor (reference: tensor.go): a named IO
+// handle on a Predictor. Inputs stage (shape, dtype, data) for the next
+// Run; outputs read back shape/dtype/data after Run.
+type Tensor struct {
+	pred    *Predictor
+	name    string
+	isInput bool
+	outIdx  int
+	shape   []int32
+}
+
+// Reshape records the input shape for the next CopyFromCpu
+// (reference: Tensor.Reshape).
+func (t *Tensor) Reshape(shape []int32) {
+	t.shape = append([]int32(nil), shape...)
+}
+
+// Shape reports the tensor's shape (outputs: after Run; inputs: the staged
+// Reshape value).
+func (t *Tensor) Shape() []int32 {
+	if t.isInput {
+		return t.shape
+	}
+	var buf [16]C.longlong
+	nd := C.PD_PredictorGetOutputShape(t.pred.p, C.int(t.outIdx), &buf[0], 16)
+	out := make([]int32, int(nd))
+	for i := range out {
+		out[i] = int32(buf[i])
+	}
+	return out
+}
+
+func (t *Tensor) setInput(ptr unsafe.Pointer, dtype string) error {
+	shape := make([]C.longlong, len(t.shape))
+	for i, s := range t.shape {
+		shape[i] = C.longlong(s)
+	}
+	cn := C.CString(t.name)
+	cd := C.CString(dtype)
+	defer C.free(unsafe.Pointer(cn))
+	defer C.free(unsafe.Pointer(cd))
+	var sp *C.longlong
+	if len(shape) > 0 {
+		sp = &shape[0]
+	}
+	if rc := C.PD_PredictorSetInput(t.pred.p, cn, ptr, sp,
+		C.int(len(shape)), cd); rc != 0 {
+		return fmt.Errorf("goapi: SetInput(%s) failed rc=%d", t.name, rc)
+	}
+	return nil
+}
+
+// CopyFromCpu stages input data; supported element types mirror the C ABI
+// dtype table (reference: Tensor.CopyFromCpu).
+func (t *Tensor) CopyFromCpu(value interface{}) error {
+	switch v := value.(type) {
+	case []float32:
+		return t.setInput(unsafe.Pointer(&v[0]), "float32")
+	case []int32:
+		return t.setInput(unsafe.Pointer(&v[0]), "int32")
+	case []int64:
+		return t.setInput(unsafe.Pointer(&v[0]), "int64")
+	case []float64:
+		return t.setInput(unsafe.Pointer(&v[0]), "float64")
+	case []uint8:
+		return t.setInput(unsafe.Pointer(&v[0]), "uint8")
+	case []int8:
+		return t.setInput(unsafe.Pointer(&v[0]), "int8")
+	default:
+		return fmt.Errorf("goapi: unsupported input slice type %T", value)
+	}
+}
+
+// Dtype reports the output's dtype string after Run.
+func (t *Tensor) Dtype() string {
+	var buf [32]C.char
+	n := C.PD_PredictorGetOutputDtype(t.pred.p, C.int(t.outIdx), &buf[0], 32)
+	if n <= 0 {
+		return ""
+	}
+	return C.GoStringN(&buf[0], n)
+}
+
+func (t *Tensor) copyOut(ptr unsafe.Pointer, capBytes int64) error {
+	n := C.PD_PredictorGetOutputData(t.pred.p, C.int(t.outIdx), ptr,
+		C.longlong(capBytes))
+	if int64(n) < 0 {
+		return fmt.Errorf("goapi: CopyToCpu(%s) failed", t.name)
+	}
+	if int64(n) > capBytes {
+		return fmt.Errorf("goapi: output %s needs %d bytes, buffer has %d",
+			t.name, int64(n), capBytes)
+	}
+	return nil
+}
+
+// CopyToCpu copies the output into a pre-sized slice
+// (reference: Tensor.CopyToCpu).
+func (t *Tensor) CopyToCpu(value interface{}) error {
+	switch v := value.(type) {
+	case []float32:
+		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*4)
+	case []int32:
+		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*4)
+	case []int64:
+		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*8)
+	case []float64:
+		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*8)
+	case []uint8:
+		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v)))
+	default:
+		return fmt.Errorf("goapi: unsupported output slice type %T", value)
+	}
+}
