@@ -1,3 +1,3 @@
 module github.com/paddle-tpu/paddle/inference/goapi
 
-go 1.19
+go 1.20

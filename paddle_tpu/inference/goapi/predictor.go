@@ -32,6 +32,7 @@ import (
 // Predictor mirrors paddle_infer.Predictor (reference: predictor.go).
 type Predictor struct {
 	p        *C.PD_Predictor
+	inNames  []string
 	outNames []string
 }
 
@@ -39,6 +40,7 @@ type Predictor struct {
 // (reference: NewPredictor).
 func NewPredictor(cfg *Config) (*Predictor, error) {
 	p := C.PD_PredictorCreate(cfg.c)
+	runtime.KeepAlive(cfg) // finalizer must not free cfg.c mid-call
 	if p == nil {
 		return nil, fmt.Errorf("goapi: predictor creation failed (see stderr)")
 	}
@@ -67,19 +69,32 @@ func (pr *Predictor) Destroy() {
 }
 
 func names(fn func(*C.char, C.int) C.int) []string {
+	// the C side copies only when cap > need and always RETURNS need,
+	// so size the buffer off a first probe and never slice past it
 	buf := make([]byte, 4096)
 	n := fn((*C.char)(unsafe.Pointer(&buf[0])), C.int(len(buf)))
 	if n <= 0 {
 		return nil
 	}
-	return strings.Split(string(buf[:n]), "\n")
+	if int(n) >= len(buf) {
+		buf = make([]byte, int(n)+1)
+		n = fn((*C.char)(unsafe.Pointer(&buf[0])), C.int(len(buf)))
+		if n <= 0 {
+			return nil
+		}
+	}
+	return strings.Split(string(buf[:int(n)]), "\n")
 }
 
 // GetInputNames lists the program's named inputs (reference parity).
 func (pr *Predictor) GetInputNames() []string {
-	return names(func(b *C.char, cap C.int) C.int {
-		return C.PD_PredictorGetInputNames(pr.p, b, cap)
-	})
+	if pr.inNames == nil {
+		pr.inNames = names(func(b *C.char, cap C.int) C.int {
+			return C.PD_PredictorGetInputNames(pr.p, b, cap)
+		})
+		runtime.KeepAlive(pr)
+	}
+	return pr.inNames
 }
 
 // GetOutputNames lists the program's named outputs.
@@ -88,6 +103,7 @@ func (pr *Predictor) GetOutputNames() []string {
 		pr.outNames = names(func(b *C.char, cap C.int) C.int {
 			return C.PD_PredictorGetOutputNames(pr.p, b, cap)
 		})
+		runtime.KeepAlive(pr)
 	}
 	return pr.outNames
 }
@@ -97,7 +113,10 @@ func (pr *Predictor) GetInputHandle(name string) *Tensor {
 	return &Tensor{pred: pr, name: name, isInput: true}
 }
 
-// GetOutputHandle returns the named output tensor handle.
+// GetOutputHandle returns the named output tensor handle; an unknown name
+// yields an invalid handle whose accessors error (never a silent wrong
+// tensor — python-side negative indexing would otherwise serve the LAST
+// output for idx=-1).
 func (pr *Predictor) GetOutputHandle(name string) *Tensor {
 	idx := -1
 	for i, n := range pr.GetOutputNames() {
@@ -111,7 +130,9 @@ func (pr *Predictor) GetOutputHandle(name string) *Tensor {
 // Run executes the compiled program on the staged inputs
 // (reference: Predictor.Run).
 func (pr *Predictor) Run() error {
-	if n := C.PD_PredictorRun(pr.p); n < 0 {
+	n := C.PD_PredictorRun(pr.p)
+	runtime.KeepAlive(pr)
+	if n < 0 {
 		return fmt.Errorf("goapi: run failed (see stderr)")
 	}
 	return nil

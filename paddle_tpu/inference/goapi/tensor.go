@@ -17,6 +17,7 @@ import "C"
 
 import (
 	"fmt"
+	"runtime"
 	"unsafe"
 )
 
@@ -38,13 +39,23 @@ func (t *Tensor) Reshape(shape []int32) {
 }
 
 // Shape reports the tensor's shape (outputs: after Run; inputs: the staged
-// Reshape value).
+// Reshape value). Invalid handles / pre-Run reads return nil, never panic.
 func (t *Tensor) Shape() []int32 {
 	if t.isInput {
 		return t.shape
 	}
+	if t.outIdx < 0 {
+		return nil
+	}
 	var buf [16]C.longlong
 	nd := C.PD_PredictorGetOutputShape(t.pred.p, C.int(t.outIdx), &buf[0], 16)
+	runtime.KeepAlive(t.pred)
+	if nd < 0 {
+		return nil
+	}
+	if nd > 16 {
+		nd = 16 // fixed probe buffer; the C side wrote at most 16 entries
+	}
 	out := make([]int32, int(nd))
 	for i := range out {
 		out[i] = int32(buf[i])
@@ -53,6 +64,7 @@ func (t *Tensor) Shape() []int32 {
 }
 
 func (t *Tensor) setInput(ptr unsafe.Pointer, dtype string) error {
+	defer runtime.KeepAlive(t.pred)
 	shape := make([]C.longlong, len(t.shape))
 	for i, s := range t.shape {
 		shape[i] = C.longlong(s)
@@ -75,19 +87,25 @@ func (t *Tensor) setInput(ptr unsafe.Pointer, dtype string) error {
 // CopyFromCpu stages input data; supported element types mirror the C ABI
 // dtype table (reference: Tensor.CopyFromCpu).
 func (t *Tensor) CopyFromCpu(value interface{}) error {
+	ptr := func(n int, p unsafe.Pointer) unsafe.Pointer {
+		if n == 0 {
+			return nil // zero-element tensors are legal; &v[0] would panic
+		}
+		return p
+	}
 	switch v := value.(type) {
 	case []float32:
-		return t.setInput(unsafe.Pointer(&v[0]), "float32")
+		return t.setInput(ptr(len(v), unsafe.Pointer(unsafe.SliceData(v))), "float32")
 	case []int32:
-		return t.setInput(unsafe.Pointer(&v[0]), "int32")
+		return t.setInput(ptr(len(v), unsafe.Pointer(unsafe.SliceData(v))), "int32")
 	case []int64:
-		return t.setInput(unsafe.Pointer(&v[0]), "int64")
+		return t.setInput(ptr(len(v), unsafe.Pointer(unsafe.SliceData(v))), "int64")
 	case []float64:
-		return t.setInput(unsafe.Pointer(&v[0]), "float64")
+		return t.setInput(ptr(len(v), unsafe.Pointer(unsafe.SliceData(v))), "float64")
 	case []uint8:
-		return t.setInput(unsafe.Pointer(&v[0]), "uint8")
+		return t.setInput(ptr(len(v), unsafe.Pointer(unsafe.SliceData(v))), "uint8")
 	case []int8:
-		return t.setInput(unsafe.Pointer(&v[0]), "int8")
+		return t.setInput(ptr(len(v), unsafe.Pointer(unsafe.SliceData(v))), "int8")
 	default:
 		return fmt.Errorf("goapi: unsupported input slice type %T", value)
 	}
@@ -95,6 +113,9 @@ func (t *Tensor) CopyFromCpu(value interface{}) error {
 
 // Dtype reports the output's dtype string after Run.
 func (t *Tensor) Dtype() string {
+	if t.outIdx < 0 {
+		return ""
+	}
 	var buf [32]C.char
 	n := C.PD_PredictorGetOutputDtype(t.pred.p, C.int(t.outIdx), &buf[0], 32)
 	if n <= 0 {
@@ -104,8 +125,13 @@ func (t *Tensor) Dtype() string {
 }
 
 func (t *Tensor) copyOut(ptr unsafe.Pointer, capBytes int64) error {
+	if t.outIdx < 0 {
+		return fmt.Errorf("goapi: %q is not an output of this predictor",
+			t.name)
+	}
 	n := C.PD_PredictorGetOutputData(t.pred.p, C.int(t.outIdx), ptr,
 		C.longlong(capBytes))
+	runtime.KeepAlive(t.pred)
 	if int64(n) < 0 {
 		return fmt.Errorf("goapi: CopyToCpu(%s) failed", t.name)
 	}
@@ -121,15 +147,17 @@ func (t *Tensor) copyOut(ptr unsafe.Pointer, capBytes int64) error {
 func (t *Tensor) CopyToCpu(value interface{}) error {
 	switch v := value.(type) {
 	case []float32:
-		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*4)
+		return t.copyOut(unsafe.Pointer(unsafe.SliceData(v)), int64(len(v))*4)
 	case []int32:
-		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*4)
+		return t.copyOut(unsafe.Pointer(unsafe.SliceData(v)), int64(len(v))*4)
 	case []int64:
-		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*8)
+		return t.copyOut(unsafe.Pointer(unsafe.SliceData(v)), int64(len(v))*8)
 	case []float64:
-		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v))*8)
+		return t.copyOut(unsafe.Pointer(unsafe.SliceData(v)), int64(len(v))*8)
 	case []uint8:
-		return t.copyOut(unsafe.Pointer(&v[0]), int64(len(v)))
+		return t.copyOut(unsafe.Pointer(unsafe.SliceData(v)), int64(len(v)))
+	case []int8:
+		return t.copyOut(unsafe.Pointer(unsafe.SliceData(v)), int64(len(v)))
 	default:
 		return fmt.Errorf("goapi: unsupported output slice type %T", value)
 	}
