@@ -118,6 +118,7 @@ func (t *Tensor) Dtype() string {
 	}
 	var buf [32]C.char
 	n := C.PD_PredictorGetOutputDtype(t.pred.p, C.int(t.outIdx), &buf[0], 32)
+	runtime.KeepAlive(t.pred)
 	if n <= 0 {
 		return ""
 	}

@@ -1,0 +1,280 @@
+"""Head-PAIR flash attention over the packed qkv layout, for head_dim 64.
+
+Why this exists: at head_dim 64 (GPT-medium, BERT-base, most 64-dim-head
+models) the flat [B*H, L, D] kernels read half-empty 128-lane tiles AND the
+[B,L,H,D] <-> [B*H,L,D] relayout around them costs ~4 ms/layer of pure HBM
+transposes at BERT-base shapes (measured, BASELINE.md r4). This path instead
+reads 128-wide column blocks straight out of the fused projection output
+[B, L, 3*H*D] — TWO adjacent 64-wide heads per block — and writes the
+context back pre-packed [B, L, H*D]. Zero layout copies, full lanes.
+
+Shape contract: head_dim*2 % 128 == 0, heads even, and the whole KV length
+in ONE tile (L_pad == block_k; VMEM bounds this to L <= ~1024). Within that
+contract the backward is the fused single-tile form (s/p computed once for
+dq, dk AND dv — see _flash_bwd_fused_kernel's rationale) writing d(qkv)
+directly in the packed layout.
+
+Reference analog: phi/kernels/fusion/fused_attention — the reference fuses
+qkv-projection-adjacent attention exactly to avoid these relayouts.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import (_NEG_INF, _dropout_mask, _pad_len, _round_up,
+                              _valid_mask)
+
+
+def pair_layout_supported(head_dim: int, num_heads: int, seq_len: int) -> bool:
+    """The gate for this path: two heads fill the 128-lane quantum, and the
+    KV length fits one tile (scores stay in VMEM)."""
+    return ((2 * head_dim) % 128 == 0 and head_dim % 8 == 0
+            and num_heads % 2 == 0 and seq_len <= 1024)
+
+
+# ------------------------------------------------------------------ forward
+
+
+def _pair_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                     sm_scale, causal, d, kv_len, block_q, kv_pad,
+                     dropout_rate, n_heads):
+    # grid (b, h2, q_blocks); refs hold TWO heads side by side [*, 2d]
+    b, h2, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    for which in (0, 1):
+        sl = slice(which * d, (which + 1) * d)
+        qs = (q_ref[:, sl].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        s = jax.lax.dot_general(qs, k_ref[:, sl], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        valid = None
+        if causal or kv_len < kv_pad:
+            valid = _valid_mask(qi, 0, causal=causal, block_q=block_q,
+                                block_k=kv_pad, kv_len=kv_len,
+                                causal_offset=0)
+            s = jnp.where(valid, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            bh = b * n_heads + 2 * h2 + which
+            keep = _dropout_mask(seed_ref, bh, qi, jnp.int32(0),
+                                 (block_q, kv_pad), dropout_rate)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        o = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[:, sl],
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        o_ref[:, sl] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[which, :] = (m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)))
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "d", "causal",
+                                             "sm_scale", "block_q",
+                                             "dropout_rate", "interpret"))
+def _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
+              dropout_rate=0.0, interpret=False):
+    b, L, width = qkv.shape
+    h2 = heads // 2
+    kv_pad = _round_up(L, 128)
+    block_q = min(block_q, kv_pad)
+    while kv_pad % block_q:      # q blocks must tile the kv row count exactly
+        block_q //= 2
+    q_pad = kv_pad
+    qkvp = _pad_len(qkv, kv_pad)
+    grid = (b, h2, q_pad // block_q)
+    # column maps into [B, L, 3HD]: q pair at 2*h2*d, k at (H + 2*h2)*d, ...
+    qs = pl.BlockSpec((None, block_q, 2 * d),
+                      lambda bb, hh, i, *_: (bb, i, hh))
+    ks = pl.BlockSpec((None, kv_pad, 2 * d),
+                      lambda bb, hh, i, *_: (bb, 0, h2 + hh))
+    vs = pl.BlockSpec((None, kv_pad, 2 * d),
+                      lambda bb, hh, i, *_: (bb, 0, 2 * h2 + hh))
+    out, lse = pl.pallas_call(
+        functools.partial(_pair_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          d=d, kv_len=L, block_q=block_q, kv_pad=kv_pad,
+                          dropout_rate=dropout_rate, n_heads=heads),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[qs, ks, vs],
+            out_specs=[
+                pl.BlockSpec((None, block_q, 2 * d),
+                             lambda bb, hh, i, *_: (bb, i, hh)),
+                pl.BlockSpec((None, None, 2, block_q),
+                             lambda bb, hh, i, *_: (bb, hh, 0, i)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv_pad, heads * d), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h2, 2, q_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(seed, qkvp, qkvp, qkvp)
+    return out[:, :L], lse
+
+
+# ------------------------------------------------------------------ backward
+
+
+def _pair_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                     sm_scale, causal, d, kv_len, block_q, kv_pad,
+                     dropout_rate, n_heads, n_q):
+    # grid (b, h2, q_blocks) with q sequential. dq/dk/dv are separate
+    # kv_pad-tall 2D-blocked outputs (Mosaic-friendly refs): dq rows land per
+    # q block via a dynamic-slice store; dk/dv accumulate in scratch and
+    # finalize at the last q step. s/p computed ONCE per (pair, q block).
+    b, h2, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    for which in (0, 1):
+        sl = slice(which * d, (which + 1) * d)
+        qs = (q_ref[:, sl].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
+        s = jax.lax.dot_general(qs, k_ref[:, sl], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        lse = lse_ref[which, :][:, None]
+        p = jnp.exp(s - lse)
+        if causal or kv_len < kv_pad:
+            valid = _valid_mask(qi, 0, causal=causal, block_q=block_q,
+                                block_k=kv_pad, kv_len=kv_len,
+                                causal_offset=0)
+            p = jnp.where(valid, p, 0.0)
+        keep_scale = None
+        if dropout_rate > 0.0:
+            bh = b * n_heads + 2 * h2 + which
+            keep = _dropout_mask(seed_ref, bh, qi, jnp.int32(0),
+                                 (block_q, kv_pad), dropout_rate)
+            keep_scale = jnp.where(keep, 1.0 / (1.0 - dropout_rate), 0.0)
+        do = do_ref[:, sl]
+        p_dv = p * keep_scale if keep_scale is not None else p
+        dv_acc[:, sl] += jax.lax.dot_general(
+            p_dv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[:, sl], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if keep_scale is not None:
+            dp = dp * keep_scale
+        ds = p * (dp - delta_ref[which, :][:, None])
+        dsc = ds.astype(q_ref.dtype)
+        dq = (jax.lax.dot_general(
+            dsc, k_ref[:, sl], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        ).astype(dq_ref.dtype)
+        dq_ref[pl.ds(qi * block_q, block_q), sl] = dq
+        dk_acc[:, sl] += jax.lax.dot_general(
+            dsc, q_ref[:, sl], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "d", "causal",
+                                             "sm_scale", "block_q",
+                                             "dropout_rate", "interpret"))
+def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
+              dropout_rate=0.0, interpret=False):
+    b, L, width = qkv.shape
+    h2 = heads // 2
+    kv_pad = _round_up(L, 128)
+    block_q = min(block_q, kv_pad)
+    while kv_pad % block_q:
+        block_q //= 2
+    q_pad = kv_pad
+    qkvp = _pad_len(qkv, kv_pad)
+    gp = _pad_len(g, kv_pad)
+    delta = jnp.sum((g.astype(jnp.float32) * o.astype(jnp.float32))
+                    .reshape(b, L, heads, d), axis=-1)       # [B, L, H]
+    delta = jnp.transpose(delta, (0, 2, 1)).reshape(b, h2, 2, L)
+    delta = _pad_len(delta, q_pad, axis=3)
+    lsep = _pad_len(lse, q_pad, axis=3)
+
+    # one kv_pad-tall output block per (b, h2) and per grad: dq rows land
+    # via pl.ds as q blocks sweep (q_pad == kv_pad by the block_q rule
+    # above), dk/dv at the final q step
+    grid = (b, h2, q_pad // block_q)
+    qs = pl.BlockSpec((None, block_q, 2 * d), lambda bb, hh, i, *_: (bb, i, hh))
+    ks = pl.BlockSpec((None, kv_pad, 2 * d),
+                      lambda bb, hh, i, *_: (bb, 0, h2 + hh))
+    vs = pl.BlockSpec((None, kv_pad, 2 * d),
+                      lambda bb, hh, i, *_: (bb, 0, 2 * h2 + hh))
+    gs = pl.BlockSpec((None, block_q, 2 * d), lambda bb, hh, i, *_: (bb, i, hh))
+    ls = pl.BlockSpec((None, None, 2, block_q),
+                      lambda bb, hh, i, *_: (bb, hh, 0, i))
+    gpart = pl.BlockSpec((None, kv_pad, 2 * d), lambda bb, hh, i, *_: (bb, 0, hh))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_pair_bwd_kernel, sm_scale=sm_scale, causal=causal,
+                          d=d, kv_len=L, block_q=block_q, kv_pad=kv_pad,
+                          dropout_rate=dropout_rate, n_heads=heads,
+                          n_q=q_pad // block_q),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[qs, ks, vs, gs, ls, ls],
+            out_specs=[gpart, gpart, gpart],
+            scratch_shapes=[pltpu.VMEM((kv_pad, 2 * d), jnp.float32),
+                            pltpu.VMEM((kv_pad, 2 * d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((b, kv_pad, heads * d), qkv.dtype)
+                   for _ in range(3)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(seed, qkvp, qkvp, qkvp, gp, lsep, delta)
+    # d(qkv) column order [q | k | v]; the concat feeds qkv_proj's backward
+    # matmul and fuses there
+    return jnp.concatenate([dq[:, :L], dk[:, :L], dv[:, :L]], axis=-1)
+
+
+# ------------------------------------------------------------------ custom_vjp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+def flash_pair(qkv, seed, heads, d, causal, sm_scale, block_q, dropout_rate,
+               interpret):
+    out, _ = _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
+                       dropout_rate, interpret)
+    return out
+
+
+def _pair_vjp_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
+                  dropout_rate, interpret):
+    out, lse = _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
+                         dropout_rate, interpret)
+    return out, (qkv, out, lse, seed)
+
+
+def _pair_vjp_bwd(heads, d, causal, sm_scale, block_q, dropout_rate,
+                  interpret, res, g):
+    qkv, out, lse, seed = res
+    dqkv = _pair_bwd(qkv, out, lse, g, seed, heads, d, causal, sm_scale,
+                     block_q, dropout_rate, interpret)
+    return dqkv, None
+
+
+flash_pair.defvjp(_pair_vjp_fwd, _pair_vjp_bwd)
+
+
+def flash_pair_packed(qkv, num_heads, causal, dropout_rate=0.0, seed=0,
+                      block_q=512, interpret=False):
+    """Keyword front door for the pair path: derives head_dim/scale/seed form
+    so call sites don't hand-assemble the 9-positional custom_vjp call."""
+    d = qkv.shape[-1] // (3 * num_heads)
+    seed_arr = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
+    return flash_pair(qkv, seed_arr, int(num_heads), int(d), bool(causal),
+                      1.0 / math.sqrt(d), int(block_q), float(dropout_rate),
+                      bool(interpret))

@@ -83,12 +83,21 @@ class BertLayer(nn.Layer):
 
     def forward(self, x, attn_mask=None, seq_lens=None):
         b, s, h = x.shape
-        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv.unbind(2)
-        attn = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, kv_lens=seq_lens,
-            dropout_p=self.attn_dropout_p if self.training else 0.0)
-        attn = self.out_proj(attn.reshape([b, s, h]))
+        qkv = self.qkv_proj(x)
+        if attn_mask is None and seq_lens is None:
+            # packed path: attention reads the projection output in place
+            # (head-pair kernels at head_dim 64 — no [B,L,H,D] relayouts)
+            attn = F.flash_attention_qkv_packed(
+                qkv, self.num_heads, causal=False,
+                dropout=self.attn_dropout_p, training=self.training)
+        else:
+            qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+            q, k, v = qkv.unbind(2)
+            attn = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, kv_lens=seq_lens,
+                dropout_p=self.attn_dropout_p if self.training else 0.0)
+            attn = attn.reshape([b, s, h])
+        attn = self.out_proj(attn)
         x = self.attn_norm(x + self.dropout(attn))
         ffn = self.fc_out(F.gelu(self.fc_in(x)))
         return self.ffn_norm(x + self.dropout(ffn))

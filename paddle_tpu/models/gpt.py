@@ -96,12 +96,20 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
         from ..kernels.pallas.flash_attention import (
             flash_attention_blhd, flash_attention_qkv_packed,
             packed_layout_supported)
+        from ..kernels.pallas.flash_pair import (flash_pair_packed,
+                                                 pair_layout_supported)
         if use_flash and packed_layout_supported(hd):
             # fused-projection kernel: no head split/merge inside the scan —
             # the output is already the [b, s, h] layout the proj matmul wants
             att = flash_attention_qkv_packed(
                 qkv, num_heads, causal=True, dropout_rate=attn_dropout,
                 seed=kd[0].astype(jnp.int32))
+        elif use_flash and pair_layout_supported(hd, num_heads, s):
+            # head_dim-64: two heads per 128-lane column block, still zero
+            # relayouts (kernels/pallas/flash_pair.py)
+            att = flash_pair_packed(qkv, num_heads, True,
+                                    dropout_rate=attn_dropout,
+                                    seed=kd[0].astype(jnp.int32))
         elif use_flash:
             q, k, v = (t.reshape(b, s, num_heads, hd)
                        for t in jnp.split(qkv, 3, axis=-1))
@@ -195,9 +203,11 @@ class GPTAttention(nn.Layer):
         b, s, h = x.shape
         drop = self.dropout_p if self.training else 0.0
         from ..kernels.pallas.flash_attention import packed_layout_supported
+        from ..kernels.pallas.flash_pair import pair_layout_supported
         from ..nn.functional.attention import flash_path_available
         if (self.use_flash and attn_mask is None
-                and packed_layout_supported(self.head_dim)
+                and (packed_layout_supported(self.head_dim)
+                     or pair_layout_supported(self.head_dim, self.num_heads, s))
                 and flash_path_available(s, self.head_dim, x)):
             # packed path: the fused projection feeds the kernel directly and
             # the context comes back [b, s, h] — no head split/merge relayout

@@ -74,7 +74,15 @@ register_op("flash_attn_pallas", _flash_attn_pallas_fwd, nondiff_inputs=(3,))
 def _flash_attn_packed_fwd(qkv, *rest, num_heads, causal=True,
                            dropout_rate=0.0):
     from ...kernels.pallas.flash_attention import flash_attention_qkv_packed
+    from ...kernels.pallas.flash_pair import (flash_pair_packed,
+                                              pair_layout_supported)
     seed = rest[0] if rest else 0
+    d = qkv.shape[-1] // (3 * num_heads)
+    if d % 128 != 0 and pair_layout_supported(d, num_heads, qkv.shape[1]):
+        # head_dim-64 fast path: two heads per 128-lane column block, zero
+        # relayouts (kernels/pallas/flash_pair.py)
+        return flash_pair_packed(qkv, num_heads, causal,
+                                 dropout_rate=dropout_rate, seed=seed)
     return flash_attention_qkv_packed(qkv, num_heads, causal=causal,
                                       dropout_rate=dropout_rate, seed=seed)
 
@@ -134,8 +142,10 @@ def flash_attention_qkv_packed(qkv, num_heads, dropout=0.0, causal=True,
     shape = qkv.shape
     d = shape[-1] // (3 * num_heads)
     from ...kernels.pallas.flash_attention import packed_layout_supported
+    from ...kernels.pallas.flash_pair import pair_layout_supported
     if not (flash_path_available(shape[1], d, qkv)
-            and packed_layout_supported(d)):
+            and (packed_layout_supported(d)
+                 or pair_layout_supported(d, num_heads, shape[1]))):
         b, L = shape[0], shape[1]
         unwrap = qkv.value() if hasattr(qkv, "value") else qkv
         q, k, v = (Tensor(unwrap[:, :, i * num_heads * d:(i + 1) * num_heads * d]

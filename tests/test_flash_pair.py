@@ -1,0 +1,127 @@
+"""Head-pair packed flash attention (kernels/pallas/flash_pair.py) vs an
+fp32 oracle — fwd and fused dqkv backward, causal and bidirectional,
+interpret mode (runs on CPU)."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels.pallas.flash_pair import flash_pair, \
+    pair_layout_supported
+
+
+def _oracle(qkv, heads, d, causal):
+    b, L, _ = qkv.shape
+    q, k, v = (qkv[:, :, i * heads * d:(i + 1) * heads * d]
+               .reshape(b, L, heads, d).transpose(0, 2, 1, 3)
+               for i in range(3))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.transpose(0, 2, 1, 3).reshape(b, L, heads * d)
+
+
+def _rand_qkv(b, L, heads, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return jnp.asarray(rs.randn(b, L, 3 * heads * d) * 0.5, jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("L", [256, 384, 512])
+def test_pair_forward(causal, L):
+    b, heads, d = 2, 4, 64
+    qkv = _rand_qkv(b, L, heads, d)
+    seed = jnp.asarray([0], jnp.int32)
+    out = flash_pair(qkv, seed, heads, d, causal, 1.0 / math.sqrt(d),
+                     256, 0.0, True)
+    ref = _oracle(qkv, heads, d, causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pair_backward_dqkv(causal):
+    b, L, heads, d = 2, 256, 4, 64
+    qkv = _rand_qkv(b, L, heads, d, seed=1)
+    seed = jnp.asarray([0], jnp.int32)
+
+    def f_pair(x):
+        return (flash_pair(x, seed, heads, d, causal, 1.0 / math.sqrt(d),
+                           128, 0.0, True) ** 2).sum()
+
+    def f_ref(x):
+        return (_oracle(x, heads, d, causal) ** 2).sum()
+
+    g_pair = jax.grad(f_pair)(qkv)
+    g_ref = jax.grad(f_ref)(qkv)
+    np.testing.assert_allclose(np.asarray(g_pair), np.asarray(g_ref),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_pair_gate():
+    assert pair_layout_supported(64, 12, 512)
+    assert pair_layout_supported(64, 16, 1024)
+    assert not pair_layout_supported(64, 12, 2048)   # kv beyond one tile
+    assert not pair_layout_supported(64, 13, 512)    # odd heads
+    assert not pair_layout_supported(80, 12, 512)    # 2d not lane-aligned
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _on_tpu(),
+                    reason="in-kernel hardware PRNG needs a real TPU")
+def test_pair_dropout_fwd_bwd_mask_consistent():
+    """The fused backward must regenerate the SAME dropout mask as the
+    forward: check analytic grads against finite differences of the seeded
+    kernel itself (a fwd/bwd mask desync fails this immediately)."""
+    b, L, heads, d = 1, 256, 2, 64
+    qkv = _rand_qkv(b, L, heads, d, seed=3)
+    seed = jnp.asarray([5], jnp.int32)
+
+    def loss(x):
+        o = flash_pair(x, seed, heads, d, False, 1.0 / math.sqrt(d),
+                       128, 0.3, False)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    # determinism per seed
+    l1, l2 = float(loss(qkv)), float(loss(qkv))
+    assert l1 == l2
+    g = jax.grad(loss)(qkv)
+    rs = np.random.RandomState(0)
+    # tolerance: TPU fp32 matmuls ride bf16 passes, so directional finite
+    # differences carry a measured ~3-6% noise floor EVEN AT dropout=0 (where
+    # interpret-mode tests prove grads exact); a fwd/bwd mask desync would
+    # decorrelate the masks and show O(1) relative error — 15% separates the
+    # two regimes decisively
+    for _ in range(3):
+        v = jnp.asarray(rs.randn(*qkv.shape).astype(np.float32))
+        eps = 1e-2
+        fd = (float(loss(qkv + eps * v)) - float(loss(qkv - eps * v))) / (2 * eps)
+        an = float(jnp.vdot(g, v))
+        assert abs(fd - an) <= 0.15 * max(abs(fd), abs(an), 1.0), (fd, an)
+
+
+def test_functional_routes_pair_path():
+    # the packed functional takes the pair path for d=64 (no crash; numerics
+    # against the oracle in fp32/interpret are covered above — here we check
+    # the plumbing end-to-end through the dispatcher on CPU fallback rules)
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    b, L, heads, d = 2, 256, 4, 64
+    qkv = paddle.to_tensor(np.random.RandomState(2)
+                           .randn(b, L, 3 * heads * d).astype("float32"))
+    out = F.flash_attention_qkv_packed(qkv, heads, causal=True,
+                                       training=False)
+    # CPU: flash_path_available is False -> sdpa fallback; just verify shape
+    assert list(out.shape) == [b, L, heads * d]
