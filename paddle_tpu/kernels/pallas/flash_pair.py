@@ -8,11 +8,14 @@ reads 128-wide column blocks straight out of the fused projection output
 [B, L, 3*H*D] — TWO adjacent 64-wide heads per block — and writes the
 context back pre-packed [B, L, H*D]. Zero layout copies, full lanes.
 
-Shape contract: head_dim*2 % 128 == 0, heads even, and the whole KV length
-in ONE tile (L_pad == block_k; VMEM bounds this to L <= ~1024). Within that
-contract the backward is the fused single-tile form (s/p computed once for
-dq, dk AND dv — see _flash_bwd_fused_kernel's rationale) writing d(qkv)
-directly in the packed layout.
+Shape contract: head-BLOCKS of hpb = max(1, 128 // head_dim) adjacent heads
+fill the 128-lane quantum (hpb*d % 128 == 0; hpb=2 at d=64, hpb=1 at d=128),
+num_heads % hpb == 0, and the whole KV length in ONE tile (L_pad == block_k;
+VMEM bounds this to L <= ~1024). Within that contract the backward is the
+fused single-tile form (s/p computed once for dq, dk AND dv — see
+_flash_bwd_fused_kernel's rationale) writing d(qkv) parts directly in the
+packed layout — so d=128 decoders get the fused backward through this path
+too.
 
 Reference analog: phi/kernels/fusion/fused_attention — the reference fuses
 qkv-projection-adjacent attention exactly to avoid these relayouts.
@@ -31,11 +34,18 @@ from .flash_attention import (_NEG_INF, _dropout_mask, _pad_len, _round_up,
                               _valid_mask)
 
 
+def _heads_per_block(head_dim: int) -> int:
+    """How many adjacent heads fill the 128-lane quantum (2 at d=64, 1 at
+    d>=128-multiples)."""
+    return max(1, 128 // head_dim)
+
+
 def pair_layout_supported(head_dim: int, num_heads: int, seq_len: int) -> bool:
-    """The gate for this path: two heads fill the 128-lane quantum, and the
-    KV length fits one tile (scores stay in VMEM)."""
-    return ((2 * head_dim) % 128 == 0 and head_dim % 8 == 0
-            and num_heads % 2 == 0 and seq_len <= 1024)
+    """The gate for this path: whole head-blocks fill the 128-lane quantum,
+    and the KV length fits one tile (scores stay in VMEM)."""
+    hpb = _heads_per_block(head_dim)
+    return ((hpb * head_dim) % 128 == 0 and head_dim % 8 == 0
+            and num_heads % hpb == 0 and seq_len <= 1024)
 
 
 # ------------------------------------------------------------------ forward
@@ -43,10 +53,10 @@ def pair_layout_supported(head_dim: int, num_heads: int, seq_len: int) -> bool:
 
 def _pair_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                      sm_scale, causal, d, kv_len, block_q, kv_pad,
-                     dropout_rate, n_heads):
-    # grid (b, h2, q_blocks); refs hold TWO heads side by side [*, 2d]
+                     dropout_rate, n_heads, hpb):
+    # grid (b, head_block, q_blocks); refs hold hpb heads side by side [*, hpb*d]
     b, h2, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    for which in (0, 1):
+    for which in range(hpb):
         sl = slice(which * d, (which + 1) * d)
         qs = (q_ref[:, sl].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
         s = jax.lax.dot_general(qs, k_ref[:, sl], (((1,), (1,)), ((), ())),
@@ -63,7 +73,7 @@ def _pair_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             p = jnp.where(valid, p, 0.0)
         l = jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
-            bh = b * n_heads + 2 * h2 + which
+            bh = b * n_heads + hpb * h2 + which
             keep = _dropout_mask(seed_ref, bh, qi, jnp.int32(0),
                                  (block_q, kv_pad), dropout_rate)
             p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
@@ -80,7 +90,8 @@ def _pair_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 def _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
               dropout_rate=0.0, interpret=False):
     b, L, width = qkv.shape
-    h2 = heads // 2
+    hpb = _heads_per_block(d)
+    h2 = heads // hpb
     kv_pad = _round_up(L, 128)
     block_q = min(block_q, kv_pad)
     while kv_pad % block_q:      # q blocks must tile the kv row count exactly
@@ -88,31 +99,31 @@ def _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
     q_pad = kv_pad
     qkvp = _pad_len(qkv, kv_pad)
     grid = (b, h2, q_pad // block_q)
-    # column maps into [B, L, 3HD]: q pair at 2*h2*d, k at (H + 2*h2)*d, ...
-    qs = pl.BlockSpec((None, block_q, 2 * d),
+    # column maps into [B, L, 3HD]: q block at hpb*h2*d, k at (H + hpb*h2)*d
+    qs = pl.BlockSpec((None, block_q, hpb * d),
                       lambda bb, hh, i, *_: (bb, i, hh))
-    ks = pl.BlockSpec((None, kv_pad, 2 * d),
+    ks = pl.BlockSpec((None, kv_pad, hpb * d),
                       lambda bb, hh, i, *_: (bb, 0, h2 + hh))
-    vs = pl.BlockSpec((None, kv_pad, 2 * d),
+    vs = pl.BlockSpec((None, kv_pad, hpb * d),
                       lambda bb, hh, i, *_: (bb, 0, 2 * h2 + hh))
     out, lse = pl.pallas_call(
         functools.partial(_pair_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           d=d, kv_len=L, block_q=block_q, kv_pad=kv_pad,
-                          dropout_rate=dropout_rate, n_heads=heads),
+                          dropout_rate=dropout_rate, n_heads=heads, hpb=hpb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[qs, ks, vs],
             out_specs=[
-                pl.BlockSpec((None, block_q, 2 * d),
+                pl.BlockSpec((None, block_q, hpb * d),
                              lambda bb, hh, i, *_: (bb, i, hh)),
-                pl.BlockSpec((None, None, 2, block_q),
+                pl.BlockSpec((None, None, hpb, block_q),
                              lambda bb, hh, i, *_: (bb, hh, 0, i)),
             ],
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b, kv_pad, heads * d), qkv.dtype),
-            jax.ShapeDtypeStruct((b, h2, 2, q_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, h2, hpb, q_pad), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
@@ -127,7 +138,7 @@ def _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
 def _pair_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                      delta_ref, dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                      sm_scale, causal, d, kv_len, block_q, kv_pad,
-                     dropout_rate, n_heads, n_q):
+                     dropout_rate, n_heads, n_q, hpb):
     # grid (b, h2, q_blocks) with q sequential. dq/dk/dv are separate
     # kv_pad-tall 2D-blocked outputs (Mosaic-friendly refs): dq rows land per
     # q block via a dynamic-slice store; dk/dv accumulate in scratch and
@@ -139,7 +150,7 @@ def _pair_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    for which in (0, 1):
+    for which in range(hpb):
         sl = slice(which * d, (which + 1) * d)
         qs = (q_ref[:, sl].astype(jnp.float32) * sm_scale).astype(q_ref.dtype)
         s = jax.lax.dot_general(qs, k_ref[:, sl], (((1,), (1,)), ((), ())),
@@ -153,7 +164,7 @@ def _pair_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             p = jnp.where(valid, p, 0.0)
         keep_scale = None
         if dropout_rate > 0.0:
-            bh = b * n_heads + 2 * h2 + which
+            bh = b * n_heads + hpb * h2 + which
             keep = _dropout_mask(seed_ref, bh, qi, jnp.int32(0),
                                  (block_q, kv_pad), dropout_rate)
             keep_scale = jnp.where(keep, 1.0 / (1.0 - dropout_rate), 0.0)
@@ -189,7 +200,8 @@ def _pair_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
               dropout_rate=0.0, interpret=False):
     b, L, width = qkv.shape
-    h2 = heads // 2
+    hpb = _heads_per_block(d)
+    h2 = heads // hpb
     kv_pad = _round_up(L, 128)
     block_q = min(block_q, kv_pad)
     while kv_pad % block_q:
@@ -199,7 +211,7 @@ def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
     gp = _pad_len(g, kv_pad)
     delta = jnp.sum((g.astype(jnp.float32) * o.astype(jnp.float32))
                     .reshape(b, L, heads, d), axis=-1)       # [B, L, H]
-    delta = jnp.transpose(delta, (0, 2, 1)).reshape(b, h2, 2, L)
+    delta = jnp.transpose(delta, (0, 2, 1)).reshape(b, h2, hpb, L)
     delta = _pad_len(delta, q_pad, axis=3)
     lsep = _pad_len(lse, q_pad, axis=3)
 
@@ -207,27 +219,30 @@ def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
     # via pl.ds as q blocks sweep (q_pad == kv_pad by the block_q rule
     # above), dk/dv at the final q step
     grid = (b, h2, q_pad // block_q)
-    qs = pl.BlockSpec((None, block_q, 2 * d), lambda bb, hh, i, *_: (bb, i, hh))
-    ks = pl.BlockSpec((None, kv_pad, 2 * d),
+    qs = pl.BlockSpec((None, block_q, hpb * d),
+                      lambda bb, hh, i, *_: (bb, i, hh))
+    ks = pl.BlockSpec((None, kv_pad, hpb * d),
                       lambda bb, hh, i, *_: (bb, 0, h2 + hh))
-    vs = pl.BlockSpec((None, kv_pad, 2 * d),
+    vs = pl.BlockSpec((None, kv_pad, hpb * d),
                       lambda bb, hh, i, *_: (bb, 0, 2 * h2 + hh))
-    gs = pl.BlockSpec((None, block_q, 2 * d), lambda bb, hh, i, *_: (bb, i, hh))
-    ls = pl.BlockSpec((None, None, 2, block_q),
+    gs = pl.BlockSpec((None, block_q, hpb * d),
+                      lambda bb, hh, i, *_: (bb, i, hh))
+    ls = pl.BlockSpec((None, None, hpb, block_q),
                       lambda bb, hh, i, *_: (bb, hh, 0, i))
-    gpart = pl.BlockSpec((None, kv_pad, 2 * d), lambda bb, hh, i, *_: (bb, 0, hh))
+    gpart = pl.BlockSpec((None, kv_pad, hpb * d),
+                         lambda bb, hh, i, *_: (bb, 0, hh))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_pair_bwd_kernel, sm_scale=sm_scale, causal=causal,
                           d=d, kv_len=L, block_q=block_q, kv_pad=kv_pad,
                           dropout_rate=dropout_rate, n_heads=heads,
-                          n_q=q_pad // block_q),
+                          n_q=q_pad // block_q, hpb=hpb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[qs, ks, vs, gs, ls, ls],
             out_specs=[gpart, gpart, gpart],
-            scratch_shapes=[pltpu.VMEM((kv_pad, 2 * d), jnp.float32),
-                            pltpu.VMEM((kv_pad, 2 * d), jnp.float32)],
+            scratch_shapes=[pltpu.VMEM((kv_pad, hpb * d), jnp.float32),
+                            pltpu.VMEM((kv_pad, hpb * d), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct((b, kv_pad, heads * d), qkv.dtype)
                    for _ in range(3)],
@@ -274,6 +289,14 @@ def flash_pair_packed(qkv, num_heads, causal, dropout_rate=0.0, seed=0,
     """Keyword front door for the pair path: derives head_dim/scale/seed form
     so call sites don't hand-assemble the 9-positional custom_vjp call."""
     d = qkv.shape[-1] // (3 * num_heads)
+    if not pair_layout_supported(d, num_heads, qkv.shape[1]):
+        # fail fast: a truncating heads // hpb would leave trailing heads'
+        # output columns unwritten (silent NaN/garbage)
+        raise ValueError(
+            f"flash_pair: unsupported shape (head_dim={d}, "
+            f"num_heads={num_heads}, L={qkv.shape[1]}); requires "
+            f"num_heads % max(1, 128 // head_dim) == 0, hpb*d % 128 == 0, "
+            f"and L <= 1024 — use flash_attention_blhd/packed instead")
     seed_arr = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
     return flash_pair(qkv, seed_arr, int(num_heads), int(d), bool(causal),
                       1.0 / math.sqrt(d), int(block_q), float(dropout_rate),

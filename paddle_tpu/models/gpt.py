@@ -98,18 +98,18 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
             packed_layout_supported)
         from ..kernels.pallas.flash_pair import (flash_pair_packed,
                                                  pair_layout_supported)
-        if use_flash and packed_layout_supported(hd):
-            # fused-projection kernel: no head split/merge inside the scan —
-            # the output is already the [b, s, h] layout the proj matmul wants
-            att = flash_attention_qkv_packed(
-                qkv, num_heads, causal=True, dropout_rate=attn_dropout,
-                seed=kd[0].astype(jnp.int32))
-        elif use_flash and pair_layout_supported(hd, num_heads, s):
-            # head_dim-64: two heads per 128-lane column block, still zero
-            # relayouts (kernels/pallas/flash_pair.py)
+        if use_flash and pair_layout_supported(hd, num_heads, s):
+            # single-tile head-block kernels: zero relayouts + fused
+            # single-pass dqkv backward (kernels/pallas/flash_pair.py)
             att = flash_pair_packed(qkv, num_heads, True,
                                     dropout_rate=attn_dropout,
                                     seed=kd[0].astype(jnp.int32))
+        elif use_flash and packed_layout_supported(hd):
+            # fused-projection kernel for longer sequences: no head
+            # split/merge inside the scan
+            att = flash_attention_qkv_packed(
+                qkv, num_heads, causal=True, dropout_rate=attn_dropout,
+                seed=kd[0].astype(jnp.int32))
         elif use_flash:
             q, k, v = (t.reshape(b, s, num_heads, hd)
                        for t in jnp.split(qkv, 3, axis=-1))

@@ -78,9 +78,9 @@ def _flash_attn_packed_fwd(qkv, *rest, num_heads, causal=True,
                                               pair_layout_supported)
     seed = rest[0] if rest else 0
     d = qkv.shape[-1] // (3 * num_heads)
-    if d % 128 != 0 and pair_layout_supported(d, num_heads, qkv.shape[1]):
-        # head_dim-64 fast path: two heads per 128-lane column block, zero
-        # relayouts (kernels/pallas/flash_pair.py)
+    if pair_layout_supported(d, num_heads, qkv.shape[1]):
+        # single-tile fast path (head-blocks fill the 128-lane quantum;
+        # fused single-pass dqkv backward) — kernels/pallas/flash_pair.py
         return flash_pair_packed(qkv, num_heads, causal,
                                  dropout_rate=dropout_rate, seed=seed)
     return flash_attention_qkv_packed(qkv, num_heads, causal=causal,

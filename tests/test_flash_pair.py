@@ -45,9 +45,10 @@ def test_pair_forward(causal, L):
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("d", [64, 128])
 @pytest.mark.parametrize("causal", [False, True])
-def test_pair_backward_dqkv(causal):
-    b, L, heads, d = 2, 256, 4, 64
+def test_pair_backward_dqkv(causal, d):
+    b, L, heads = 2, 256, 4
     qkv = _rand_qkv(b, L, heads, d, seed=1)
     seed = jnp.asarray([0], jnp.int32)
 
@@ -67,9 +68,10 @@ def test_pair_backward_dqkv(causal):
 def test_pair_gate():
     assert pair_layout_supported(64, 12, 512)
     assert pair_layout_supported(64, 16, 1024)
+    assert pair_layout_supported(128, 8, 1024)       # hpb=1 (fused-bwd form)
     assert not pair_layout_supported(64, 12, 2048)   # kv beyond one tile
     assert not pair_layout_supported(64, 13, 512)    # odd heads
-    assert not pair_layout_supported(80, 12, 512)    # 2d not lane-aligned
+    assert not pair_layout_supported(80, 12, 512)    # block not lane-aligned
 
 
 def _on_tpu():
