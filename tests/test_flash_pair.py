@@ -61,8 +61,11 @@ def test_pair_backward_dqkv(causal, d):
 
     g_pair = jax.grad(f_pair)(qkv)
     g_ref = jax.grad(f_ref)(qkv)
+    # tolerance covers BOTH interpret mode (exact fp32) and real-TPU runs via
+    # tools/run_tpu_tests.sh, where fp32 matmuls ride bf16 MXU passes
+    # (measured max grad diff ~0.01 at these shapes); real bugs are O(1)
     np.testing.assert_allclose(np.asarray(g_pair), np.asarray(g_ref),
-                               rtol=5e-3, atol=5e-3)
+                               rtol=1e-2, atol=2e-2)
 
 
 def test_pair_gate():

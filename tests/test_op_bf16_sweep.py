@@ -94,16 +94,19 @@ def test_forward_low_precision(s, dtype, request):
     if ref_np.dtype == bool or out_np.dtype == bool:
         return
     # fp16 has a narrow exponent: ops whose intermediates exceed ~65k
-    # legitimately overflow where bf16 (fp32-range) does not — only gate
-    # finiteness where the fp32 ORACLE is modest
-    finite_ok = np.isfinite(ref_np) & (np.abs(ref_np) < 1e4)
-    assert np.isfinite(out_np[finite_ok]).all(), \
-        f"{sid}: non-finite {dtype} output where fp32 is finite and small"
+    # legitimately overflow where bf16 (fp32-range) does NOT — the exclusion
+    # applies to fp16 only; bf16 keeps full finiteness/accuracy coverage
+    if dtype == "float16":
+        sel = np.isfinite(ref_np) & (np.abs(ref_np) < 1e4)
+    else:
+        sel = np.isfinite(ref_np)
+    assert np.isfinite(out_np[sel]).all(), \
+        f"{sid}: non-finite {dtype} output where fp32 is finite"
     # bf16: ~2-3 significant digits (wide range); fp16: ~3 digits (narrow
-    # range) — scale-aware tolerance either way
-    scale = max(1.0, float(np.max(np.abs(ref_np))) if ref_np.size else 1.0)
+    # range) — tolerance scaled by the values actually COMPARED (scaling by
+    # an excluded outlier would make the comparison vacuous)
+    scale = max(1.0, float(np.max(np.abs(ref_np[sel]))) if sel.any() else 1.0)
     rtol = 0.09 if dtype == "bfloat16" else 0.02
-    sel = finite_ok
     np.testing.assert_allclose(out_np[sel], ref_np[sel], rtol=rtol,
                                atol=0.05 * scale,
                                err_msg=f"{sid}: {dtype} vs fp32 diverged")

@@ -1,0 +1,19 @@
+#!/bin/sh
+# Pallas-kernel tests on the REAL TPU chip.
+#
+# The main suite conftest (tests/conftest.py) pins jax to a virtual 8-device
+# CPU mesh, so kernel tests needing Mosaic/the hardware PRNG skip there.
+# This runner stages copies OUTSIDE the conftest's directory and runs them
+# with the repo root as cwd (the axon plugin resolves the TPU only from
+# there). The chip is exclusive — stop other TPU processes first.
+set -e
+cd "$(dirname "$0")/.."
+STAGE=$(mktemp -d /tmp/paddle_tpu_tputests.XXXXXX)
+trap 'rm -rf "$STAGE"' EXIT
+cp tests/test_flash_tpu.py tests/test_dropout_pallas.py \
+   tests/test_flash_pair.py "$STAGE"/
+# NB: APPEND to PYTHONPATH — the login env carries /root/.axon_site, whose
+# sitecustomize configures the axon TPU plugin; overwriting it silently
+# drops the chip and every TPU-gated test skips
+env -u JAX_PLATFORMS PYTHONPATH="$PWD:$PYTHONPATH" python -m pytest \
+    "$STAGE" -q -p no:cacheprovider "$@"
