@@ -9,6 +9,7 @@ executable produced by `jit(vjp(fwd))` rather than a generated CUDA grad kernel.
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -131,20 +132,41 @@ class GradNode:
         return f"GradNode({self.name})"
 
 
-import functools
+_FILL_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_FILL_CACHE_BYTES = 0
+_FILL_CACHE_BUDGET = 64 << 20  # total pinned HBM for seed constants
+_FILL_CACHE_LOCK = threading.Lock()
 
 
-@functools.lru_cache(maxsize=4096)
 def _cached_fill_small(shape, dt, v):
-    return jnp.full(shape, v, dt)
+    global _FILL_CACHE_BYTES
+    key = (shape, dt, v)
+    with _FILL_CACHE_LOCK:
+        arr = _FILL_CACHE.get(key)
+        if arr is not None:
+            _FILL_CACHE.move_to_end(key)
+            return arr
+    arr = jnp.full(shape, v, dt)
+    with _FILL_CACHE_LOCK:
+        if key not in _FILL_CACHE:
+            # account by arr.nbytes on BOTH insert and evict: under x64
+            # disabled, jnp.full canonicalizes 64-bit requests down to 32-bit
+            # and the requested-dtype size would drift the counter upward
+            _FILL_CACHE[key] = arr
+            _FILL_CACHE_BYTES += arr.nbytes
+            while _FILL_CACHE_BYTES > _FILL_CACHE_BUDGET and _FILL_CACHE:
+                _, old = _FILL_CACHE.popitem(last=False)
+                _FILL_CACHE_BYTES -= old.nbytes
+    return arr
 
 
 def _cached_fill(shape, dt, v):
     # zero/one cotangent seeds are immutable constants; through a remote PJRT
     # tunnel each uncached jnp.zeros is a ~0.3ms device op and the backward
     # walk seeds one per unused output slot (e.g. BN's mean/var outputs).
-    # Only SMALL seeds are cached — caching activation-sized buffers would pin
-    # arbitrary HBM for the process lifetime under shape-diverse workloads.
+    # Only SMALL seeds are cached, and the cache is byte-budgeted (LRU
+    # eviction at 64 MiB total) — an entry-count bound alone would let a
+    # shape-diverse workload pin GiBs of constants for the process lifetime.
     n = dt.itemsize
     for s in shape:
         n *= s

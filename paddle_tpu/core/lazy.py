@@ -120,7 +120,10 @@ class LazyGraph:
                 if la is not None:
                     out_slots.append((ni, pos))
                     targets.append(la)
-        leaf_avals = tuple((a.shape, a.dtype) for a in self.leaves)
+        leaf_avals = tuple(
+            (a.shape, a.dtype, bool(getattr(a, "weak_type", False)))
+            for a in self.leaves
+        )
         sig = (tuple(n.sig for n in self.nodes), leaf_avals, tuple(out_slots))
         exe = _EXEC_CACHE.get(sig)
         if exe is None:

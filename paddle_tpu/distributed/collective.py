@@ -135,37 +135,91 @@ class _MPBackend:
                 self._mesh_cache = None
         return self._mesh_cache
 
+    def _dev_path_agreed(self):
+        """Decide ONCE, collectively, whether the device fast path is usable.
+        Each rank probes a tiny device all-reduce locally, then the ranks
+        all-gather the success flags over the host path and enable the device
+        path only if EVERY rank succeeded — a per-rank sticky fallback would
+        let ranks diverge (some jitted-collective, some host-allgather) and
+        deadlock the job with no diagnostic."""
+        agreed = self.__dict__.get("_dev_agreed")
+        if agreed is not None:
+            return agreed
+        import os
+        import numpy as np
+        # Two-round agreement, every round a HOST-path collective so the
+        # global collective order is identical on all ranks regardless of
+        # per-rank env/config drift:
+        #   round 1: vote "willing to probe" (env var unset AND 1-D global
+        #            mesh constructible — both are rank-local conditions).
+        #            Only if EVERY rank is willing does anyone run the probe;
+        #            a conditional probe would strand willing ranks inside
+        #            the probe psum while a disabled rank skips past it.
+        #   round 2: run the probe (a cross-process device psum) on all
+        #            ranks, vote on its success.
+        willing = (not os.environ.get("PADDLE_DISABLE_DEV_COLLECTIVE")
+                   and self._mesh() is not None)
+        flags = self.allgather_np(np.array([1 if willing else 0], np.int32))
+        if flags.min() != 1:
+            self._dev_agreed = False
+            return False
+        ok = False
+        try:
+            # Hazard note: if one rank dies between the willing vote and
+            # joining the probe psum while peers are already inside it, the
+            # job blocks on the backend's collective timeout — the probe is
+            # one [1]-f32 psum to shrink that window. An all-ranks failure
+            # (runtime without cross-process device collectives) raises on
+            # every rank symmetrically and falls through to round 2.
+            probe = self._dev_run(("probe",), np.zeros((1,), np.float32),
+                                  lambda x: jax.lax.psum(x[0], "r")[None])
+            ok = probe is not None
+        except Exception:
+            ok = False
+        flags = self.allgather_np(np.array([1 if ok else 0], np.int32))
+        self._dev_agreed = bool(flags.min() == 1)
+        return self._dev_agreed
+
     def _dev_collective(self, kind, local, body):
         """Shared device-collective machinery: assemble the global [world,...]
         array from the local shard, run the cached jitted shard_map `body`,
-        return this rank's output shard. Returns None when unavailable —
-        and remembers a failure (nulls the mesh) so a runtime without
-        cross-process device collectives doesn't pay device_put + a raised
-        exception on EVERY eager collective call."""
+        return this rank's output shard. Returns None when the collectively
+        agreed decision (see _dev_path_agreed) is that the path is
+        unavailable. A failure AFTER agreement raises loudly — silently
+        falling back on one rank while others run the device collective
+        would deadlock the job."""
+        if not self._dev_path_agreed():
+            return None
+        try:
+            return self._dev_run(kind, local, body)
+        except Exception as e:
+            raise RuntimeError(
+                "device-collective fast path failed after all ranks agreed "
+                f"to use it (rank {self.rank}, kind={kind!r}): {e!r}. "
+                "Set PADDLE_DISABLE_DEV_COLLECTIVE=1 to force the host path "
+                "on ALL ranks.") from e
+
+    def _dev_run(self, kind, local, body):
         mesh = self._mesh()
         if mesh is None:
             return None
-        try:
-            import jax.numpy as _jnp
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            from jax.experimental.shard_map import shard_map
-            local = _jnp.asarray(local)
-            sh = NamedSharding(mesh, P("r"))
-            garr = jax.make_array_from_single_device_arrays(
-                (self.world,) + tuple(local.shape), sh,
-                [jax.device_put(local[None], jax.local_devices()[0])])
-            key = (kind, tuple(local.shape), str(local.dtype))
-            fns = self.__dict__.setdefault("_dev_fns", {})
-            fn = fns.get(key)
-            if fn is None:
-                fn = jax.jit(shard_map(body, mesh=mesh,
-                                       in_specs=P("r"), out_specs=P("r")))
-                fns[key] = fn
-            out = fn(garr)
-            return out.addressable_shards[0].data[0]
-        except Exception:
-            self._mesh_cache = None  # sticky: don't retry per call
-            return None
+        import jax.numpy as _jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        local = _jnp.asarray(local)
+        sh = NamedSharding(mesh, P("r"))
+        garr = jax.make_array_from_single_device_arrays(
+            (self.world,) + tuple(local.shape), sh,
+            [jax.device_put(local[None], jax.local_devices()[0])])
+        key = (kind, tuple(local.shape), str(local.dtype))
+        fns = self.__dict__.setdefault("_dev_fns", {})
+        fn = fns.get(key)
+        if fn is None:
+            fn = jax.jit(shard_map(body, mesh=mesh,
+                                   in_specs=P("r"), out_specs=P("r")))
+            fns[key] = fn
+        out = fn(garr)
+        return out.addressable_shards[0].data[0]
 
     def allreduce_dev(self, local, op):
         """Device-side all-reduce of each rank's local array; returns the

@@ -110,7 +110,9 @@ func (pr *Predictor) GetOutputNames() []string {
 
 // GetInputHandle returns the named input tensor handle.
 func (pr *Predictor) GetInputHandle(name string) *Tensor {
-	return &Tensor{pred: pr, name: name, isInput: true}
+	// outIdx -1: calling CopyToCpu/Dtype on an input handle must error, not
+	// silently serve output 0.
+	return &Tensor{pred: pr, name: name, isInput: true, outIdx: -1}
 }
 
 // GetOutputHandle returns the named output tensor handle; an unknown name
