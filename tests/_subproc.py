@@ -1,0 +1,63 @@
+"""Load-tolerant subprocess harness for multi-process tests.
+
+Policy: a fully loaded host (whole suite + parallel TPU benches) can starve a
+subprocess group's cold jax imports past any fixed timeout, while the same
+group passes in seconds when run in isolation. A genuine product bug fails
+twice; a load flake passes on retry. So every subprocess group test launches
+through run_group(), which retries the WHOLE group once on timeout or nonzero
+exit — with freshly constructed commands (new ports) each attempt.
+"""
+import subprocess
+
+
+def run_group(make_argvs, timeout=420, retries=1, env=None, cwd=None):
+    """Launch a group of processes and wait for all.
+
+    make_argvs: callable returning a list of argv lists — called per attempt
+    so rendezvous ports/dirs can be fresh on retry.
+    Returns (returncodes, outputs). Retries the whole group once on timeout
+    or any nonzero exit; the final attempt's result is returned either way.
+    """
+    last = None
+    for attempt in range(retries + 1):
+        procs = [subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  env=env, cwd=cwd)
+                 for argv in make_argvs()]
+        try:
+            outs = [p.communicate(timeout=timeout)[0] or "" for p in procs]
+            rcs = [p.returncode for p in procs]
+        except subprocess.TimeoutExpired:
+            # only blame procs that actually hung: finished ones keep their
+            # real returncode/output so the failure message shows the hung
+            # rank's diagnostics, not the healthy rank's
+            hung = [p.poll() is None for p in procs]
+            for p, h in zip(procs, hung):
+                if h:
+                    p.kill()
+            outs = [(p.communicate()[0] or "")
+                    + ("\n<GROUP TIMEOUT: this proc hung>" if h else "")
+                    for p, h in zip(procs, hung)]
+            rcs = [-1 if h else p.returncode
+                   for p, h in zip(procs, hung)]
+        last = (rcs, outs)
+        if all(rc == 0 for rc in rcs):
+            return last
+    return last
+
+
+def retry_run(run_once, retries=1, ok=None):
+    """Call run_once() (a subprocess.run-style closure) and retry once if the
+    result fails `ok` (default: returncode == 0) or times out."""
+    ok = ok or (lambda r: r.returncode == 0)
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            last = run_once()
+        except subprocess.TimeoutExpired:
+            if attempt < retries:
+                continue
+            raise
+        if ok(last):
+            return last
+    return last

@@ -27,19 +27,29 @@ def _read_events(outdir):
 
 
 def test_elastic_scale_in_resumes_training(tmp_path):
-    out = tmp_path / "out"
-    out.mkdir()
+    from _subproc import retry_run
+
     env = {k: v for k, v in os.environ.items() if not k.startswith("PADDLE_")}
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", "3", "--elastic_level", "1", "--min_np", "2",
-         "--max_restart", "3", "--log_dir", str(tmp_path / "logs"),
-         WORKER, str(out), "6", "3"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    dirs = []
+
+    def run_once():
+        # fresh out/log dirs per attempt so a retry never reads stale events
+        out = tmp_path / f"out{len(dirs)}"
+        logdir = tmp_path / f"logs{len(dirs)}"
+        out.mkdir()
+        dirs.append((out, logdir))
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "3", "--elastic_level", "1", "--min_np", "2",
+             "--max_restart", "3", "--log_dir", str(logdir),
+             WORKER, str(out), "6", "3"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+
+    proc = retry_run(run_once)
+    out, logdir = dirs[-1]
     logs = ""
-    logdir = tmp_path / "logs"
     if logdir.exists():
         for f in sorted(logdir.iterdir()):
             if f.is_file():

@@ -197,14 +197,18 @@ def test_cross_rank_bus_two_processes(tmp_path):
             s.bind(("", 0))
             return s.getsockname()[1]
 
+    from _subproc import run_group
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    prog = _RANK_PROG.format(repo=repo, port0=free_port(), port1=free_port())
-    procs = [subprocess.Popen([sys.executable, "-c", prog, str(r)],
-                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                              text=True) for r in (0, 1)]
-    outs = [p.communicate(timeout=120)[0] for p in procs]
-    assert procs[0].returncode == 0, outs[0]
-    assert procs[1].returncode == 0, outs[1]
+
+    def make_argvs():
+        prog = _RANK_PROG.format(repo=repo, port0=free_port(),
+                                 port1=free_port())
+        return [[sys.executable, "-c", prog, str(r)] for r in (0, 1)]
+
+    rcs, outs = run_group(make_argvs, timeout=420)
+    assert rcs[0] == 0, outs[0]
+    assert rcs[1] == 0, outs[1]
     assert "RANK0_OK" in outs[0]
 
 

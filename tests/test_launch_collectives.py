@@ -18,19 +18,28 @@ WORKER = os.path.join(REPO, "tests", "collective_worker.py")
 
 
 def test_two_process_real_collectives(tmp_path):
-    out = tmp_path / "out"
-    out.mkdir()
     env = {k: v for k, v in os.environ.items() if not k.startswith("PADDLE_")}
     env.pop("XLA_FLAGS", None)  # each rank: plain single-CPU process
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
-         WORKER, str(out)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    from _subproc import retry_run
+    dirs = []
+
+    def run_once():
+        # fresh out/log dirs per attempt so a retry never reads stale files
+        out = tmp_path / f"out{len(dirs)}"
+        logdir = tmp_path / f"logs{len(dirs)}"
+        out.mkdir()
+        dirs.append((out, logdir))
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(logdir),
+             WORKER, str(out)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+
+    proc = retry_run(run_once)
+    out, logdir = dirs[-1]
     if proc.returncode != 0:
         logs = ""
-        logdir = tmp_path / "logs"
         if logdir.exists():
             for f in sorted(logdir.iterdir()):
                 logs += f"\n--- {f.name} ---\n" + f.read_text()[-3000:]
