@@ -11,11 +11,26 @@ construct dispatches at runtime — a non-Tensor condition takes the normal
 Python path (same objects, same truthiness), a Tensor condition lowers to the
 structured form. So the pass can run on every @to_static function by default.
 
+Jump handling (reference: jit/dy2static/return_transformer.py,
+early_return_transformer.py, break_continue_transformer.py — same capability,
+different mechanics):
+  - EARLY RETURN in an `if` is rewritten continuation-passing style: the
+    branch bodies and the rest of the function become nested functions, the
+    if becomes `return __dy2s_ret_cond(test, t, f, ...)`. A return inside a
+    branch is then a plain function-level return — it maps 1:1 onto lax.cond
+    (both paths must produce the same structure under a traced condition).
+  - BREAK/CONTINUE in `while` / `for i in range(...)` are rewritten to jump
+    flags carried through the loop: the loop condition gains `and not brk`,
+    statements after a jump point are guarded by `if no_jump(brk, cnt)`.
+    `for` loops with jumps become explicit while loops. The rewritten form
+    is semantics-preserving for plain Python and lowers to lax.while_loop
+    when the condition (or a jump flag) is traced.
+
 Deliberate subset (loud, line-numbered errors where it matters):
-  - `if`/`while`/`for` containing `return`/`break`/`continue` at the rewritten
-    level are NOT converted; their condition is wrapped in a guard that raises
-    a clear error if a traced Tensor reaches it (the reference's early-return
-    transformer has no jax analog — rewrite to a result variable instead).
+  - `return` inside a LOOP body, and loops with an `else:` clause, are NOT
+    converted; their condition is wrapped in a guard that raises a clear
+    error if a traced Tensor reaches it (carry the value out via a flag
+    variable instead).
   - Only simple-`Name` bindings thread through branches/loops; attribute and
     subscript mutation works via closure (same object).
   - Functions with free variables (closures), generators, and async functions
@@ -92,19 +107,25 @@ def _dy2s_cond(test, true_fn, false_fn, args, names, lineno):
 
 
 def _dy2s_while(cond_fn, body_fn, args, names, lineno):
-    test = cond_fn(*args)
-    if _is_traced_tensor(test):
-        from .. import static
-
-        out = static.while_loop(
-            lambda *vs: cond_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
-            list(args))
-        return tuple(out)
+    # Traced-ness is re-checked EVERY iteration, not just at entry: a loop
+    # whose test starts out python (`while True:` with a rewritten tensor
+    # break flag) becomes traced the first time the body assigns a traced
+    # value into the condition's state. The python iterations already run
+    # are then simply an unrolled prefix of the lax.while_loop.
     vs = tuple(args)
-    while test:
+    test = cond_fn(*vs)
+    while True:
+        if _is_traced_tensor(test):
+            from .. import static
+
+            out = static.while_loop(
+                lambda *s: cond_fn(*s), lambda *s: tuple(body_fn(*s)),
+                list(vs))
+            return tuple(out)
+        if not test:
+            return vs
         vs = tuple(body_fn(*vs))
         test = cond_fn(*vs)
-    return vs
 
 
 def _dy2s_for_range(range_args, body_fn, args, names, lineno):
@@ -151,11 +172,92 @@ def _dy2s_bool(test, lineno, construct):
     if _is_traced_tensor(test):
         raise RuntimeError(
             f"dy2static: the {construct} at line {lineno} branches on a "
-            f"traced Tensor but contains return/break/continue, which cannot "
-            f"be captured as lax control flow. Rewrite it to assign a result "
-            f"variable (converted automatically), or use "
-            f"paddle.static.cond/while_loop explicitly.")
+            f"traced Tensor but contains a jump that cannot be captured as "
+            f"lax control flow (a `return` inside a loop body, a loop "
+            f"`else:` clause, or a jump under global/nonlocal). Carry the "
+            f"value out with a flag variable and break, or use "
+            f"paddle.static.cond/while_loop explicitly. (Early `return` in "
+            f"an if, and break/continue in loops, ARE converted "
+            f"automatically.)")
     return test
+
+
+def _dy2s_ret_cond(test, tfn, ffn, args, lineno):
+    """Early-return join: each branch returns the FUNCTION's final value
+    (either the early return or the continuation of the rest of the body)."""
+    if _is_traced_tensor(test):
+        from .. import static
+
+        try:
+            return static.cond(test, lambda: tfn(*args), lambda: ffn(*args))
+        except TypeError as e:
+            raise RuntimeError(
+                f"dy2static: the early-returning if at line {lineno} "
+                f"branches on a traced Tensor, so both paths (the early "
+                f"return and the rest of the function) must produce the same "
+                f"structure and dtypes — lax.cond requirement. Underlying "
+                f"error: {e}") from e
+    return tfn(*args) if test else ffn(*args)
+
+
+def _tensorish(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def _dy2s_loop_test(brk, thunk):
+    """Loop condition with a break flag: `(not brk) and test`, tensor-aware.
+    Python-bool flags keep short-circuit evaluation; a traced flag combines
+    with the test via logical ops (the test is then evaluated
+    unconditionally, which is fine under trace — it is pure)."""
+    if _tensorish(brk):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        t = thunk()
+        td = t._data if _tensorish(t) else jnp.asarray(t)
+        return Tensor(jnp.logical_and(
+            jnp.logical_not(brk._data.reshape(())), td.reshape(())))
+    return (not brk) and thunk()
+
+
+def _dy2s_no_jump(*flags):
+    """True when no jump flag (break/continue) is set; tensor-aware."""
+    if any(_tensorish(f) for f in flags):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        acc = jnp.asarray(False)
+        for f in flags:
+            fd = f._data if _tensorish(f) else jnp.asarray(f)
+            acc = jnp.logical_or(acc, fd.reshape(()))
+        return Tensor(jnp.logical_not(acc))
+    return not any(bool(f) for f in flags)
+
+
+def _dy2s_range_cont(it, stop, step):
+    """range() continuation test honoring the step sign; tensor-aware."""
+    if any(_tensorish(v) for v in (it, stop, step)):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        def d(v):
+            return (v._data if _tensorish(v) else jnp.asarray(v)).reshape(())
+
+        i_, s_, st_ = d(it), d(stop), d(step)
+        # a traced step==0 cannot raise data-dependently; it falls into the
+        # `it > stop` arm and iterates zero times
+        return Tensor(jnp.where(st_ > 0, i_ < s_, i_ > s_))
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return it < stop if step > 0 else it > stop
+
+
+def _dy2s_maybe_or(value, fallback):
+    """The captured prior binding of a for-loop target, or `fallback` (the
+    range start) when it was unbound before the loop."""
+    return fallback if isinstance(value, _Undef) else value
 
 
 _HELPERS = {
@@ -164,6 +266,11 @@ _HELPERS = {
     "__dy2s_for_range": _dy2s_for_range,
     "__dy2s_bool": _dy2s_bool,
     "__dy2s_maybe": _dy2s_maybe,
+    "__dy2s_ret_cond": _dy2s_ret_cond,
+    "__dy2s_loop_test": _dy2s_loop_test,
+    "__dy2s_no_jump": _dy2s_no_jump,
+    "__dy2s_range_cont": _dy2s_range_cont,
+    "__dy2s_maybe_or": _dy2s_maybe_or,
 }
 
 
@@ -245,6 +352,47 @@ def _has_scope_decl(stmts) -> bool:
     return False
 
 
+def _contains_return(*stmt_lists) -> bool:
+    """Any ast.Return in these lists, excluding nested function scopes."""
+
+    def walk(node):
+        if isinstance(node, _SCOPE_STOPS):
+            return False
+        if isinstance(node, ast.Return):
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s) for lst in stmt_lists for s in lst)
+
+
+def _contains_yield(stmts) -> bool:
+    """Yield/YieldFrom at this function's level (nested scopes excluded)."""
+
+    def walk(node):
+        if isinstance(node, _SCOPE_STOPS):
+            return False
+        if isinstance(node, (ast.Yield, ast.YieldFrom, ast.Await)):
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s) for s in stmts)
+
+
+def _level0_jumps(stmts) -> bool:
+    """Break/Continue belonging to the CURRENT loop (not nested ones)."""
+
+    def walk(node, depth):
+        if isinstance(node, _SCOPE_STOPS):
+            return False
+        if isinstance(node, (ast.Break, ast.Continue)) and depth == 0:
+            return True
+        d = depth + 1 if isinstance(node, (ast.For, ast.AsyncFor, ast.While)) \
+            else depth
+        return any(walk(c, d) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s, 0) for s in stmts)
+
+
 # ---------------------------------------------------------------- transformer
 
 
@@ -285,6 +433,232 @@ def _names_tuple_store(names: List[str]) -> ast.expr:
 def _const_tuple(values) -> ast.expr:
     return ast.Tuple(elts=[ast.Constant(value=v) for v in values],
                      ctx=ast.Load())
+
+
+# ------------------------------------------------------- early-return (CPS)
+
+
+def _mkfn(name: str, params: List[str], body: List[ast.stmt]) -> ast.FunctionDef:
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body or [ast.Pass()],
+        decorator_list=[], type_params=[])
+
+
+def _fn_scope_names(fndef) -> List[str]:
+    a = fndef.args
+    names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names |= _assigned_names(fndef.body)
+    return sorted(n for n in names if not n.startswith("__dy2s_"))
+
+
+def _cps_list(stmts: List[ast.stmt], k, params: List[str],
+              counter: List[int]) -> List[ast.stmt]:
+    """Rewrite early-return ifs in a statement list continuation-passing
+    style. `k` is the continuation to call on fallthrough (None at function
+    tail: falling off the end returns None, as in plain Python)."""
+    out: List[ast.stmt] = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.FunctionDef):
+            # nested defs get their own scope's rewrite — but never
+            # generators: moving a `return` past a `yield` into a
+            # continuation would turn the generator into a plain function
+            if not _contains_yield(s.body):
+                _apply_return_cps(s)
+            out.append(s)
+            continue
+        if isinstance(s, ast.If) and _contains_return(s.body, s.orelse):
+            counter[0] += 1
+            n = counter[0]
+            aname, tname, fname = (f"__dy2s_ra{n}", f"__dy2s_rt{n}",
+                                   f"__dy2s_rf{n}")
+            adef = _mkfn(aname, params,
+                         _cps_list(stmts[i + 1:], k, params, counter))
+            tdef = _mkfn(tname, params,
+                         _cps_list(s.body, aname, params, counter))
+            fdef = _mkfn(fname, params,
+                         _cps_list(s.orelse, aname, params, counter))
+            call = ast.Call(
+                func=_name("__dy2s_ret_cond"),
+                args=[s.test, _name(tname), _name(fname),
+                      ast.Tuple(elts=[_maybe_arg(p) for p in params],
+                                ctx=ast.Load()),
+                      ast.Constant(value=s.lineno)],
+                keywords=[])
+            out.extend(ast.copy_location(ast.fix_missing_locations(x), s)
+                       for x in (adef, tdef, fdef, ast.Return(value=call)))
+            return out
+        out.append(s)
+    if k is not None and not (out and isinstance(out[-1], ast.Return)):
+        tail = ast.Return(value=ast.Call(
+            func=_name(k), args=[_name(p) for p in params], keywords=[]))
+        anchor = out[-1] if out else ast.Pass()
+        out.append(ast.copy_location(ast.fix_missing_locations(tail), anchor)
+                   if out else ast.fix_missing_locations(tail))
+    return out
+
+
+def _apply_return_cps(fndef) -> None:
+    """Function-level pass: ifs containing `return` become branch functions
+    joined by __dy2s_ret_cond, with the rest of the function as an explicit
+    continuation — a `return` in a branch is then a plain function-level
+    return, which lax.cond captures directly. Skipped for functions using
+    global/nonlocal (moving statements into nested scopes would break the
+    declaration)."""
+    if _has_scope_decl(fndef.body):
+        return
+    params = _fn_scope_names(fndef)
+    fndef.body = _cps_list(fndef.body, None, params, [0])
+
+
+# ------------------------------------------------- break/continue (flag carry)
+
+
+def _assign(var: str, value: ast.expr) -> ast.stmt:
+    return ast.Assign(targets=[_name(var, ast.Store())], value=value)
+
+
+def _assign_const(var: str, v) -> ast.stmt:
+    return _assign(var, ast.Constant(value=v))
+
+
+def _rw_loop(stmts: List[ast.stmt], brk: str, cnt: str) -> List[ast.stmt]:
+    """Rewrite this loop's level-0 break/continue to flag writes, guarding
+    every statement after a jump point with `if no_jump(brk, cnt):`."""
+    out: List[ast.stmt] = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(ast.copy_location(_assign_const(brk, True), s))
+            return out  # rest of the list is unreachable
+        if isinstance(s, ast.Continue):
+            out.append(ast.copy_location(_assign_const(cnt, True), s))
+            return out
+        if (isinstance(s, (ast.If, ast.Try, ast.With))
+                and _level0_jumps([s])):
+            if isinstance(s, ast.If):
+                s.body = _rw_loop(s.body, brk, cnt)
+                s.orelse = _rw_loop(s.orelse, brk, cnt)
+            elif isinstance(s, ast.Try):
+                s.body = _rw_loop(s.body, brk, cnt)
+                for h in s.handlers:
+                    h.body = _rw_loop(h.body, brk, cnt)
+                orelse = _rw_loop(s.orelse, brk, cnt)
+                if orelse:
+                    # a real break in the try body would SKIP the else
+                    # clause; the flag rewrite completes the body normally,
+                    # so the else must be guarded (finally is NOT: it runs
+                    # even on a break)
+                    s.orelse = [ast.copy_location(ast.fix_missing_locations(
+                        ast.If(test=ast.Call(func=_name("__dy2s_no_jump"),
+                                             args=[_name(brk), _name(cnt)],
+                                             keywords=[]),
+                               body=orelse, orelse=[])), s)]
+                s.finalbody = _rw_loop(s.finalbody, brk, cnt)
+            else:
+                s.body = _rw_loop(s.body, brk, cnt)
+            out.append(s)
+            rest = _rw_loop(stmts[i + 1:], brk, cnt)
+            if rest:
+                guard = ast.If(
+                    test=ast.Call(func=_name("__dy2s_no_jump"),
+                                  args=[_name(brk), _name(cnt)], keywords=[]),
+                    body=rest, orelse=[])
+                out.append(ast.copy_location(
+                    ast.fix_missing_locations(guard), s))
+            return out
+        out.append(s)
+    return out
+
+
+class _LoopJumpPass(ast.NodeTransformer):
+    """Rewrites while/for-range loops containing break/continue into the
+    flag-carry form the lax lowering can capture. Runs before _CFTransformer;
+    the rewritten loops contain no jumps, so visit_While/visit_For convert
+    them normally (the flags become ordinary carried state)."""
+
+    def __init__(self):
+        self.n = 0
+
+    def _fresh(self):
+        self.n += 1
+        return (f"_jmp_brk{self.n}", f"_jmp_cnt{self.n}")
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if (node.orelse or _has_scope_decl(node.body)
+                or _contains_return(node.body)
+                or not _level0_jumps(node.body)):
+            return node
+        brk, cnt = self._fresh()
+        body = ([_assign_const(cnt, False)]
+                + _rw_loop(node.body, brk, cnt))
+        test = ast.Call(
+            func=_name("__dy2s_loop_test"),
+            args=[_name(brk),
+                  ast.Lambda(args=ast.arguments(
+                      posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                      defaults=[]), body=node.test)],
+            keywords=[])
+        new = [_assign_const(brk, False), _assign_const(cnt, False),
+               ast.While(test=test, body=body, orelse=[])]
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in new]
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if (not is_range or node.orelse or _has_scope_decl(node.body)
+                or _contains_return(node.body)
+                or not _level0_jumps(node.body)):
+            return node  # python iteration handles its own jumps natively
+        brk, cnt = self._fresh()
+        it, stop, step = (f"_jmp_it{self.n}", f"_jmp_stop{self.n}",
+                          f"_jmp_step{self.n}")
+        ra = node.iter.args
+        start_e = ra[0] if len(ra) >= 2 else ast.Constant(value=0)
+        stop_e = ra[1] if len(ra) >= 2 else ra[0]
+        step_e = ra[2] if len(ra) == 3 else ast.Constant(value=1)
+        init = [_assign_const(brk, False), _assign_const(cnt, False),
+                _assign(it, start_e), _assign(stop, stop_e),
+                _assign(step, step_e),
+                # pre-bind the target so it can join the loop carry without
+                # clobbering a pre-existing binding (python leaves the prior
+                # value on an empty range; an unbound target becomes start)
+                _assign(node.target.id, ast.Call(
+                    func=_name("__dy2s_maybe_or"),
+                    args=[_maybe_arg(node.target.id), _name(it)],
+                    keywords=[]))]
+        test = ast.Call(
+            func=_name("__dy2s_loop_test"),
+            args=[_name(brk),
+                  ast.Lambda(
+                      args=ast.arguments(posonlyargs=[], args=[],
+                                         kwonlyargs=[], kw_defaults=[],
+                                         defaults=[]),
+                      body=ast.Call(func=_name("__dy2s_range_cont"),
+                                    args=[_name(it), _name(stop), _name(step)],
+                                    keywords=[]))],
+            keywords=[])
+        body = ([_assign(node.target.id, _name(it)),
+                 _assign_const(cnt, False)]
+                + _rw_loop(node.body, brk, cnt)
+                + [_assign(it, ast.BinOp(left=_name(it), op=ast.Add(),
+                                         right=_name(step)))])
+        new = init + [ast.While(test=test, body=body, orelse=[])]
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in new]
 
 
 class _CFTransformer(ast.NodeTransformer):
@@ -419,6 +793,8 @@ def _convert_cached(fn: Callable) -> Callable:
     if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ValueError("not a function definition")
     fndef.decorator_list = []
+    _apply_return_cps(fndef)       # early return in if → branch fns + lax.cond
+    fndef = _LoopJumpPass().visit(fndef)  # break/continue → carried jump flags
     new = _CFTransformer().visit(fndef)
     mod = ast.Module(body=[new], type_ignores=[])
     ast.fix_missing_locations(mod)

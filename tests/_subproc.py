@@ -24,22 +24,28 @@ def run_group(make_argvs, timeout=420, retries=1, env=None, cwd=None):
                                   stderr=subprocess.STDOUT, text=True,
                                   env=env, cwd=cwd)
                  for argv in make_argvs()]
+        done = {}  # idx -> output captured by a successful communicate()
         try:
-            outs = [p.communicate(timeout=timeout)[0] or "" for p in procs]
+            for idx, p in enumerate(procs):
+                done[idx] = p.communicate(timeout=timeout)[0] or ""
+            outs = [done[i] for i in range(len(procs))]
             rcs = [p.returncode for p in procs]
         except subprocess.TimeoutExpired:
-            # only blame procs that actually hung: finished ones keep their
-            # real returncode/output so the failure message shows the hung
-            # rank's diagnostics, not the healthy rank's
+            # only blame procs that actually hung: finished ones keep the
+            # returncode/output already captured (a second communicate()
+            # would return '' and discard their diagnostics)
             hung = [p.poll() is None for p in procs]
             for p, h in zip(procs, hung):
                 if h:
                     p.kill()
-            outs = [(p.communicate()[0] or "")
-                    + ("\n<GROUP TIMEOUT: this proc hung>" if h else "")
-                    for p, h in zip(procs, hung)]
-            rcs = [-1 if h else p.returncode
-                   for p, h in zip(procs, hung)]
+            outs, rcs = [], []
+            for idx, (p, h) in enumerate(zip(procs, hung)):
+                out = done.get(idx)
+                if out is None:
+                    out = p.communicate()[0] or ""
+                outs.append(out + ("\n<GROUP TIMEOUT: this proc hung>"
+                                   if h else ""))
+                rcs.append(-1 if h else p.returncode)
         last = (rcs, outs)
         if all(rc == 0 for rc in rcs):
             return last

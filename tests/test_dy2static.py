@@ -89,15 +89,20 @@ def test_nested_if_in_while():
     np.testing.assert_allclose(out, 11.0)
 
 
-def test_return_in_tensor_if_raises_loudly():
+def test_return_in_tensor_if_now_converts():
+    # round-5: early return in a tensor if is CPS-rewritten onto lax.cond
+    # (was a loud error through round 4; full coverage in
+    # tests/test_dy2static_jumps.py)
     @paddle.jit.to_static
     def f(x):
         if x.sum() > 0:
             return x * 2.0
         return x
 
-    with pytest.raises(RuntimeError, match="dy2static.*line.*return"):
-        f(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.ones(2, np.float32))).numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(-np.ones(2, np.float32))).numpy(), [-1.0, -1.0])
 
 
 def test_none_check_with_return_still_works():
