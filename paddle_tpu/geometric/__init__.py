@@ -1,19 +1,27 @@
-"""paddle.geometric — graph message passing.
+"""paddle.geometric — graph message passing + sampling/reindex.
 
 Reference analog: python/paddle/geometric (send_u_recv / send_ue_recv /
-segment_* over the graph_send_recv kernels). TPU-native lowering:
-jax.ops.segment_sum/max/min — XLA turns these into sorted-segment reductions,
-the same dataflow the reference's CUDA kernels implement by atomics.
+send_uv / segment_* over the graph_send_recv kernels;
+sampling/neighbors.py:23 sample_neighbors; reindex.py:24,138
+reindex_graph/reindex_heter_graph). TPU-native lowering: message passing and
+segment reductions via jax.ops.segment_* (XLA sorted-segment reductions, the
+same dataflow the reference's CUDA kernels implement by atomics); sampling
+and reindex are host-side batch-prep ops with data-dependent output sizes,
+so they run eagerly on numpy (the reference's GPU kernels exist to overlap
+sampling with training — on TPU the DataLoader worker processes play that
+role).
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
-           "segment_max", "segment_min"]
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "sample_neighbors",
+           "reindex_graph", "reindex_heter_graph"]
 
 
 def _val(x):
@@ -87,3 +95,118 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
         raise ValueError(message_op)
     num = int(out_size) if out_size is not None else xv.shape[0]
     return Tensor(_seg(msg, dst, num, reduce_op))
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-EDGE messages x[src] ⊕ y[dst], no reduction (reference send_uv:
+    python/paddle/geometric/message_passing/send_recv.py)."""
+    xv, yv = _val(x), _val(y)
+    src = _val(src_index).astype(jnp.int32)
+    dst = _val(dst_index).astype(jnp.int32)
+    a, b = xv[src], yv[dst]
+    if message_op == "add":
+        out = a + b
+    elif message_op == "sub":
+        out = a - b
+    elif message_op == "mul":
+        out = a * b
+    elif message_op == "div":
+        out = a / b
+    else:
+        raise ValueError(message_op)
+    return Tensor(out)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniformly sample up to sample_size neighbors per input node from a
+    CSC graph (reference: geometric/sampling/neighbors.py:23
+    graph_sample_neighbors). Host-side eager op (data-dependent output size);
+    perm_buffer (a GPU fisher-yates buffer) is accepted and ignored.
+
+    Returns (out_neighbors, out_count[, out_eids])."""
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+
+    def _np(x):
+        # host-side op: numpy inputs keep their dtype (no jnp round-trip,
+        # which would canonicalize int64 -> int32 under the x64-off default)
+        return (x.numpy() if isinstance(x, Tensor)
+                else np.asarray(x)).reshape(-1)
+
+    rnp = _np(row)
+    cp = _np(colptr)
+    nodes = _np(input_nodes)
+    enp = _np(eids) if eids is not None else None
+    sel_neighbors, counts, sel_eids = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pos = np.arange(beg, end)
+        else:
+            pos = beg + np.random.choice(deg, size=sample_size, replace=False)
+        sel_neighbors.append(rnp[pos])
+        counts.append(len(pos))
+        if return_eids:
+            sel_eids.append(enp[pos])
+    cat = (np.concatenate(sel_neighbors) if sel_neighbors
+           else np.zeros((0,), rnp.dtype))
+    out_neighbors = Tensor(cat.astype(rnp.dtype))
+    out_count = Tensor(np.asarray(counts, np.int32))
+    if return_eids:
+        ecat = (np.concatenate(sel_eids) if sel_eids
+                else np.zeros((0,), enp.dtype))
+        return out_neighbors, out_count, Tensor(ecat.astype(enp.dtype))
+    return out_neighbors, out_count
+
+
+def _reindex(xs, neighbor_lists, count_lists):
+    idx = {int(v): i for i, v in enumerate(xs)}
+    if len(idx) != len(xs):
+        raise ValueError("reindex_graph: input nodes x must be unique")
+    out_nodes = [int(v) for v in xs]
+    srcs, dsts = [], []
+    for nb, cnt in zip(neighbor_lists, count_lists):
+        src = np.empty(len(nb), np.int64)
+        for j, v in enumerate(nb):
+            v = int(v)
+            i = idx.get(v)
+            if i is None:
+                i = len(out_nodes)
+                idx[v] = i
+                out_nodes.append(v)
+            src[j] = i
+        srcs.append(src)
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt))
+    cat = lambda ls: (np.concatenate(ls) if ls else np.zeros((0,), np.int64))
+    return cat(srcs), cat(dsts), np.asarray(out_nodes, np.int64)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber input nodes + sampled neighbors to a compact id space with
+    the input nodes first (reference: geometric/reindex.py:24 graph_reindex).
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    xs = np.asarray(_val(x)).reshape(-1)
+    nb = np.asarray(_val(neighbors)).reshape(-1)
+    cnt = np.asarray(_val(count)).reshape(-1)
+    src, dst, out_nodes = _reindex(xs, [nb], [cnt])
+    dt = xs.dtype
+    return Tensor(src.astype(dt)), Tensor(dst.astype(dt)), \
+        Tensor(out_nodes.astype(dt))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Multi-edge-type reindex: one shared id space across the per-type
+    neighbor lists (reference: geometric/reindex.py:138). `neighbors` and
+    `count` are lists/tuples of tensors, one per edge type."""
+    xs = np.asarray(_val(x)).reshape(-1)
+    nbs = [np.asarray(_val(n)).reshape(-1) for n in neighbors]
+    cnts = [np.asarray(_val(c)).reshape(-1) for c in count]
+    src, dst, out_nodes = _reindex(xs, nbs, cnts)
+    dt = xs.dtype
+    return Tensor(src.astype(dt)), Tensor(dst.astype(dt)), \
+        Tensor(out_nodes.astype(dt))

@@ -505,16 +505,47 @@ def _cps_list(stmts: List[ast.stmt], k, params: List[str],
     return out
 
 
+def _nested_scope_reads(stmts) -> Set[str]:
+    """Names loaded inside nested function/lambda scopes (deferred closures)."""
+    reads: Set[str] = set()
+
+    def collect_loads(node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                reads.add(n.id)
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            collect_loads(node)
+            return
+        for c in ast.iter_child_nodes(node):
+            walk(c)
+
+    for s in stmts:
+        walk(s)
+    return reads
+
+
 def _apply_return_cps(fndef) -> None:
     """Function-level pass: ifs containing `return` become branch functions
     joined by __dy2s_ret_cond, with the rest of the function as an explicit
     continuation — a `return` in a branch is then a plain function-level
-    return, which lax.cond captures directly. Skipped for functions using
-    global/nonlocal (moving statements into nested scopes would break the
-    declaration)."""
+    return, which lax.cond captures directly.
+
+    Skipped when the rewrite could change meaning: functions using
+    global/nonlocal (moving statements into nested scopes breaks the
+    declaration), and functions where a nested def/lambda reads a local that
+    the function also assigns — the continuation would rebind such names in
+    its OWN scope, leaving the deferred closure watching the stale outer
+    binding."""
     if _has_scope_decl(fndef.body):
         return
+    if not _contains_return(fndef.body):
+        return
     params = _fn_scope_names(fndef)
+    if _nested_scope_reads(fndef.body) & set(params):
+        return
     fndef.body = _cps_list(fndef.body, None, params, [0])
 
 

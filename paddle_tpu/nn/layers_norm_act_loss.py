@@ -81,10 +81,141 @@ class BatchNorm3D(_BatchNormBase):
     pass
 
 
+class _SyncBNOp:
+    """Cross-process sync BN as a PyLayer: forward all-reduces the per-channel
+    (sum, sum-of-squares, count) so every rank normalizes with the GLOBAL
+    batch statistics; backward all-reduces the two per-channel grad sums of
+    the standard BN gradient so dx matches the global-batch derivative.
+
+    Reference analog: python/paddle/nn/layer/norm.py:1517 (sync_batch_norm_
+    op) and operators/sync_batch_norm_op.cu — same two-collective dataflow
+    (one in forward, one in backward), here over the eager collective path
+    (device psum fast path with host fallback) instead of NCCL.
+
+    Every rank must call forward/backward in the same order (the usual DP
+    contract); grads for weight/bias are LOCAL sums — the DataParallel
+    gradient all-reduce aggregates them, matching the reference.
+    """
+
+    _fn = None
+
+    @classmethod
+    def apply(cls, x, weight, bias, epsilon, channel_axis):
+        if cls._fn is None:
+            from ..autograd import PyLayer
+
+            class _Fn(PyLayer):
+                forward = cls._forward
+                backward = cls._backward
+
+            cls._fn = _Fn
+        return cls._fn.apply(x, weight, bias,
+                             epsilon=epsilon, channel_axis=channel_axis)
+
+    @staticmethod
+    def _forward(ctx, x, weight, bias, epsilon, channel_axis):
+        import jax.numpy as jnp
+
+        from ..distributed.collective import all_reduce
+        xv = x.value()
+        c = xv.shape[channel_axis]
+        axes = tuple(i for i in range(xv.ndim) if i != channel_axis)
+        n_local = 1
+        for i, s in enumerate(xv.shape):
+            if i != channel_axis:
+                n_local *= s
+        x32 = xv.astype(jnp.float32)
+        packed = jnp.concatenate([
+            jnp.sum(x32, axis=axes), jnp.sum(x32 * x32, axis=axes),
+            jnp.array([float(n_local)], jnp.float32)])
+        packed = all_reduce(Tensor(packed)).value()
+        n_g = packed[2 * c]
+        mean = packed[:c] / n_g
+        var = jnp.maximum(packed[c:2 * c] / n_g - mean * mean, 0.0)
+        inv = jnp.reciprocal(jnp.sqrt(var + epsilon))
+        shape = [1] * xv.ndim
+        shape[channel_axis] = c
+        xhat = (x32 - mean.reshape(shape)) * inv.reshape(shape)
+        y = xhat
+        if weight is not None:
+            y = y * weight.value().astype(jnp.float32).reshape(shape) \
+                + bias.value().astype(jnp.float32).reshape(shape)
+        ctx.save_for_backward(x, weight)
+        ctx.bn = (xhat, inv, n_g, channel_axis, shape)
+        return (Tensor(y.astype(xv.dtype)), Tensor(mean), Tensor(var),
+                Tensor(jnp.asarray(n_g)))
+
+    @staticmethod
+    def _backward(ctx, dy, _dmean, _dvar, _dn):
+        import jax.numpy as jnp
+
+        from ..distributed.collective import all_reduce
+        x, weight = ctx.saved_tensor
+        xhat, inv, n_g, channel_axis, shape = ctx.bn
+        c = xhat.shape[channel_axis]
+        axes = tuple(i for i in range(xhat.ndim) if i != channel_axis)
+        dyv = dy.value().astype(jnp.float32)
+        dxhat = dyv
+        if weight is not None:
+            dxhat = dyv * weight.value().astype(jnp.float32).reshape(shape)
+        packed = jnp.concatenate([jnp.sum(dxhat, axis=axes),
+                                  jnp.sum(dxhat * xhat, axis=axes)])
+        packed = all_reduce(Tensor(packed)).value()
+        g_sum = packed[:c].reshape(shape)
+        g_sum_x = packed[c:].reshape(shape)
+        dx = inv.reshape(shape) * (dxhat - g_sum / n_g - xhat * g_sum_x / n_g)
+        dx = Tensor(dx.astype(x.dtype))
+        if weight is None:
+            return dx
+        dw = Tensor(jnp.sum(dyv * xhat, axis=axes).astype(weight.dtype))
+        db = Tensor(jnp.sum(dyv, axis=axes).astype(weight.dtype))
+        return dx, dw, db
+
+
 class SyncBatchNorm(_BatchNormBase):
-    """Cross-replica BN. Under SPMD jit, batch stats computed over a sharded batch
-    ARE the global stats (XLA inserts the all-reduce); eager single-process matches
-    plain BN. convert_sync_batchnorm provided for API parity."""
+    """Cross-replica BN (reference: nn/layer/norm.py:1517 SyncBatchNorm).
+
+    Three regimes:
+    - SPMD jit: batch stats computed over the sharded batch ARE the global
+      stats (XLA inserts the all-reduce) — plain BN is already sync.
+    - Eager, multi-process (launcher DP): forward/backward all-reduce the
+      batch statistics across ranks via _SyncBNOp, so normalization and
+      running stats use the GLOBAL batch, matching reference semantics.
+    - Eager, single process: identical to plain BN.
+    """
+
+    def forward(self, x):
+        from ..core.dispatch import in_trace
+        from ..distributed.collective import _mp_mode
+        use_stats = self._use_global_stats
+        if use_stats is None:
+            use_stats = not self.training
+        sync = False
+        if self.training and not use_stats and not in_trace():
+            try:
+                sync = _mp_mode(None)
+            except Exception:
+                sync = False
+        if not sync:
+            return super().forward(x)
+        channel_axis = (1 if self._data_format.startswith("NC") else
+                        x.ndim - 1)
+        if x.ndim <= 2:
+            channel_axis = x.ndim - 1
+        y, bmean, bvar, n_g = _SyncBNOp.apply(
+            x, self.weight, self.bias, float(self._epsilon), channel_axis)
+        from ..core.dispatch import no_grad
+        with no_grad():
+            m = float(self._momentum)
+            n = float(n_g)
+            unbiased = bvar * (n / max(n - 1.0, 1.0))
+            new_mean = self._mean * m + bmean * (1 - m)
+            new_var = self._variance * m + unbiased * (1 - m)
+            self._mean._set_value_inplace(
+                new_mean._data.astype(self._mean.dtype))
+            self._variance._set_value_inplace(
+                new_var._data.astype(self._variance.dtype))
+        return y
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

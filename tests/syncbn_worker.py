@@ -1,0 +1,58 @@
+"""Rank worker for test_syncbn_launch.py: eager cross-process SyncBatchNorm.
+
+Each rank holds HALF of a global batch; after one forward+backward the
+per-rank outputs, running stats, and grads are written for the test to
+compare against a single-process full-batch oracle.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    outdir = sys.argv[1]
+
+    rs = np.random.RandomState(0)
+    full = rs.randn(8, 3, 4, 4).astype("float32")
+    upstream = rs.randn(8, 3, 4, 4).astype("float32")  # fixed cotangent
+    per = full.shape[0] // world
+    local = full[rank * per:(rank + 1) * per]
+
+    paddle.seed(0)
+    bn = paddle.nn.SyncBatchNorm(3)
+    bn.weight.set_value(paddle.to_tensor(
+        np.array([1.5, 0.5, 2.0], "float32")))
+    bn.bias.set_value(paddle.to_tensor(np.array([0.1, -0.2, 0.3], "float32")))
+
+    x = paddle.to_tensor(local, stop_gradient=False)
+    y = bn(x)
+    seed = paddle.to_tensor(upstream[rank * per:(rank + 1) * per])
+    loss = (y * seed).sum()
+    loss.backward()
+
+    out = {
+        "rank": rank,
+        "world": world,
+        "y": y.numpy().tolist(),
+        "running_mean": bn._mean.numpy().tolist(),
+        "running_var": bn._variance.numpy().tolist(),
+        "x_grad": x.grad.numpy().tolist(),
+        "w_grad": bn.weight.grad.numpy().tolist(),
+        "b_grad": bn.bias.grad.numpy().tolist(),
+    }
+    with open(os.path.join(outdir, f"syncbn_{rank}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

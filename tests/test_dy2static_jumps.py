@@ -273,6 +273,26 @@ def test_break_statements_after_guarded():
     assert int(i) == 4 and int(trail) == 40
 
 
+def test_deferred_closure_blocks_cps():
+    """A nested def reading a local the function rebinds after the early
+    return must keep plain-python semantics (CPS is skipped)."""
+
+    def f(x, flag):
+        y = 1
+
+        def g():
+            return y
+
+        if flag:
+            return x
+        y = 2
+        return g()
+
+    sf = convert_to_static(f)
+    assert sf(5, True) == 5
+    assert sf(5, False) == 2  # g() must see the rebound y
+
+
 def test_nested_generator_untouched():
     def f(cond):
         def gen():

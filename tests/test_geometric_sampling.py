@@ -1,0 +1,150 @@
+"""geometric sampling + reindex vs numpy oracles.
+
+Reference: python/paddle/geometric/sampling/neighbors.py:23,
+reindex.py:24,138 — the docstring examples there are used verbatim as
+oracles.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+# reference docstring graph: edges (3,0),(7,0),(0,1),(9,1),(1,2),(4,3),(2,4),
+# (9,5),(3,5),(9,6),(1,6),(9,8),(7,8)
+ROW = np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], "int64")
+COLPTR = np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], "int64")
+
+
+def _t(a, dt=None):
+    return paddle.to_tensor(np.asarray(a, dt) if dt else np.asarray(a))
+
+
+def test_sample_neighbors_all():
+    nodes = np.array([0, 8, 1, 2], "int64")
+    nb, cnt = G.sample_neighbors(_t(ROW), _t(COLPTR), _t(nodes))
+    cnt = cnt.numpy()
+    assert cnt.dtype == np.int32
+    # degree oracle from CSC
+    deg = [COLPTR[v + 1] - COLPTR[v] for v in nodes]
+    np.testing.assert_array_equal(cnt, deg)
+    nbv = nb.numpy()
+    off = 0
+    for v, d in zip(nodes, deg):
+        got = sorted(nbv[off:off + d].tolist())
+        want = sorted(ROW[COLPTR[v]:COLPTR[v + 1]].tolist())
+        assert got == want, (v, got, want)
+        off += d
+
+
+def test_sample_neighbors_limited():
+    np.random.seed(0)
+    nodes = np.array([0, 8, 1, 2, 7], "int64")
+    nb, cnt = G.sample_neighbors(_t(ROW), _t(COLPTR), _t(nodes),
+                                 sample_size=2)
+    cnt = cnt.numpy()
+    deg = np.array([COLPTR[v + 1] - COLPTR[v] for v in nodes])
+    np.testing.assert_array_equal(cnt, np.minimum(deg, 2))
+    nbv = nb.numpy()
+    off = 0
+    for v, c in zip(nodes, cnt):
+        got = nbv[off:off + c].tolist()
+        allowed = set(ROW[COLPTR[v]:COLPTR[v + 1]].tolist())
+        assert set(got) <= allowed
+        assert len(set(got)) == len(got), "sampling without replacement"
+        off += c
+
+
+def test_sample_neighbors_eids():
+    eids = np.arange(len(ROW), dtype="int64") + 100
+    nodes = np.array([0, 1, 6], "int64")
+    np.random.seed(1)
+    nb, cnt, out_eids = G.sample_neighbors(
+        _t(ROW), _t(COLPTR), _t(nodes), sample_size=1,
+        eids=_t(eids), return_eids=True)
+    nbv, ev = nb.numpy(), out_eids.numpy()
+    assert len(nbv) == len(ev) == int(cnt.numpy().sum())
+    for n, e in zip(nbv, ev):
+        assert ROW[e - 100] == n  # eid indexes the sampled edge
+
+
+def test_sample_neighbors_eids_follow_eids_dtype():
+    # eids dtype is taken from the EIDS input, not from row (Tensor's global
+    # int canonicalization — int64 -> int32 — still applies at wrap time)
+    np.random.seed(3)
+    nb, cnt, ev = G.sample_neighbors(
+        ROW.astype("int32"), COLPTR.astype("int32"),
+        np.array([0, 1], "int32"), sample_size=1,
+        eids=np.arange(len(ROW), dtype="int32"), return_eids=True)
+    assert ev.numpy().dtype == np.int32
+    for n, e in zip(nb.numpy(), ev.numpy()):
+        assert ROW[e] == n
+
+
+def test_sample_neighbors_eids_requires_eids():
+    with pytest.raises(ValueError, match="eids"):
+        G.sample_neighbors(_t(ROW), _t(COLPTR), _t(np.array([0], "int64")),
+                           return_eids=True)
+
+
+def test_reindex_graph_reference_example():
+    x = _t([0, 1, 2], "int64")
+    nb = _t([8, 9, 0, 4, 7, 6, 7], "int64")
+    cnt = _t([2, 3, 2], "int32")
+    src, dst, nodes = G.reindex_graph(x, nb, cnt)
+    assert src.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6]
+    assert dst.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2]
+    assert nodes.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6]
+    # invariant: out_nodes[reindex_src] recovers the raw neighbor ids
+    np.testing.assert_array_equal(
+        nodes.numpy()[src.numpy()], [8, 9, 0, 4, 7, 6, 7])
+
+
+def test_reindex_heter_graph_reference_example():
+    x = _t([0, 1, 2], "int64")
+    nb_a = _t([8, 9, 0, 4, 7, 6, 7], "int64")
+    cnt_a = _t([2, 3, 2], "int32")
+    nb_b = _t([0, 2, 3, 5, 1], "int64")
+    cnt_b = _t([1, 3, 1], "int32")
+    src, dst, nodes = G.reindex_heter_graph(x, [nb_a, nb_b], [cnt_a, cnt_b])
+    assert src.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1]
+    assert dst.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2]
+    assert nodes.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6, 3, 5]
+
+
+def test_reindex_rejects_duplicate_x():
+    with pytest.raises(ValueError, match="unique"):
+        G.reindex_graph(_t([0, 0], "int64"), _t([1], "int64"),
+                        _t([1, 0], "int32"))
+
+
+def test_sample_then_reindex_pipeline():
+    """The sample -> reindex -> message-passing workflow the reference serves."""
+    np.random.seed(2)
+    nodes = np.array([0, 1, 2, 4], "int64")
+    nb, cnt = G.sample_neighbors(_t(ROW), _t(COLPTR), _t(nodes),
+                                 sample_size=2)
+    src, dst, out_nodes = G.reindex_graph(_t(nodes), nb, cnt)
+    n = len(out_nodes.numpy())
+    feats = paddle.to_tensor(
+        np.random.RandomState(0).randn(n, 4).astype("float32"))
+    out = G.send_u_recv(feats, src, dst, reduce_op="sum",
+                        out_size=len(nodes))
+    # numpy oracle
+    want = np.zeros((len(nodes), 4), "float32")
+    for s, d in zip(src.numpy(), dst.numpy()):
+        want[d] += feats.numpy()[s]
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_send_uv():
+    x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    y = paddle.to_tensor((np.arange(8, dtype="float32") * 10).reshape(4, 2))
+    src = _t([0, 1, 2], "int32")
+    dst = _t([1, 2, 3], "int32")
+    out = G.send_uv(x, y, src, dst, message_op="add")
+    want = x.numpy()[[0, 1, 2]] + y.numpy()[[1, 2, 3]]
+    np.testing.assert_allclose(out.numpy(), want)
+    out = G.send_uv(x, y, src, dst, message_op="mul")
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy()[[0, 1, 2]] * y.numpy()[[1, 2, 3]])

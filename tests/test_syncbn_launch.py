@@ -1,0 +1,92 @@
+"""Eager cross-process SyncBatchNorm == single-process full-batch oracle.
+
+Reference: python/paddle/nn/layer/norm.py:1517 (sync_batch_norm_ all-reduces
+batch statistics in eager multi-process mode). Two launcher ranks each see
+half the batch; their outputs, running stats, and gradients must match a
+plain BatchNorm2D run on the FULL batch in one process.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "syncbn_worker.py")
+
+
+def test_syncbn_two_process_matches_full_batch(tmp_path):
+    from _subproc import retry_run
+
+    env = {k: v for k, v in os.environ.items() if not k.startswith("PADDLE_")}
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    dirs = []
+
+    def run_once():
+        out = tmp_path / f"out{len(dirs)}"
+        logdir = tmp_path / f"logs{len(dirs)}"
+        out.mkdir()
+        dirs.append((out, logdir))
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(logdir),
+             WORKER, str(out)],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+
+    proc = retry_run(run_once)
+    out, logdir = dirs[-1]
+    if proc.returncode != 0:
+        logs = ""
+        if logdir.exists():
+            for f in sorted(logdir.iterdir()):
+                if f.is_file():
+                    logs += f"\n--- {f.name} ---\n" + f.read_text()[-3000:]
+        raise AssertionError(f"launch failed rc={proc.returncode}\n"
+                             f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+                             f"{logs}")
+
+    res = []
+    for rank in range(2):
+        path = out / f"syncbn_{rank}.json"
+        assert path.exists(), f"rank {rank} wrote no result"
+        res.append(json.loads(path.read_text()))
+
+    # single-process full-batch oracle (plain BN over the concatenated batch)
+    import paddle_tpu as paddle
+    rs = np.random.RandomState(0)
+    full = rs.randn(8, 3, 4, 4).astype("float32")
+    upstream = rs.randn(8, 3, 4, 4).astype("float32")
+    paddle.seed(0)
+    bn = paddle.nn.BatchNorm2D(3)
+    bn.weight.set_value(paddle.to_tensor(np.array([1.5, 0.5, 2.0], "float32")))
+    bn.bias.set_value(paddle.to_tensor(np.array([0.1, -0.2, 0.3], "float32")))
+    x = paddle.to_tensor(full, stop_gradient=False)
+    y = bn(x)
+    (y * paddle.to_tensor(upstream)).sum().backward()
+
+    y_full = y.numpy()
+    per = 4
+    for r in res:
+        rank = r["rank"]
+        np.testing.assert_allclose(
+            np.asarray(r["y"], "float32"),
+            y_full[rank * per:(rank + 1) * per], rtol=1e-4, atol=1e-5)
+        # running stats: every rank holds the GLOBAL-batch stats
+        np.testing.assert_allclose(np.asarray(r["running_mean"]),
+                                   bn._mean.numpy(), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r["running_var"]),
+                                   bn._variance.numpy(), rtol=1e-4, atol=1e-6)
+        # dx: the synced backward reproduces the full-batch derivative
+        np.testing.assert_allclose(
+            np.asarray(r["x_grad"], "float32"),
+            x.grad.numpy()[rank * per:(rank + 1) * per],
+            rtol=1e-3, atol=1e-5)
+    # param grads are LOCAL sums; summed over ranks == full-batch grads
+    np.testing.assert_allclose(
+        np.asarray(res[0]["w_grad"]) + np.asarray(res[1]["w_grad"]),
+        bn.weight.grad.numpy(), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(res[0]["b_grad"]) + np.asarray(res[1]["b_grad"]),
+        bn.bias.grad.numpy(), rtol=1e-3, atol=1e-5)
