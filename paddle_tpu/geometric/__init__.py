@@ -169,6 +169,10 @@ def _reindex(xs, neighbor_lists, count_lists):
     out_nodes = [int(v) for v in xs]
     srcs, dsts = [], []
     for nb, cnt in zip(neighbor_lists, count_lists):
+        if int(np.sum(cnt)) != len(nb):
+            raise ValueError(
+                f"reindex_graph: count sums to {int(np.sum(cnt))} but "
+                f"neighbors has {len(nb)} entries")
         src = np.empty(len(nb), np.int64)
         for j, v in enumerate(nb):
             v = int(v)

@@ -506,18 +506,43 @@ def _cps_list(stmts: List[ast.stmt], k, params: List[str],
 
 
 def _nested_scope_reads(stmts) -> Set[str]:
-    """Names loaded inside nested function/lambda scopes (deferred closures)."""
+    """FREE names read inside deferred nested scopes — function defs,
+    lambdas, and generator expressions (list/set/dict comprehensions
+    evaluate immediately in place, so they cannot observe later
+    rebindings). Names the nested scope binds itself (params, its own
+    assignments, comprehension targets) are excluded."""
     reads: Set[str] = set()
 
-    def collect_loads(node):
+    def scope_bound(node) -> Set[str]:
+        bound: Set[str] = set()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            bound |= {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if not isinstance(node, ast.Lambda):
+                bound |= _assigned_names(node.body)
+        elif isinstance(node, ast.GeneratorExp):
+            for comp in node.generators:
+                for n in ast.walk(comp.target):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+        return bound
+
+    def collect(node):
+        bound = scope_bound(node)
         for n in ast.walk(node):
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id not in bound:
                 reads.add(n.id)
 
     def walk(node):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            collect_loads(node)
+                             ast.Lambda, ast.GeneratorExp)):
+            collect(node)
             return
         for c in ast.iter_child_nodes(node):
             walk(c)
@@ -544,7 +569,11 @@ def _apply_return_cps(fndef) -> None:
     if not _contains_return(fndef.body):
         return
     params = _fn_scope_names(fndef)
-    if _nested_scope_reads(fndef.body) & set(params):
+    # the hazard is a deferred closure watching a local that statements
+    # moved into a continuation would REBIND in their own scope — so gate
+    # on names assigned in the body (parameters that are only read stay
+    # CPS-safe)
+    if _nested_scope_reads(fndef.body) & _assigned_names(fndef.body):
         return
     fndef.body = _cps_list(fndef.body, None, params, [0])
 

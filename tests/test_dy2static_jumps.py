@@ -293,6 +293,41 @@ def test_deferred_closure_blocks_cps():
     assert sf(5, False) == 2  # g() must see the rebound y
 
 
+def test_read_only_closure_keeps_cps():
+    """A nested def reading a PARAMETER (never rebound) must not disable the
+    early-return conversion."""
+
+    def f(x):
+        def g():
+            return x * 3.0
+
+        if paddle.sum(x) > 0:
+            return x * 2.0
+        return g()
+
+    sf = _ts(f)
+    np.testing.assert_allclose(
+        sf(paddle.to_tensor(np.array([3.0], "float32"))).numpy(), [6.0])
+    np.testing.assert_allclose(
+        sf(paddle.to_tensor(np.array([-3.0], "float32"))).numpy(), [-9.0])
+
+
+def test_genexp_closure_blocks_cps():
+    """Generator expressions are deferred closures too."""
+
+    def f(x, flag):
+        y = 1
+        gen = (y + 0 for _ in range(1))
+        if flag:
+            return x
+        y = 2
+        return next(gen) + y
+
+    sf = convert_to_static(f)
+    assert sf(5, True) == 5
+    assert sf(5, False) == f(5, False)
+
+
 def test_nested_generator_untouched():
     def f(cond):
         def gen():

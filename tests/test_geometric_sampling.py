@@ -112,6 +112,12 @@ def test_reindex_heter_graph_reference_example():
     assert nodes.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6, 3, 5]
 
 
+def test_reindex_rejects_count_mismatch():
+    with pytest.raises(ValueError, match="count sums"):
+        G.reindex_graph(_t([0], "int64"), _t([5, 6], "int64"),
+                        _t([1], "int32"))
+
+
 def test_reindex_rejects_duplicate_x():
     with pytest.raises(ValueError, match="unique"):
         G.reindex_graph(_t([0, 0], "int64"), _t([1], "int64"),
