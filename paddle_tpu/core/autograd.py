@@ -61,14 +61,30 @@ class GradNode:
         if not _MULTI_DEVICE:
             return cotangents  # stage boundaries cannot exist on one device
 
+        from .lazy import LazyArray, _placement_key
+
+        def place_key(x):
+            # deferred-eager aware: a pending LazyArray's placement is its
+            # graph's routing key; forcing here would break fusion for the
+            # common single-placement multi-device case
+            if type(x) is LazyArray:
+                if x._concrete is not None:
+                    return _placement_key(x._concrete)
+                return x._graph.pkey
+            if isinstance(x, _jax.Array):
+                return _placement_key(x)
+            return None
+
         ref = None
+        ref_key = None
         all_devs = set()
         try:
             for p in (self.saved_primals or ()):
-                if isinstance(p, _jax.Array):
-                    devs = p.sharding.device_set
-                    all_devs |= devs
-                    if ref is None or len(devs) > len(ref.sharding.device_set):
+                k = place_key(p)
+                if k is not None:
+                    all_devs |= set(k)
+                    if ref_key is None or len(k) > len(ref_key):
+                        ref_key = k
                         ref = p
         except Exception:
             return cotangents
@@ -79,10 +95,15 @@ class GradNode:
             # create_graph cotangents are Tensors: align the inner array
             # in-place (placement doesn't affect the recorded history)
             inner = c._data if hasattr(c, "_data") else c
-            # only a DISJOINT device set marks a stage boundary; overlapping sets
-            # (e.g. single-device input + mesh-wide weight) are jit-compatible
-            if (isinstance(inner, _jax.Array)
-                    and not (inner.sharding.device_set & all_devs)):
+            ck = place_key(inner)
+            # only a DISJOINT device set marks a stage boundary; overlapping
+            # sets (e.g. single-device input + mesh-wide weight) are
+            # jit-compatible
+            if ck is not None and not (set(ck) & all_devs):
+                if type(inner) is LazyArray:
+                    inner = inner.force()  # stage boundary: flush the source
+                if type(ref) is LazyArray:
+                    ref = ref.force()
                 sh = ref.sharding
                 target = (NamedSharding(sh.mesh, _P())
                           if isinstance(sh, NamedSharding) else sh)
@@ -253,7 +274,9 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
             # ZeRO stage-2 invariant: grads shard the moment they're produced,
             # even while buffered here — never a full replicated copy per param
             import jax
-            g = jax.device_put(g, sh)
+
+            from .lazy import concrete
+            g = jax.device_put(concrete(g), sh)
         ent = leaf_acc.get(id(t))
         if ent is None:
             leaf_acc[id(t)] = [t, g]

@@ -250,13 +250,23 @@ def _bwd_call(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
     runs the cached jitted vjp."""
 
     def call(primals, cotangents):
+        hook = _PROFILER_HOOK
+        t0 = _time.perf_counter() if hook is not None else 0.0
         if lazy.enabled():
             raw = _bwd_raw(name, attr_key, diff_idx, n_in)
-            return lazy.record(("gbwd", name, attr_key, diff_idx, n_in), raw,
-                               tuple(primals) + tuple(cotangents))
-        primals = tuple(lazy.concrete(p) for p in primals)
-        cotangents = tuple(lazy.concrete(c) for c in cotangents)
-        return _bwd_exec(name, attr_key, diff_idx, n_in)(primals, cotangents)
+            out = lazy.record(("gbwd", name, attr_key, diff_idx, n_in), raw,
+                              tuple(primals) + tuple(cotangents))
+        else:
+            primals = tuple(lazy.concrete(p) for p in primals)
+            cotangents = tuple(lazy.concrete(c) for c in cotangents)
+            out = _bwd_exec(name, attr_key, diff_idx, n_in)(primals,
+                                                            cotangents)
+        if hook is not None:
+            # backward dispatch event under the op's own name (the reference
+            # host tracer records *_grad ops; profilers and coverage gates
+            # see the backward under "name@grad")
+            hook(f"{name}@grad", t0, _time.perf_counter())
+        return out
 
     return call
 
@@ -278,15 +288,22 @@ def _explicit_bwd_call(name: str, attr_key: Tuple):
     op = _REGISTRY[name]
 
     def call(primals, outs, cotangents):
+        hook = _PROFILER_HOOK
+        t0 = _time.perf_counter() if hook is not None else 0.0
         if lazy.enabled() and not op.no_jit:
             raw = _ebwd_raw(name, attr_key, len(primals), len(outs))
-            return lazy.record(
+            res = lazy.record(
                 ("ebwd", name, attr_key, len(primals), len(outs)), raw,
                 tuple(primals) + tuple(outs) + tuple(cotangents))
-        primals = tuple(lazy.concrete(p) for p in primals)
-        outs = tuple(lazy.concrete(o) for o in outs)
-        cotangents = tuple(lazy.concrete(c) for c in cotangents)
-        return _explicit_bwd_exec(name, attr_key)(primals, outs, cotangents)
+        else:
+            primals = tuple(lazy.concrete(p) for p in primals)
+            outs = tuple(lazy.concrete(o) for o in outs)
+            cotangents = tuple(lazy.concrete(c) for c in cotangents)
+            res = _explicit_bwd_exec(name, attr_key)(primals, outs,
+                                                     cotangents)
+        if hook is not None:
+            hook(f"{name}@grad", t0, _time.perf_counter())
+        return res
 
     return call
 

@@ -47,7 +47,7 @@ def flag(name: str):
 # Core flags (subset of the reference's ~200; grown as subsystems land).
 define_flag("FLAGS_check_nan_inf", False, "check every op output for NaN/Inf (reference: framework/details/nan_inf_utils)")
 define_flag("FLAGS_eager_jit_ops", True, "execute eager ops through cached jitted executables")
-define_flag("FLAGS_eager_fusion", True, "deferred-eager: batch the eager op stream into fused, signature-cached executables (single-device; see core/lazy.py)")
+define_flag("FLAGS_eager_fusion", True, "deferred-eager: batch the eager op stream into fused, signature-cached executables (per-placement graphs on multi-device; see core/lazy.py)")
 define_flag("FLAGS_use_bf16_matmul", False, "force bf16 accumulation inputs for matmul/conv in eager mode")
 define_flag("FLAGS_retain_grad_for_all", False, "retain .grad for non-leaf tensors")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity")

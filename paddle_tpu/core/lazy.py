@@ -22,10 +22,16 @@ How it works:
   - Python scalars become device constants through `scalar_const` (cached): through
     the tunnel a single `jnp.asarray(2.0)` is a ~3 ms host→device transfer.
 
-Enabled when FLAGS_eager_fusion is set, the process sees a single device (multi-
-device eager keeps explicit per-op placement semantics), FLAGS_check_nan_inf is
-off, and no to_static trace is active. Everything else (autograd tape, hooks,
-version counters) is unchanged — laziness lives strictly below the Tensor layer.
+Enabled when FLAGS_eager_fusion is set, FLAGS_check_nan_inf is off, and no
+to_static trace is active. Multi-device processes keep explicit per-op
+placement semantics via PER-PLACEMENT graphs: ops are recorded into the lazy
+graph matching their arguments' device set (committed single-device arrays
+and mesh-sharded global arrays alike), a value crossing placements flushes
+its source graph (flush-on-placement-change), and an op whose own arguments
+span two placements executes eagerly so jax raises the same error it would
+without fusion. Single-device processes skip the placement bookkeeping
+entirely. Everything else (autograd tape, hooks, version counters) is
+unchanged — laziness lives strictly below the Tensor layer.
 """
 from __future__ import annotations
 
@@ -50,7 +56,10 @@ _EXEC_CACHE: Dict[Tuple, Any] = {}
 # python scalar -> device constant (dedups the per-op host→device transfer)
 _CONST_CACHE: Dict[Tuple, jax.Array] = {}
 
-_SINGLE_DEVICE: Optional[bool] = None
+_MULTI: Optional[bool] = None
+
+# sharding object -> canonical device-set key (placement routing, multi-device)
+_PKEY_CACHE: Dict[Any, Optional[Tuple]] = {}
 
 _MAX_NODES = 8192  # safety valve: unobserved streams flush periodically
 
@@ -58,10 +67,33 @@ _MAX_NODES = 8192  # safety valve: unobserved streams flush periodically
 def enabled() -> bool:
     if not flag("FLAGS_eager_fusion") or flag("FLAGS_check_nan_inf"):
         return False
-    global _SINGLE_DEVICE
-    if _SINGLE_DEVICE is None:
-        _SINGLE_DEVICE = jax.device_count() == 1
-    return _SINGLE_DEVICE
+    global _MULTI
+    if _MULTI is None:
+        _MULTI = jax.device_count() > 1
+    return True
+
+
+def _placement_key(a) -> Optional[Tuple]:
+    """Canonical key for the device set a committed array is pinned to; None
+    for uncommitted arrays (they follow whatever computation uses them)."""
+    if not getattr(a, "_committed", True):
+        return None
+    sh = getattr(a, "sharding", None)
+    if sh is None:
+        return None
+    try:
+        k = _PKEY_CACHE.get(sh, _placement_key)  # sentinel: self
+    except TypeError:
+        return None  # unhashable sharding: treat as unconstrained
+    if k is _placement_key:
+        try:
+            k = tuple(sorted(d.id for d in sh.device_set))
+        except Exception:
+            k = None
+        if len(_PKEY_CACHE) > 4096:
+            _PKEY_CACHE.clear()
+        _PKEY_CACHE[sh] = k
+    return k
 
 
 def scalar_const(v) -> jax.Array:
@@ -88,13 +120,14 @@ class _Node:
 
 
 class LazyGraph:
-    __slots__ = ("nodes", "leaves", "leaf_ids", "flushed")
+    __slots__ = ("nodes", "leaves", "leaf_ids", "flushed", "pkey")
 
-    def __init__(self):
+    def __init__(self, pkey=None):
         self.nodes: List[_Node] = []
         self.leaves: List[jax.Array] = []
         self.leaf_ids: Dict[int, int] = {}
         self.flushed = False
+        self.pkey = pkey  # placement routing key (multi-device only)
 
     def _leaf(self, arr) -> Tuple:
         i = self.leaf_ids.get(id(arr))
@@ -110,6 +143,9 @@ class LazyGraph:
         self.flushed = True
         if _tls.__dict__.get("graph") is self:
             _tls.graph = None
+        graphs = _tls.__dict__.get("graphs")
+        if graphs is not None and graphs.get(self.pkey) is self:
+            del graphs[self.pkey]
         if not self.nodes:
             return
         out_slots = []
@@ -238,6 +274,12 @@ class LazyArray:
             pass
         return record(("cast", str(dt)), lambda a: a.astype(dt), (self,))
 
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return record(("lreshape", shape),
+                      lambda a: a.reshape(shape), (self,))
+
     def _binop(self, name, fn, other, reverse=False):
         if isinstance(other, (int, float, bool)):
             other = scalar_const(other)
@@ -284,13 +326,19 @@ def concrete(x):
     return x.force() if type(x) is LazyArray else x
 
 
-def _current_graph() -> LazyGraph:
-    g = _tls.__dict__.get("graph")
+def _current_graph(pkey=None) -> LazyGraph:
+    if not _MULTI:
+        g = _tls.__dict__.get("graph")
+        if g is None or g.flushed:
+            # g.flushed: another thread forced this graph (flush() clears only
+            # the OWNER's thread-local); recording into a flushed graph would
+            # strand the new nodes — they'd never execute
+            g = _tls.graph = LazyGraph()
+        return g
+    graphs = _tls.__dict__.setdefault("graphs", {})
+    g = graphs.get(pkey)
     if g is None or g.flushed:
-        # g.flushed: another thread forced this graph (flush() clears only the
-        # OWNER's thread-local); recording into a flushed graph would strand
-        # the new nodes — they'd never execute
-        g = _tls.graph = LazyGraph()
+        g = graphs[pkey] = LazyGraph(pkey)
     return g
 
 
@@ -299,6 +347,10 @@ def flush_all():
     g = _tls.__dict__.get("graph")
     if g is not None:
         g.flush()
+    graphs = _tls.__dict__.get("graphs")
+    if graphs:
+        for g in list(graphs.values()):
+            g.flush()
 
 
 def record(key, fn: Callable, args: Sequence):
@@ -308,10 +360,30 @@ def record(key, fn: Callable, args: Sequence):
     or numpy arrays (anything np/python is promoted to a leaf)."""
     import jax.numpy as jnp
 
-    g = _current_graph()
+    pkey = None
+    if _MULTI:
+        pkeys = set()
+        for a in args:
+            if type(a) is LazyArray:
+                if a._concrete is None:
+                    pkeys.add(a._graph.pkey)
+                else:
+                    # a READY lazy value's placement is its concrete array's
+                    # (a flushed jit output is committed) — missing this
+                    # would route it as a leaf into a foreign-placement
+                    # graph and poison that graph's flush
+                    pkeys.add(_placement_key(a._concrete))
+            elif isinstance(a, jax.Array):
+                pkeys.add(_placement_key(a))
+        pkeys.discard(None)  # uncommitted values follow; no constraint
+        if len(pkeys) > 1:
+            return _cross_placement(key, fn, args)
+        pkey = next(iter(pkeys)) if pkeys else None
+
+    g = _current_graph(pkey)
     if len(g.nodes) >= _MAX_NODES:
         g.flush()
-        g = _current_graph()
+        g = _current_graph(pkey)
 
     encoded = []
     avals = []
@@ -351,6 +423,54 @@ def record(key, fn: Callable, args: Sequence):
         node.out_refs[pos] = weakref.ref(la)
         las.append(la)
     return jax.tree_util.tree_unflatten(treedef, las)
+
+
+def _cross_placement(key, fn, args):
+    """An op whose arguments span two committed placements. Unfused eager
+    would never have committed SCALAR intermediates (python-scalar math
+    stays uncommitted), but a flushed graph's outputs are committed — so
+    replicate stray scalar operands onto the placement owning the bulk of
+    the data and retry the lazy record. If real tensors genuinely span
+    placements, execute eagerly so jax raises the same error it would
+    without fusion.
+
+    Deliberate deviation: a USER-committed 1-element array gets the same
+    silent transfer (we cannot tell it apart from a flushed intermediate).
+    Unfused jax would raise there; following the bulk data is both harmless
+    numerically and what the reference framework does with scalar
+    operands."""
+    from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+
+    conc = [concrete(a) for a in args]
+    sizes: Dict[Tuple, int] = {}
+    rep: Dict[Tuple, jax.Array] = {}
+    for a in conc:
+        if isinstance(a, jax.Array):
+            k = _placement_key(a)
+            if k is not None:
+                sizes[k] = sizes.get(k, 0) + a.size
+                rep.setdefault(k, a)
+    target = max(sizes, key=sizes.get)
+    sh = rep[target].sharding
+    if isinstance(sh, NamedSharding):
+        repl = NamedSharding(sh.mesh, PartitionSpec())
+    elif isinstance(sh, SingleDeviceSharding):
+        repl = sh
+    else:
+        return fn(*conc)
+    moved, ok = [], True
+    for a in conc:
+        if isinstance(a, jax.Array):
+            k = _placement_key(a)
+            if k is not None and k != target:
+                if a.size <= 1:
+                    a = jax.device_put(a, repl)
+                else:
+                    ok = False
+        moved.append(a)
+    if not ok:
+        return fn(*moved)  # genuine cross-placement: surface jax's error
+    return record(key, fn, moved)
 
 
 def cache_stats():

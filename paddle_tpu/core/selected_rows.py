@@ -38,8 +38,13 @@ class SelectedRows:
 
     def __init__(self, rows, values, dense_shape: Tuple[int, ...],
                  _merged: bool = False):
-        self.rows = rows
-        self.values = values
+        # SelectedRows flow straight into jitted sparse-update executables
+        # and jnp scatter indexing, neither of which accepts deferred-eager
+        # LazyArrays — materialize at the boundary (one flush; the sparse
+        # path is eager-only by design, see module docstring)
+        from . import lazy
+        self.rows = lazy.concrete(rows)
+        self.values = lazy.concrete(values)
         self.dense_shape = tuple(int(s) for s in dense_shape)
         self._merged = _merged
 

@@ -96,7 +96,8 @@ class DGCOptimizer(_Wrapper):
                 if p._grad is None:
                     continue
                 pid = id(p)
-                g = p._grad + self._residual.get(pid, 0.0)
+                from ...core.lazy import concrete
+                g = concrete(p._grad) + self._residual.get(pid, 0.0)
                 flat = jnp.abs(g.reshape(-1))
                 k = max(1, int(flat.size * (1.0 - self.sparsity)))
                 thresh = jax.lax.top_k(flat, k)[0][-1]
@@ -168,6 +169,8 @@ class LarsMomentumOptimizer(_Wrapper):
         for p in opt._parameter_list:
             if p._grad is None or p.ndim < 2 or self._excluded(p):
                 continue  # reference skips bias/bn/excluded params
+            from ...core.lazy import concrete
+            p._grad = concrete(p._grad)  # raw jnp math below
             w_norm = jnp.linalg.norm(p.value().astype(jnp.float32))
             g_norm = jnp.linalg.norm(p._grad.astype(jnp.float32))
             trust = self.lars_coeff * w_norm / (

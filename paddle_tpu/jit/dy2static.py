@@ -525,6 +525,13 @@ def _nested_scope_reads(stmts) -> Set[str]:
                 bound.add(a.kwarg.arg)
             if not isinstance(node, ast.Lambda):
                 bound |= _assigned_names(node.body)
+                # nonlocal/global-declared names are NOT locally bound even
+                # when assigned — they read/write the enclosing cell, so
+                # they count as free reads for the rebinding hazard
+                for s in node.body:
+                    for n in ast.walk(s):
+                        if isinstance(n, (ast.Global, ast.Nonlocal)):
+                            bound -= set(n.names)
         elif isinstance(node, ast.GeneratorExp):
             for comp in node.generators:
                 for n in ast.walk(comp.target):

@@ -1,8 +1,9 @@
 """Deferred-eager (core/lazy.py) correctness worker.
 
-Run in a subprocess with a SINGLE device (no --xla_force_host_platform_device_count):
-lazy mode only engages on single-device processes, so it cannot be exercised by the
-8-device suite directly. Prints LAZY_WORKER_OK on success.
+Run in a subprocess with a SINGLE device (no --xla_force_host_platform_device_count)
+to exercise the production single-chip fast path (no placement bookkeeping);
+the multi-device path is covered in-suite by tests/test_lazy_multidevice.py.
+Prints LAZY_WORKER_OK on success.
 """
 import os
 import sys
@@ -22,7 +23,7 @@ import paddle_tpu.nn as nn
 from paddle_tpu.core import lazy
 
 assert jax.device_count() == 1
-assert lazy.enabled(), "FLAGS_eager_fusion should engage on a single device"
+assert lazy.enabled(), "FLAGS_eager_fusion should engage by default"
 
 # --- laziness is real: a math chain defers, observation materializes --------
 x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))

@@ -99,7 +99,17 @@ def test_early_return_one_program_both_paths():
     b = sf(paddle.to_tensor(np.array([-3.0], "float32")))
     np.testing.assert_allclose(a.numpy(), [6.0])
     np.testing.assert_allclose(b.numpy(), [3.0])
-    assert len(_CALLS) == 1, "second call should hit the compiled cache"
+    # f executes only at compile points (the trace + one per distinct lazy
+    # flush signature), never per call: steady-state calls add ZERO
+    warm_out = sf(paddle.to_tensor(np.array([1.0], "float32")))
+    np.testing.assert_allclose(warm_out.numpy(), [2.0])  # warm the 1-node sig
+    warm = len(_CALLS)
+    for v in (5.0, -7.0, 2.0):
+        out = sf(paddle.to_tensor(np.array([v], "float32")))
+        np.testing.assert_allclose(out.numpy(),
+                                   [v * 2.0 if v > 0 else -v])
+    assert len(_CALLS) == warm, \
+        f"steady-state calls retraced: {len(_CALLS)} != {warm}"
 
 
 def test_early_return_in_model_forward():
@@ -310,6 +320,28 @@ def test_read_only_closure_keeps_cps():
         sf(paddle.to_tensor(np.array([3.0], "float32"))).numpy(), [6.0])
     np.testing.assert_allclose(
         sf(paddle.to_tensor(np.array([-3.0], "float32"))).numpy(), [-9.0])
+
+
+def test_nonlocal_closure_blocks_cps():
+    """A nested def writing an outer local via nonlocal is a deferred
+    closure over that name even though it also assigns it."""
+
+    def f(x, flag):
+        y = 1
+
+        def g():
+            nonlocal y
+            y = y + 1
+            return y
+
+        if flag:
+            return x
+        y = 2
+        return g() + y
+
+    sf = convert_to_static(f)
+    assert sf(5, True) == f(5, True) == 5
+    assert sf(5, False) == f(5, False) == 6
 
 
 def test_genexp_closure_blocks_cps():

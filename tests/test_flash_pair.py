@@ -72,9 +72,73 @@ def test_pair_gate():
     assert pair_layout_supported(64, 12, 512)
     assert pair_layout_supported(64, 16, 1024)
     assert pair_layout_supported(128, 8, 1024)       # hpb=1 (fused-bwd form)
-    assert not pair_layout_supported(64, 12, 2048)   # kv beyond one tile
+    assert pair_layout_supported(64, 12, 2048)       # round 5: multi-tile
+    assert pair_layout_supported(64, 12, 8192)       # any length now
     assert not pair_layout_supported(64, 13, 512)    # odd heads
     assert not pair_layout_supported(80, 12, 512)    # block not lane-aligned
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("L", [2048, 4096])
+def test_pair_forward_long(causal, L):
+    """Multi-tile online softmax: KV spans several tiles (block_k=1024)."""
+    b, heads, d = 1, 2, 64
+    qkv = _rand_qkv(b, L, heads, d, seed=4)
+    seed = jnp.asarray([0], jnp.int32)
+    out = flash_pair(qkv, seed, heads, d, causal, 1.0 / math.sqrt(d),
+                     512, 0.0, True)
+    ref = _oracle(qkv, heads, d, causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("L", [2048, 4096])
+def test_pair_backward_long_fused(causal, L):
+    """Several kv tiles through the FUSED multi-tile backward (4096 takes
+    the reduced 256/512 tile shape that fits the VMEM budget)."""
+    b, heads, d = 1, 2, 64
+    qkv = _rand_qkv(b, L, heads, d, seed=5)
+    seed = jnp.asarray([0], jnp.int32)
+
+    def f_pair(x):
+        return (flash_pair(x, seed, heads, d, causal, 1.0 / math.sqrt(d),
+                           512, 0.0, True) ** 2).sum()
+
+    def f_ref(x):
+        return (_oracle(x, heads, d, causal) ** 2).sum()
+
+    g_pair = jax.grad(f_pair)(qkv)
+    g_ref = jax.grad(f_ref)(qkv)
+    np.testing.assert_allclose(np.asarray(g_pair), np.asarray(g_ref),
+                               rtol=1e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pair_backward_split(causal, monkeypatch):
+    """The SPLIT two-kernel backward (kv_pad beyond the fused VMEM bound) —
+    exercised by shrinking the bound so L=1024 takes the split path."""
+    import paddle_tpu.kernels.pallas.flash_pair as fp
+    monkeypatch.setattr(fp, "_MAX_FUSED_BWD", 512)
+    b, L, heads, d = 1, 1024, 2, 64
+    qkv = _rand_qkv(b, L, heads, d, seed=6)
+    seed = jnp.asarray([0], jnp.int32)
+
+    # block_q=64 is used by NO other test: _pair_bwd is jitted and reads
+    # _MAX_FUSED_BWD at trace time, so a unique static signature guarantees
+    # the patched bound is seen (and the poisoned cache entry it leaves
+    # behind can never be hit by another signature)
+    def f_pair(x):
+        return (fp.flash_pair(x, seed, heads, d, causal, 1.0 / math.sqrt(d),
+                              64, 0.0, True) ** 2).sum()
+
+    def f_ref(x):
+        return (_oracle(x, heads, d, causal) ** 2).sum()
+
+    g_pair = jax.grad(f_pair)(qkv)
+    g_ref = jax.grad(f_ref)(qkv)
+    np.testing.assert_allclose(np.asarray(g_pair), np.asarray(g_ref),
+                               rtol=1e-2, atol=2e-2)
 
 
 def _on_tpu():

@@ -24,6 +24,9 @@ from test_op_bf16_sweep import SKIP as FWD_SKIP  # same inapplicable families
 _COVERED = set()
 _RAN = [0]
 _orig_hook = None
+# coverage collection is gated so the set counts ONLY ops dispatched while a
+# bfloat16 BACKWARD runs — not fp32 reference passes, forwards, or fp16 runs
+_COLLECT = [False]
 
 # additional grad-only exclusions, each with why
 GRAD_SKIP = {
@@ -45,18 +48,25 @@ GRAD_SKIP = {
 def setup_module():
     global _orig_hook
     _orig_hook = dispatch._PROFILER_HOOK
-    dispatch.set_profiler_hook(lambda name, t0, t1: _COVERED.add(name))
+    # backward dispatches fire the hook as "<op>@grad" (dispatch._bwd_call)
+    dispatch.set_profiler_hook(
+        lambda name, t0, t1: _COVERED.add(name.split("@")[0])
+        if (_COLLECT[0] and name.endswith("@grad")) else None)
 
 
 def teardown_module():
     dispatch.set_profiler_hook(_orig_hook)
 
 
-def _grad_all(fn, ts, diff_idx):
+def _grad_all(fn, ts, diff_idx, collect=False):
     for i in diff_idx:
         ts[i].stop_gradient = False
     out = fn(*ts)
-    out.astype("float32").sum().backward()
+    _COLLECT[0] = collect
+    try:
+        out.astype("float32").sum().backward()
+    finally:
+        _COLLECT[0] = False
     return [ts[i].grad for i in diff_idx]
 
 
@@ -95,7 +105,8 @@ def test_backward_low_precision(s, dtype, request):
             t = t.astype(dtype)
         lp_ts.append(t)
     try:
-        lp_grads = _grad_all(fn, lp_ts, diff_idx)
+        lp_grads = _grad_all(fn, lp_ts, diff_idx,
+                             collect=(dtype == "bfloat16"))
     except Exception as e:
         pytest.fail(f"{sid}: backward raised on {dtype} inputs: {e}")
 
