@@ -272,11 +272,12 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
             g = g.to_dense()  # ZeRO-sharded params keep the dense contract
         if sh is not None and not isinstance(g, Tensor):
             # ZeRO stage-2 invariant: grads shard the moment they're produced,
-            # even while buffered here — never a full replicated copy per param
-            import jax
-
-            from .lazy import concrete
-            g = jax.device_put(concrete(g), sh)
+            # even while buffered here — never a full replicated copy per
+            # param. lazy_device_put records the re-placement into the lazy
+            # graph when possible (a force here would flush per parameter
+            # and undo the backward's fusion).
+            from .lazy import lazy_device_put
+            g = lazy_device_put(g, sh)
         ent = leaf_acc.get(id(t))
         if ent is None:
             leaf_acc[id(t)] = [t, g]

@@ -243,6 +243,12 @@ class LazyArray:
     def devices(self):
         return self.force().devices()
 
+    @property
+    def sharding(self):
+        # placement metadata is only final once materialized (a pending
+        # value's sharding is whatever the flush executable assigns)
+        return self.force().sharding
+
     def __jax_array__(self):
         return self.force()
 
@@ -471,6 +477,28 @@ def _cross_placement(key, fn, args):
     if not ok:
         return fn(*moved)  # genuine cross-placement: surface jax's error
     return record(key, fn, moved)
+
+
+def lazy_device_put(g, sh):
+    """device_put that stays lazy when it can: a pending LazyArray whose
+    graph's device set matches the target sharding's records the re-placement
+    INTO the graph (device_put is jit-traceable), so per-parameter grad
+    sharding doesn't flush the backward once per param. Anything else
+    concretizes and places eagerly."""
+    if type(g) is LazyArray and g._concrete is None:
+        try:
+            tk = tuple(sorted(d.id for d in sh.device_set))
+        except Exception:
+            tk = None
+        if tk is not None and g._graph.pkey in (None, tk):
+            # with_sharding_constraint, NOT device_put: inside the flush jit
+            # GSPMD ignores device_put's placement for outputs (measured:
+            # the flushed grad came back replicated), while a constraint
+            # pins the output sharding
+            return record(
+                ("dput", sh),
+                lambda a: jax.lax.with_sharding_constraint(a, sh), (g,))
+    return jax.device_put(concrete(g), sh)
 
 
 def cache_stats():

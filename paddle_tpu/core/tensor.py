@@ -222,9 +222,10 @@ class Tensor:
         if sh is not None and not isinstance(g, Tensor):
             # ZeRO stage-2 semantics: the gradient is sharded AT accumulation
             # (reduce-scatter), never held replicated on the tape — reference
-            # GroupShardedStage2's slice-reduce hooks
-            import jax
-            g = jax.device_put(g, sh)
+            # GroupShardedStage2's slice-reduce hooks. lazy_device_put keeps
+            # a pending deferred-eager grad lazy when device sets allow.
+            from .lazy import lazy_device_put
+            g = lazy_device_put(g, sh)
         if self._grad is None:
             self._grad = g
         else:

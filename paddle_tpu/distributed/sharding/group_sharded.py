@@ -83,10 +83,11 @@ class _ShardingStage2Optimizer(DygraphShardingOptimizer):
         mesh = get_mesh()
         if mesh is not None and mesh.shape.get("sharding", 1) > 1:
             # safety net for grads produced outside the marked tape path
+            from ...core.lazy import lazy_device_put
             for p in self._inner_opt._parameter_list:
                 if p._grad is not None and \
                         getattr(p, "_grad_sharding", None) is not None:
-                    p._grad = jax.device_put(p._grad, p._grad_sharding)
+                    p._grad = lazy_device_put(p._grad, p._grad_sharding)
         return super().step()
 
 

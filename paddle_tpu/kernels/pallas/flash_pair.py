@@ -77,6 +77,41 @@ def _pair_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     b, h2 = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
 
+    if n_k == 1:
+        # single-KV-tile fast path (the pre-round-5 kernel): softmax in
+        # registers, no online-softmax scratch round trips — this is the
+        # production config for L <= 1024 (GPT-medium bench, BERT-512)
+        for which in range(hpb):
+            sl = slice(which * d, (which + 1) * d)
+            qs = (q_ref[:, sl].astype(jnp.float32)
+                  * sm_scale).astype(q_ref.dtype)
+            s = jax.lax.dot_general(qs, k_ref[:, sl],
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            valid = None
+            if causal or kv_len < block_k:
+                valid = _valid_mask(qi, 0, causal=causal, block_q=block_q,
+                                    block_k=block_k, kv_len=kv_len,
+                                    causal_offset=0)
+                s = jnp.where(valid, s, _NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            if valid is not None:
+                p = jnp.where(valid, p, 0.0)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            if dropout_rate > 0.0:
+                bh = b * n_heads + hpb * h2 + which
+                keep = _dropout_mask(seed_ref, bh, qi, jnp.int32(0),
+                                     (block_q, block_k), dropout_rate)
+                p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            o = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[:, sl],
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            o_ref[:, sl] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+            lse_ref[which, :] = (m[:, 0]
+                                 + jnp.log(jnp.maximum(l[:, 0], 1e-30)))
+        return
+
     @pl.when(ki == 0)
     def _init():
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
@@ -252,9 +287,10 @@ def _pair_bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(ki == 0)
-    def _init_q():
-        dq_acc[:] = jnp.zeros_like(dq_acc)
+    if n_k > 1:
+        @pl.when(ki == 0)
+        def _init_q():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _body():
         for which in range(hpb):
@@ -264,9 +300,16 @@ def _pair_bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 kv_len=kv_len, block_q=block_q, block_k=block_k,
                 dropout_rate=dropout_rate, n_heads=n_heads, hpb=hpb,
                 b=b, h2=h2)
-            dq_acc[:, sl] += jax.lax.dot_general(
+            dq = jax.lax.dot_general(
                 dsc, k_ref[:, sl], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
+            if n_k == 1:
+                # single KV tile: dq complete in this step — write direct,
+                # no accumulator round trip (the pre-round-5 form)
+                dq_ref[pl.ds(qi * block_q, block_q), sl] = \
+                    dq.astype(dq_ref.dtype)
+            else:
+                dq_acc[:, sl] += dq
             rows = pl.ds(ki * block_k, block_k)
             dv_acc[rows, sl] += jax.lax.dot_general(
                 p_dv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -280,10 +323,11 @@ def _pair_bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     else:
         _body()
 
-    @pl.when(ki == n_k - 1)
-    def _write_dq():
-        dq_ref[pl.ds(qi * block_q, block_q), :] = \
-            dq_acc[:].astype(dq_ref.dtype)
+    if n_k > 1:
+        @pl.when(ki == n_k - 1)
+        def _write_dq():
+            dq_ref[pl.ds(qi * block_q, block_q), :] = \
+                dq_acc[:].astype(dq_ref.dtype)
 
     @pl.when(jnp.logical_and(qi == n_q - 1, ki == n_k - 1))
     def _finalize():

@@ -7,7 +7,7 @@ from ...core.dispatch import no_grad, register_op
 from ...ops._helpers import _op, static_int_list
 
 __all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
-           "local_response_norm", "normalize"]
+           "local_response_norm", "normalize", "add_dropout_ln"]
 
 
 def _bn_fwd(x, mean, var, weight=None, bias=None, epsilon=1e-5, channel_axis=1,
@@ -197,3 +197,49 @@ def _normalize_fwd(x, p=2.0, axis=1, epsilon=1e-12):
 
 
 register_op("normalize", _normalize_fwd)
+
+
+# ---------------------------------------------- fused residual add+dropout+LN
+
+
+def _add_dropout_ln_fwd(x, sub, weight, bias, seed, rate=0.0, eps=1e-12):
+    from ...kernels.pallas.fused_residual import fused_add_dropout_ln
+    shape = x.shape
+    h = shape[-1]
+    n = 1
+    for s in shape[:-1]:
+        n *= s
+    seed = jnp.atleast_1d(seed).astype(jnp.int32)
+    out = fused_add_dropout_ln(x.reshape(n, h), sub.reshape(n, h),
+                               weight, bias, seed, float(rate), float(eps))
+    return out.reshape(shape)
+
+
+register_op("fused_add_dropout_ln", _add_dropout_ln_fwd, nondiff_inputs=(4,))
+
+
+def add_dropout_ln(x, sub, weight, bias, p=0.0, epsilon=1e-12, training=True):
+    """out = LayerNorm(x + dropout(sub)) — the transformer sublayer residual
+    epilogue, fused into one Pallas pass on TPU (kernels/pallas/
+    fused_residual.py: in-kernel PRNG mask, row-stat-only saves, one-pass
+    backward). Reference analog: operators/fused/fused_attention_op.cu /
+    fused_feedforward_op.cu epilogues. Falls back to the unfused
+    composition off-TPU (identical semantics, shared dropout-mask source
+    excepted)."""
+    import os
+
+    from ...core import random as _rng
+    from ...core.tensor import Tensor as _T
+    from ...kernels.pallas.fused_residual import fused_ln_path_available
+    rate = float(p) if training else 0.0
+    if (fused_ln_path_available(x, rate)
+            and not os.environ.get("PADDLE_DISABLE_FUSED_LN")):
+        # rate==0 reuses one cached device constant: through the tunnel each
+        # fresh tiny host->device array costs ~3 ms (see lazy.scalar_const)
+        from ...core.lazy import scalar_const
+        seed = _rng.int32_seed() if rate > 0.0 else scalar_const(0)
+        return _op("fused_add_dropout_ln", x, sub, weight, bias, _T(seed),
+                   rate=rate, eps=float(epsilon))
+    from .common import dropout as _dropout
+    h = x + _dropout(sub, p=rate, training=rate > 0.0)
+    return layer_norm(h, x.shape[-1], weight, bias, epsilon=epsilon)

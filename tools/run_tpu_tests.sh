@@ -11,7 +11,7 @@ cd "$(dirname "$0")/.."
 STAGE=$(mktemp -d /tmp/paddle_tpu_tputests.XXXXXX)
 trap 'rm -rf "$STAGE"' EXIT
 cp tests/test_flash_tpu.py tests/test_dropout_pallas.py \
-   tests/test_flash_pair.py "$STAGE"/
+   tests/test_flash_pair.py tests/test_fused_residual.py "$STAGE"/
 # NB: APPEND to PYTHONPATH — the login env carries /root/.axon_site, whose
 # sitecustomize configures the axon TPU plugin; overwriting it silently
 # drops the chip and every TPU-gated test skips
