@@ -1,0 +1,12 @@
+// Minimal stand-in for the LLVM/MLIR headers the TF wheel does NOT ship
+// (include/external/llvm-project has mlir/ but no llvm/, so the real
+// BuiltinOps.h cannot compile). xla/pjrt/pjrt_client.h names mlir::ModuleOp
+// only in two by-value parameters of inline-unimplemented virtual overloads
+// this runtime never calls; a trivial complete type keeps the textual
+// declaration order — and therefore the Itanium vtable slot numbering —
+// identical to TF's build, which is all the XlaComputation-overload calls
+// rely on.
+#pragma once
+namespace mlir {
+class ModuleOp {};
+}  // namespace mlir

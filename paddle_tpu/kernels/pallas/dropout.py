@@ -96,19 +96,12 @@ def _dropout_apply(x, seed, rate: float, upscale: bool = True):
 
 
 def dropout_path_available(x) -> bool:
-    """TPU placement + lane-quantum size check (no interpret lowering for the
-    hardware PRNG). Must NOT observe the value: under deferred eager a
-    .value() here would flush the pending graph at every dropout call."""
+    """TPU placement + lane-quantum size check (no interpret lowering for
+    the hardware PRNG)."""
     n = 1
     for s in x.shape:
         n *= s
     if n == 0 or n % 128:
         return False
-    arr = getattr(x, "_data", x)
-    if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
-        try:
-            return any(d.platform == "tpu" for d in arr.devices())
-        except Exception:
-            pass
-    # tracers and LazyArrays: decide by where the program will run
-    return jax.default_backend() == "tpu"
+    from .util import tpu_placement
+    return tpu_placement(x)

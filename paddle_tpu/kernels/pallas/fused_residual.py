@@ -173,17 +173,26 @@ fused_add_dropout_ln.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 def fused_ln_path_available(x, rate: float = 0.0) -> bool:
-    """TPU placement + lane-quantum width gate. `rate` is accepted for call
-    -site symmetry but does not change eligibility: the kernel runs at any
-    rate on TPU, and off-TPU the unfused composition is the right fallback
-    even at rate==0 (interpret mode is far slower than XLA's fused chain).
-    Must not observe the value (deferred eager)."""
+    """TPU placement + Mosaic tile legality gate. `rate` is accepted for
+    call-site symmetry but does not change eligibility: the kernel runs at
+    any rate on TPU, and off-TPU the unfused composition is the right
+    fallback even at rate==0 (interpret mode is far slower than XLA's fused
+    chain). Must not observe the value (deferred eager)."""
     if x.ndim < 2 or x.shape[-1] % 128:
         return False
-    arr = getattr(x, "_data", x)
-    if isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer):
-        try:
-            return any(d.platform == "tpu" for d in arr.devices())
-        except Exception:
-            pass
-    return jax.default_backend() == "tpu"
+    hdim = int(x.shape[-1])
+    n = 1
+    for s in x.shape[:-1]:
+        n *= int(s)
+    if n == 0:
+        return False
+    # the derived row tile must be Mosaic-legal on BOTH layouts it serves:
+    # (block, H) row tiles (sublane dim % 8 or == N) and the (2, block)
+    # stats lanes (% 128 or == N) — block == N covers both, else the
+    # 128-multiple covers both
+    import numpy as np
+    block = _row_block(n, hdim, np.dtype(x.dtype).itemsize)
+    if not (block == n or block % 128 == 0):
+        return False
+    from .util import tpu_placement
+    return tpu_placement(x)
