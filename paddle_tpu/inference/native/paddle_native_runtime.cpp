@@ -55,10 +55,14 @@ struct TensorMeta {
     for (auto d : dims) n *= static_cast<size_t>(d);
     return n;
   }
+  // item_size and prim MUST cover the same dtype set: a dtype that passes
+  // header validation (item_size != 0) but maps to PRIMITIVE_TYPE_INVALID
+  // would fail later with an opaque upload error
   size_t item_size() const {
     if (dtype == "float32" || dtype == "int32" || dtype == "uint32") return 4;
     if (dtype == "float64" || dtype == "int64" || dtype == "uint64") return 8;
-    if (dtype == "float16" || dtype == "bfloat16" || dtype == "int16")
+    if (dtype == "float16" || dtype == "bfloat16" || dtype == "int16" ||
+        dtype == "uint16")
       return 2;
     if (dtype == "int8" || dtype == "uint8" || dtype == "bool") return 1;
     return 0;
@@ -72,6 +76,9 @@ struct TensorMeta {
     if (dtype == "int32") return xla::S32;
     if (dtype == "int16") return xla::S16;
     if (dtype == "int8") return xla::S8;
+    if (dtype == "uint64") return xla::U64;
+    if (dtype == "uint32") return xla::U32;
+    if (dtype == "uint16") return xla::U16;
     if (dtype == "uint8") return xla::U8;
     if (dtype == "bool") return xla::PRED;
     return xla::PRIMITIVE_TYPE_INVALID;
@@ -260,7 +267,14 @@ PD_EXPORT PD_Config* PD_ConfigCreate() { return new PD_Config(); }
 
 PD_EXPORT void PD_ConfigSetModel(PD_Config* c, const char* model, const char* params) {
   (void)params;
-  if (c && model) c->model = model;
+  if (!c || !model) return;
+  std::string m = model;
+  // accept reference-style "<prefix>.pdmodel" paths like the capi library
+  const std::string suffix = ".pdmodel";
+  if (m.size() > suffix.size() &&
+      m.compare(m.size() - suffix.size(), suffix.size(), suffix) == 0)
+    m.resize(m.size() - suffix.size());
+  c->model = m;
 }
 
 PD_EXPORT void PD_ConfigDestroy(PD_Config* c) { delete c; }
@@ -284,10 +298,11 @@ PD_EXPORT int PD_PredictorSetInput(PD_Predictor* p, const char* name, const void
   return p->model.set_input(name, data, shape, ndim, dtype) ? 0 : -1;
 }
 
-// returns 1 on success (matching the CPython-bridge ABI)
+// returns the number of outputs, or -1 (matching the CPython-bridge ABI)
 PD_EXPORT int PD_PredictorRun(PD_Predictor* p) {
-  if (!p) return 0;
-  return p->model.run() ? 1 : 0;
+  if (!p) return -1;
+  if (!p->model.run()) return -1;
+  return static_cast<int>(p->model.outs.size());
 }
 
 PD_EXPORT int PD_PredictorGetOutputNum(PD_Predictor* p) {

@@ -180,10 +180,15 @@ def fused_ln_path_available(x, rate: float = 0.0) -> bool:
     chain). Must not observe the value (deferred eager)."""
     if x.ndim < 2 or x.shape[-1] % 128:
         return False
-    hdim = int(x.shape[-1])
-    n = 1
-    for s in x.shape[:-1]:
-        n *= int(s)
+    try:
+        hdim = int(x.shape[-1])
+        n = 1
+        for s in x.shape[:-1]:
+            n *= int(s)
+    except Exception:
+        # symbolic dims (jax_export dynamic-batch tracing) cannot size the
+        # tiles — serve those traces through the unfused composition
+        return False
     if n == 0:
         return False
     # the derived row tile must be Mosaic-legal on BOTH layouts it serves:

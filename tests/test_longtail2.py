@@ -240,6 +240,9 @@ def test_shared_memory_tensor_cross_process():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     code = (
         "import sys, pickle; sys.path.insert(0, %r); "
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "  # don't
+        # contend for the exclusive TPU chip lock (a parallel bench would
+        # block this child past any timeout)
         "t = pickle.load(sys.stdin.buffer); "
         "import numpy as np; print(float(np.asarray(t.numpy()).sum()))" % repo)
     out = subprocess.run([sys.executable, "-c", code], input=blob,

@@ -114,6 +114,37 @@ def test_dynamic_batch_save_skips_native_artifact(tmp_path):
     assert not os.path.exists(prefix + ".pdnative")
 
 
+def test_dynamic_batch_save_with_fused_epilogue(tmp_path):
+    """Symbolic batch dims must not crash the fused-LN availability gate
+    (it sizes tiles with int(dim)); the save falls back to the unfused
+    composition and still exports the dynamic .pdmodel."""
+    import paddle_tpu.nn.functional as F
+
+    class WithEpilogue(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(128, 128)
+            self.norm = paddle.nn.LayerNorm(128)
+
+        def forward(self, x):
+            return F.add_dropout_ln(x, self.lin(x), self.norm.weight,
+                                    self.norm.bias, p=0.1, epsilon=1e-5,
+                                    training=False)
+
+    net = WithEpilogue()
+    net.eval()
+    prefix = str(tmp_path / "dynfused")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([-1, 4, 128],
+                                                        "float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    loaded = paddle.jit.load(prefix)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4, 128).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
 # NOTE: no in-process ctypes test on purpose — libtensorflow and jaxlib both
 # carry an XLA runtime, and loading the native library into a jax process
 # aborts on duplicate absl/protobuf registrations. The native runtime's
