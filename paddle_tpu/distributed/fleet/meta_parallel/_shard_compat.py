@@ -1,0 +1,34 @@
+"""jax version compatibility for the manual-collective (shard_map) modules.
+
+The ring/pipeline schedules are written against the current typed shard_map
+API (`jax.shard_map` + `jax.lax.pcast(..., to="varying")`). Older jax
+releases in some deployment images (0.4.x) keep shard_map in
+`jax.experimental` and have no varying-type system at all — there, values
+created inside the body are usable in cross-device collectives directly, so
+the marking is a no-op.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map", "pvary"]
+
+
+def pvary(x, axis_names):
+    """Mark `x` as device-varying over `axis_names` inside shard_map.
+
+    jax >= 0.7: `lax.pcast(..., to="varying")`; 0.5-0.6: `lax.pvary`;
+    0.4.x: no varying types — identity.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, tuple(axis_names))
+    return x

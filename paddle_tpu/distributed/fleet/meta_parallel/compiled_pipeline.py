@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ._shard_compat import pvary, shard_map
 
 __all__ = ["pipeline_apply", "CompiledPipeline"]
 
@@ -41,8 +42,8 @@ def _ring_body(w_local, xs, stage_fn, S: int, M: int, V: int, axis: str):
     outputs = jnp.zeros((M,) + xs.shape[1:], xs.dtype)
     # the carry holds per-DEVICE state (each stage's inbox differs), so mark it
     # varying over the pipe axis for the typed shard_map carry check
-    buf = jax.lax.pcast(buf, (axis,), to="varying")
-    outputs = jax.lax.pcast(outputs, (axis,), to="varying")
+    buf = pvary(buf, (axis,))
+    outputs = pvary(outputs, (axis,))
 
     def tick(carry, t):
         buf, outputs = carry

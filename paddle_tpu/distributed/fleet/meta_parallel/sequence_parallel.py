@@ -26,10 +26,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...env import get_mesh
+from ._shard_compat import pvary, shard_map
 
 __all__ = ["ring_attention", "shard_sequence", "gather_sequence"]
 
@@ -76,10 +76,8 @@ def _ring_attn_local(q, k, v, sm_scale: float, S: int, axis: str,
     # the carry varies over every axis the inputs are split on (sep + any
     # batch/head shardings that pass through), per typed-shard_map rules
     vary_all = tuple(dict.fromkeys((axis,) + tuple(vary)))
-    acc0 = jax.lax.pcast(jnp.zeros((B, H, L, D), jnp.float32), vary_all,
-                         to="varying")
-    lse0 = jax.lax.pcast(jnp.full((B, H, L), -jnp.inf, jnp.float32), vary_all,
-                         to="varying")
+    acc0 = pvary(jnp.zeros((B, H, L, D), jnp.float32), vary_all)
+    lse0 = pvary(jnp.full((B, H, L), -jnp.inf, jnp.float32), vary_all)
     (k_f, v_f, acc, lse), _ = jax.lax.scan(
         step, (k, v, acc0, lse0), jnp.arange(S))
     out = jnp.swapaxes(acc, 1, 2)                    # [B,L,H,D]

@@ -125,9 +125,16 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
     graph_sample_neighbors). Host-side eager op (data-dependent output size);
     perm_buffer (a GPU fisher-yates buffer) is accepted and ignored.
 
-    Returns (out_neighbors, out_count[, out_eids])."""
+    Returns (out_neighbors, out_count[, out_eids]).
+
+    Sampling randomness comes from the framework host RNG
+    (``core.random.host_generator()``, seeded by ``paddle.seed``) — NOT the
+    global numpy RNG — so graph sampling is reproducible per seed and
+    independent of other libraries touching ``np.random``."""
     if return_eids and eids is None:
         raise ValueError("`eids` should not be None if `return_eids` is True.")
+    from ..core.random import host_generator
+    gen = host_generator()
 
     def _np(x):
         # host-side op: numpy inputs keep their dtype (no jnp round-trip,
@@ -146,7 +153,7 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
         if sample_size < 0 or deg <= sample_size:
             pos = np.arange(beg, end)
         else:
-            pos = beg + np.random.choice(deg, size=sample_size, replace=False)
+            pos = beg + gen.choice(deg, size=sample_size, replace=False)
         sel_neighbors.append(rnp[pos])
         counts.append(len(pos))
         if return_eids:

@@ -1,3 +1,4 @@
 """hapi — high-level Model API (reference python/paddle/hapi)."""
 from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
+from .async_metrics import AsyncScalar, MetricDrain  # noqa: F401

@@ -1,0 +1,125 @@
+"""Non-blocking loss/metric readback for the hapi fit loop.
+
+The training step's loss is a device scalar; jax dispatch is asynchronous, so
+the scalar costs nothing until someone calls ``float()`` on it — at which
+point the host blocks on a device round-trip. The reference hapi loop (and
+our eager ``Model.fit``) forces that round-trip EVERY step just to fill the
+progress-bar logs, serializing host and device. The fix is the same
+bounded-staleness idea as tf.data metrics or torch_xla's ``xm.add_step_closure``:
+hold scalar *handles*, resolve them opportunistically when the device has
+already delivered them, and force a sync only every ``max_lag`` steps (and at
+epoch end), so the device round-trip happens once per window instead of once
+per step.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Tuple
+
+__all__ = ["AsyncScalar", "MetricDrain"]
+
+
+class AsyncScalar:
+    """Handle to a device scalar: blocks only when read.
+
+    jax arrays are already async futures; this wrapper just gives the fit
+    loop a uniform float-able object (``float(h)`` syncs, ``h.is_ready()``
+    polls) and a place to cache the resolved value so a handle is only ever
+    synced once.
+    """
+
+    __slots__ = ("_value", "_resolved")
+
+    def __init__(self, value):
+        self._value = value
+        self._resolved = None
+
+    def is_ready(self) -> bool:
+        if self._resolved is not None:
+            return True
+        probe = getattr(self._value, "is_ready", None)
+        if probe is None:
+            return True
+        try:
+            return bool(probe())
+        except Exception:
+            return True
+
+    def get(self) -> float:
+        if self._resolved is None:
+            self._resolved = float(self._value)
+            self._value = None  # drop the device buffer reference
+        return self._resolved
+
+    def __float__(self) -> float:
+        return self.get()
+
+    def __repr__(self):
+        if self._resolved is not None:
+            return f"AsyncScalar({self._resolved})"
+        return "AsyncScalar(<pending>)"
+
+
+def _resolve(values):
+    return [v.get() if isinstance(v, AsyncScalar) else v for v in values]
+
+
+class MetricDrain:
+    """Bounded-lag scalar drain.
+
+    ``push`` enqueues one step's scalar handles; ``ready()`` returns, in step
+    order, every entry that can be emitted *right now*: entries whose device
+    values have already landed (free), plus forced resolutions of the oldest
+    entries whenever more than ``max_lag`` steps are pending (the staleness
+    bound — a callback never observes a step more than ``max_lag`` behind the
+    dispatch frontier). ``flush`` resolves everything (epoch end).
+
+    ``forced_syncs`` counts how many entries had to block on the device —
+    the observable that the per-step round-trip is actually gone.
+    """
+
+    def __init__(self, max_lag: int = 8):
+        if max_lag < 1:
+            raise ValueError("max_lag must be >= 1")
+        self.max_lag = max_lag
+        self._pending = deque()  # (step, [AsyncScalar | float, ...])
+        self.forced_syncs = 0
+        self.free_syncs = 0
+
+    def __len__(self):
+        return len(self._pending)
+
+    def push(self, step: int, values) -> None:
+        self._pending.append((step, list(values)))
+
+    def _entry_ready(self, values) -> bool:
+        return all(v.is_ready() for v in values if isinstance(v, AsyncScalar))
+
+    def ready(self) -> List[Tuple[int, list]]:
+        """Pop resolvable entries in order; forces the oldest past the lag
+        bound, then keeps popping whatever is already device-complete."""
+        out = []
+        while self._pending:
+            step, values = self._pending[0]
+            if len(self._pending) > self.max_lag:
+                self.forced_syncs += sum(
+                    1 for v in values
+                    if isinstance(v, AsyncScalar) and not v.is_ready())
+            elif not self._entry_ready(values):
+                break
+            else:
+                self.free_syncs += 1
+            self._pending.popleft()
+            out.append((step, _resolve(values)))
+        return out
+
+    def flush(self) -> List[Tuple[int, list]]:
+        """Resolve every pending entry (epoch end / train end)."""
+        out = []
+        while self._pending:
+            step, values = self._pending.popleft()
+            self.forced_syncs += sum(
+                1 for v in values
+                if isinstance(v, AsyncScalar) and not v.is_ready())
+            out.append((step, _resolve(values)))
+        return out

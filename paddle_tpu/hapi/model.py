@@ -16,9 +16,26 @@ import numpy as np
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor, to_tensor
 from ..nn.layer import Layer
+from .async_metrics import AsyncScalar, MetricDrain
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
+
+
+class _LossNet(Layer):
+    """network + loss fused into one Layer so TrainStep can compile
+    forward → loss → backward → update as a single executable."""
+
+    def __init__(self, network: Layer, loss_fn, n_labels: int):
+        super().__init__()
+        self.net = network
+        self._loss_fn = loss_fn
+        self._n_labels = n_labels
+
+    def forward(self, *args):
+        split = len(args) - self._n_labels
+        outs = self.net(*args[:split])
+        return self._loss_fn(outs, *args[split:])
 
 
 def _as_batch_tensors(data):
@@ -43,10 +60,13 @@ class Model:
         self._metrics = []
         self.stop_training = False
         self._save_dir = None
+        self._jit_compile = False
+        self._train_step = None
 
     # -------------------------------------------------------------- prepare
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                jit_compile: bool = False):
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -55,26 +75,63 @@ class Model:
             self._metrics = list(metrics)
         else:
             self._metrics = [metrics]
+        if jit_compile and self._metrics:
+            raise ValueError(
+                "jit_compile=True trains through jit.TrainStep, which returns "
+                "only the loss; hapi metrics need eager outputs — drop the "
+                "metrics or jit_compile")
+        self._jit_compile = jit_compile
+        self._train_step = None
         return self
 
     # -------------------------------------------------------------- batches
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, sync=True):
+        """One optimizer step. ``sync=False`` returns the loss as an
+        :class:`AsyncScalar` handle instead of forcing a device round-trip —
+        the fit loop's ``metric_lag`` path resolves it with bounded lag."""
         self.network.train()
         inputs = _as_batch_tensors(inputs)
         labels = _as_batch_tensors(labels) if labels is not None else []
+        if self._jit_compile and self._optimizer is not None:
+            if not update:
+                # the eager path would accumulate p._grad across calls, but
+                # the TrainStep executable computes grads from its own batch
+                # only and never reads the tape — mixing them silently drops
+                # the accumulated batches, so refuse loudly
+                raise ValueError(
+                    "prepare(jit_compile=True) compiles forward+backward+"
+                    "update into one TrainStep executable; gradient "
+                    "accumulation via train_batch(update=False) is not "
+                    "supported there — use jit_compile=False for "
+                    "accumulation")
+            step = self._ensure_train_step(len(labels))
+            loss = step(*inputs, *labels)
+            # same return shape as the eager no-metrics path: a bare scalar
+            return float(loss) if sync else AsyncScalar(loss.value())
         outs = self.network(*inputs)
         loss = self._loss(outs, *labels) if self._loss else outs
         loss.backward()
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
-        metrics = [float(loss)]
+        metrics = [float(loss) if sync else AsyncScalar(loss.value())]
         for m in self._metrics:
             m.update(*[x.numpy() for x in
                        self._metric_inputs(m, outs, labels)])
             metrics.append(m.accumulate())
         return metrics if len(metrics) > 1 else metrics[0]
+
+    def _ensure_train_step(self, n_labels: int):
+        """Build the one-executable TrainStep behind prepare(jit_compile=True)
+        lazily (label arity is only known at the first batch)."""
+        if self._train_step is None:
+            from ..jit import TrainStep
+            net = self.network
+            if self._loss is not None:
+                net = _LossNet(self.network, self._loss, n_labels)
+            self._train_step = TrainStep(net, self._optimizer)
+        return self._train_step
 
     @no_grad()
     def eval_batch(self, inputs, labels=None):
@@ -111,7 +168,7 @@ class Model:
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir: Optional[str] = None, save_freq: int = 1,
             verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
-            num_workers: int = 0, callbacks=None):
+            num_workers: int = 0, callbacks=None, metric_lag: int = 0):
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
@@ -135,12 +192,38 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
-                cbks.on_train_batch_begin(step)
-                ins, lbs = self._split_batch(batch)
-                res = self.train_batch(ins, lbs)
-                logs = self._logs_from(res)
-                cbks.on_train_batch_end(step, logs)
+            if metric_lag > 0:
+                if self._metrics and epoch == 0:
+                    import warnings
+                    warnings.warn(
+                        "fit(metric_lag=...) defers only the LOSS readback; "
+                        "hapi metrics update from eager outputs via .numpy() "
+                        "and force a device sync every step regardless — "
+                        "drop the metrics (or compute them at eval time) to "
+                        "actually overlap readback", stacklevel=2)
+                # non-blocking readback: hold loss handles, resolve them when
+                # the device has already delivered (free) or after at most
+                # metric_lag steps (bounded staleness); callbacks still see
+                # every step in order
+                drain = MetricDrain(max_lag=metric_lag)
+                for step, batch in enumerate(train_loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, lbs = self._split_batch(batch)
+                    res = self.train_batch(ins, lbs, sync=False)
+                    drain.push(step, res if isinstance(res, list) else [res])
+                    for s, vals in drain.ready():
+                        logs = self._logs_from(vals)
+                        cbks.on_train_batch_end(s, logs)
+                for s, vals in drain.flush():  # epoch-end sync point
+                    logs = self._logs_from(vals)
+                    cbks.on_train_batch_end(s, logs)
+            else:
+                for step, batch in enumerate(train_loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, lbs = self._split_batch(batch)
+                    res = self.train_batch(ins, lbs)
+                    logs = self._logs_from(res)
+                    cbks.on_train_batch_end(step, logs)
             cbks.on_epoch_end(epoch, logs)
             history.append(logs)
 

@@ -99,6 +99,16 @@ xla::PjRtClient* client() {
   return c.get();
 }
 
+// Header sanity bounds: a corrupt/truncated .pdnative must fail the load
+// cleanly instead of driving nbytes() into overflow (and the subsequent
+// std::string(nbytes, 0) into a bad_alloc or a huge read). Generous for any
+// real model, fatal for garbage.
+constexpr int kMaxNdim = 32;
+constexpr int64_t kMaxDimExtent = int64_t{1} << 40;
+constexpr size_t kMaxTensorBytes = size_t{1} << 40;  // 1 TiB per tensor
+constexpr size_t kMaxTensorCount = size_t{1} << 20;
+constexpr size_t kMaxHloBytes = size_t{1} << 32;     // 4 GiB program
+
 struct Model {
   std::vector<TensorMeta> params, inputs, outputs;
   std::unique_ptr<xla::PjRtLoadedExecutable> exe;
@@ -148,18 +158,29 @@ bool Model::load(const std::string& prefix) {
     std::string kw;
     size_t n = 0;
     f >> kw >> n;
-    if (kw != std::string("n") + want + "s") return false;
+    // every extraction is checked before its value is trusted: a truncated
+    // stream leaves garbage in the variables (and f in a fail state)
+    if (!f || kw != std::string("n") + want + "s" || n > kMaxTensorCount)
+      return false;
     for (size_t i = 0; i < n; ++i) {
       TensorMeta m;
       std::string kind;
       int ndim = 0;
       f >> kind >> m.name >> m.dtype >> ndim;
+      if (!f || kind != want || m.item_size() == 0) return false;
+      if (ndim < 0 || ndim > kMaxNdim) return false;
+      size_t elems = 1;
       for (int d = 0; d < ndim; ++d) {
         int64_t v;
         f >> v;
+        if (!f || v < 0 || v > kMaxDimExtent) return false;
+        // overflow-guarded running product; total payload stays bounded
+        if (v != 0 &&
+            elems > kMaxTensorBytes / (static_cast<size_t>(v) * m.item_size()))
+          return false;
+        elems *= static_cast<size_t>(v);
         m.dims.push_back(v);
       }
-      if (kind != want || m.item_size() == 0) return false;
       out->push_back(std::move(m));
     }
     return true;
@@ -170,7 +191,8 @@ bool Model::load(const std::string& prefix) {
   std::string kw;
   size_t hlo_bytes = 0;
   f >> kw >> hlo_bytes;
-  if (kw != "hlo") return false;
+  if (!f || kw != "hlo" || hlo_bytes == 0 || hlo_bytes > kMaxHloBytes)
+    return false;
   f.get();  // the newline after the header
   std::string blob(hlo_bytes, '\0');
   f.read(&blob[0], static_cast<std::streamsize>(hlo_bytes));
@@ -192,6 +214,29 @@ bool Model::load(const std::string& prefix) {
     return false;
   }
   exe = std::move(*exe_or);
+
+  // exact payload check: the raw param buffers are the tail of the file, so
+  // their claimed sizes can never exceed the bytes actually remaining. This
+  // is the real guard against huge-but-in-bounds dims — on overcommitting
+  // kernels a 256 GiB std::string does not throw, it grinds the host into
+  // the OOM killer while zero-filling pages.
+  const std::streampos data_pos = f.tellg();
+  f.seekg(0, std::ios::end);
+  const std::streampos end_pos = f.tellg();
+  f.seekg(data_pos);
+  if (!f || end_pos < data_pos) return false;
+  size_t remaining = static_cast<size_t>(end_pos - data_pos);
+  for (const auto& m : params) {
+    const size_t nb = m.nbytes();
+    if (nb > remaining) {
+      std::fprintf(stderr,
+                   "paddle_native: param %s claims %zu bytes but only %zu "
+                   "remain in the artifact\n",
+                   m.name.c_str(), nb, remaining);
+      return false;
+    }
+    remaining -= nb;
+  }
 
   for (const auto& m : params) {
     std::string bytes(m.nbytes(), '\0');
@@ -235,7 +280,7 @@ bool Model::run() {
   // ExecuteSharded on the explicit device, fill_future=false: the plain
   // Execute path walks the compile-time device assignment (not set by our
   // default CompileOptions) and crashed inside the CPU client
-  std::optional<xla::Future<>> future;
+  std::optional<xla::PjRtFuture<>> future;
   auto r = exe->ExecuteSharded(
       absl::Span<xla::PjRtBuffer* const>(args),
       client()->addressable_devices()[0], opts, future,
@@ -282,7 +327,19 @@ PD_EXPORT void PD_ConfigDestroy(PD_Config* c) { delete c; }
 PD_EXPORT PD_Predictor* PD_PredictorCreate(PD_Config* c) {
   if (!c) return nullptr;
   auto* p = new PD_Predictor();
-  if (!p->model.load(c->model)) {
+  // the C ABI must not leak exceptions: a corrupt header can declare dims
+  // that pass the sanity bounds yet still exceed memory (std::bad_alloc from
+  // the param staging string) — terminate()ing the host process would defeat
+  // the fail-cleanly contract
+  bool ok = false;
+  try {
+    ok = p->model.load(c->model);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "paddle_native: load threw: %s\n", e.what());
+  } catch (...) {
+    std::fprintf(stderr, "paddle_native: load threw unknown exception\n");
+  }
+  if (!ok) {
     delete p;
     return nullptr;
   }
@@ -295,14 +352,24 @@ PD_EXPORT int PD_PredictorSetInput(PD_Predictor* p, const char* name, const void
                          const long long* shape, int ndim,
                          const char* dtype) {
   if (!p) return -1;
-  return p->model.set_input(name, data, shape, ndim, dtype) ? 0 : -1;
+  try {
+    return p->model.set_input(name, data, shape, ndim, dtype) ? 0 : -1;
+  } catch (...) {
+    std::fprintf(stderr, "paddle_native: set_input threw\n");
+    return -1;
+  }
 }
 
 // returns the number of outputs, or -1 (matching the CPython-bridge ABI)
 PD_EXPORT int PD_PredictorRun(PD_Predictor* p) {
   if (!p) return -1;
-  if (!p->model.run()) return -1;
-  return static_cast<int>(p->model.outs.size());
+  try {
+    if (!p->model.run()) return -1;
+    return static_cast<int>(p->model.outs.size());
+  } catch (...) {
+    std::fprintf(stderr, "paddle_native: run threw\n");
+    return -1;
+  }
 }
 
 PD_EXPORT int PD_PredictorGetOutputNum(PD_Predictor* p) {

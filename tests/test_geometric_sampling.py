@@ -154,3 +154,24 @@ def test_send_uv():
     out = G.send_uv(x, y, src, dst, message_op="mul")
     np.testing.assert_allclose(
         out.numpy(), x.numpy()[[0, 1, 2]] * y.numpy()[[1, 2, 3]])
+
+
+def test_sample_neighbors_reproducible_under_paddle_seed():
+    """Sampling routes through the framework RNG: same paddle.seed -> same
+    draw, regardless of the global numpy RNG state."""
+    nodes = np.array([0, 1, 5, 6], "int64")
+    paddle.seed(123)
+    np.random.seed(0)
+    a1, c1 = G.sample_neighbors(_t(ROW), _t(COLPTR), _t(nodes), sample_size=1)
+    paddle.seed(123)
+    np.random.seed(999)  # global numpy RNG must not matter
+    a2, c2 = G.sample_neighbors(_t(ROW), _t(COLPTR), _t(nodes), sample_size=1)
+    np.testing.assert_array_equal(a1.numpy(), a2.numpy())
+    np.testing.assert_array_equal(c1.numpy(), c2.numpy())
+    # and a different seed draws a different stream eventually: statistical
+    # smoke only — degree-1 nodes can't differ, so check the multi-degree ones
+    paddle.seed(7)
+    draws = {tuple(G.sample_neighbors(_t(ROW), _t(COLPTR), _t(nodes),
+                                      sample_size=1)[0].numpy())
+             for _ in range(8)}
+    assert len(draws) > 1

@@ -173,3 +173,73 @@ def test_native_runtime_from_pure_c_program(saved_fixed_model, native_lib,
     got = np.asarray([float(v) for v in proc.stdout.split()],
                      np.float32).reshape(3, 4)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_native_runtime_rejects_corrupt_header_cleanly(saved_fixed_model,
+                                                       native_lib, tmp_path):
+    """A corrupt .pdnative header (absurd ndim / negative dims / truncation)
+    must fail PD_PredictorCreate cleanly (rc=3 from the C driver) — not
+    overflow nbytes() into a giant allocation, crash, or hang."""
+    prefix, _ = saved_fixed_model
+    with open(prefix + ".pdnative", "rb") as fh:
+        blob = fh.read()
+
+    def run_with(corrupt_bytes, name):
+        d = tmp_path / name
+        d.mkdir()
+        cprefix = str(d / "net")
+        with open(cprefix + ".pdnative", "wb") as fh:
+            fh.write(corrupt_bytes)
+        csrc = tmp_path / f"{name}.c"
+        csrc.write_text(textwrap.dedent(_C_PROGRAM))
+        exe = str(tmp_path / f"{name}_demo")
+        subprocess.run(["gcc", str(csrc), "-o", exe, "-ldl"], check=True)
+        x = np.zeros((3, 8), np.float32)
+        xfile = str(tmp_path / f"{name}_x.bin")
+        x.tofile(xfile)
+        return subprocess.run([exe, native_lib, cprefix, xfile],
+                              env=dict(os.environ), capture_output=True,
+                              text=True, timeout=120)
+
+    head, rest = blob.split(b"\n", 1)
+    first_param = rest.split(b"\n", 1)[0]
+
+    # absurd ndim on the first param
+    nline, pline = rest.split(b"\n", 2)[0], rest.split(b"\n", 2)[1]
+    p_toks = pline.split(b" ")
+    p_toks[3] = b"1000000"  # ndim
+    bad_ndim = head + b"\n" + nline + b"\n" + b" ".join(p_toks) + b"\n" + \
+        rest.split(b"\n", 2)[2]
+    proc = run_with(bad_ndim, "bad_ndim")
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+
+    # negative dim
+    p2 = pline.split(b" ")
+    p2[4] = b"-8"
+    bad_dim = head + b"\n" + nline + b"\n" + b" ".join(p2) + b"\n" + \
+        rest.split(b"\n", 2)[2]
+    proc = run_with(bad_dim, "bad_dim")
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+
+    # truncated mid-header
+    proc = run_with(blob[: len(head) + len(first_param) // 2], "truncated")
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+
+    # huge dim extent that would overflow nbytes()
+    p3 = pline.split(b" ")
+    p3[4] = str(2 ** 62).encode()
+    bad_huge = head + b"\n" + nline + b"\n" + b" ".join(p3) + b"\n" + \
+        rest.split(b"\n", 2)[2]
+    proc = run_with(bad_huge, "bad_huge")
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+
+    # huge-but-in-bounds dims (256 GiB tensor): passes the extent checks but
+    # must fail as a clean rc=3 via the C-ABI exception guard, not bad_alloc
+    # -> std::terminate
+    p4 = pline.split(b" ")
+    p4[3] = b"1"
+    p4[4:] = [str(2 ** 36).encode()]
+    bad_alloc = head + b"\n" + nline + b"\n" + b" ".join(p4) + b"\n" + \
+        rest.split(b"\n", 2)[2]
+    proc = run_with(bad_alloc, "bad_alloc")
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
