@@ -47,6 +47,7 @@ save = _io.save
 load = _io.load
 
 from . import nn  # noqa: F401,E402
+from . import monitor  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
@@ -83,6 +84,10 @@ from . import dataset  # noqa: F401,E402
 from . import strings  # noqa: F401,E402
 from . import _C_ops  # noqa: F401,E402
 DataParallel = distributed.DataParallel
+
+# always-on telemetry env opt-in (PADDLE_MONITOR=<jsonl path|1>); after all
+# subsystem imports so the dispatch hooks land on the fully-built registry
+monitor._maybe_enable_from_env()
 
 
 def disable_static(place=None):  # parity no-op: eager is the default (and only) base mode

@@ -53,6 +53,21 @@ def set_profiler_hook(hook: Optional[Callable[[str, float, float], None]]):
     _PROFILER_HOOK = hook
 
 
+# monitor hooks (paddle_tpu.monitor): op-mix counter fn(op_name) invoked per
+# dispatch, and fn(op_name, attr_key) invoked once per NEW per-op executable
+# (lru miss in the caches below). Both None when the monitor is disabled —
+# the hot path pays one global read + None check, same deal as the profiler.
+_MONITOR_OP: Optional[Callable[[str], None]] = None
+_MONITOR_COMPILE: Optional[Callable[[str, Tuple], None]] = None
+
+
+def set_monitor_hooks(op_hook: Optional[Callable[[str], None]],
+                      compile_hook: Optional[Callable[[str, Tuple], None]]):
+    global _MONITOR_OP, _MONITOR_COMPILE
+    _MONITOR_OP = op_hook
+    _MONITOR_COMPILE = compile_hook
+
+
 # (name, attr_key, diff_idx, n_in) -> registered vjp-op name (double grad)
 _VJP_NAMES: Dict[Tuple, str] = {}
 
@@ -190,6 +205,9 @@ def _fwd_exec(name: str, attr_key: Tuple):
     op = _REGISTRY[name]
     attrs = dict((k, v) for k, v in attr_key)
     fn = functools.partial(op.fwd, **attrs) if attrs else op.fwd
+    ch = _MONITOR_COMPILE
+    if ch is not None:
+        ch(name, attr_key)
     return jax.jit(fn)
 
 
@@ -218,6 +236,9 @@ def _bwd_exec(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
         _, vjp_fn = jax.vjp(f, *[primals[i] for i in diff_idx])
         return vjp_fn(tuple(cotangents))
 
+    ch = _MONITOR_COMPILE
+    if ch is not None:
+        ch(f"{name}@grad", attr_key)
     return jax.jit(bwd)
 
 
@@ -266,6 +287,9 @@ def _bwd_call(name: str, attr_key: Tuple, diff_idx: Tuple[int, ...], n_in: int):
             # host tracer records *_grad ops; profilers and coverage gates
             # see the backward under "name@grad")
             hook(f"{name}@grad", t0, _time.perf_counter())
+        mon = _MONITOR_OP
+        if mon is not None:
+            mon(f"{name}@grad")
         return out
 
     return call
@@ -303,6 +327,9 @@ def _explicit_bwd_call(name: str, attr_key: Tuple):
                                                      cotangents)
         if hook is not None:
             hook(f"{name}@grad", t0, _time.perf_counter())
+        mon = _MONITOR_OP
+        if mon is not None:
+            mon(f"{name}@grad")
         return res
 
     return call
@@ -393,6 +420,9 @@ def apply_op(name: str, tensor_args: Sequence, attrs: Optional[dict] = None):
         # host-side dispatch cost (the reference host tracer's op event analog;
         # device time lives in the jax profiler trace)
         hook(name, t0, _time.perf_counter())
+    mon = _MONITOR_OP
+    if mon is not None:
+        mon(name)
 
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)

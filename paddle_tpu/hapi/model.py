@@ -9,10 +9,12 @@ or from the ambient mesh placements.
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor, to_tensor
 from ..nn.layer import Layer
@@ -183,12 +185,29 @@ class Model:
 
         cbks.on_train_begin()
         history = []
+        try:
+            history = self._fit_loop(train_loader, eval_loader, epochs,
+                                     eval_freq, steps, verbose, cbks,
+                                     metric_lag)
+        except BaseException as e:
+            # flight-recorder post-mortem of the crashed run (no-op when the
+            # monitor is disabled)
+            _monitor.on_crash(e)
+            raise
+        cbks.on_train_end()
+        return history
+
+    def _fit_loop(self, train_loader, eval_loader, epochs, eval_freq, steps,
+                  verbose, cbks, metric_lag):
+        history = []
         for epoch in range(epochs):
             if self.stop_training:
                 break
             cbks.set_params({"epochs": epochs, "steps": steps, "epoch": epoch,
                              "verbose": verbose})
             cbks.on_epoch_begin(epoch)
+            t_epoch = time.perf_counter()
+            step = -1
             for m in self._metrics:
                 m.reset()
             logs = {}
@@ -225,13 +244,17 @@ class Model:
                     logs = self._logs_from(res)
                     cbks.on_train_batch_end(step, logs)
             cbks.on_epoch_end(epoch, logs)
+            mon = _monitor._active
+            if mon is not None:
+                mon.epoch_event(epoch, steps=step + 1,
+                                wall_s=time.perf_counter() - t_epoch,
+                                logs=logs)
             history.append(logs)
 
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self._run_eval(eval_loader, cbks)
                 history[-1] = {**logs, **{f"eval_{k}": v
                                           for k, v in eval_logs.items()}}
-        cbks.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,

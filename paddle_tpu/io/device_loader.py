@@ -32,6 +32,7 @@ from typing import Callable, Optional, Union
 import jax
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
 
 __all__ = ["DeviceLoader", "batch_sharding"]
@@ -137,7 +138,8 @@ class _DeviceIterator:
             raise StopIteration
         t0 = time.perf_counter()
         item = self._q.get()
-        _emit_stage("device_loader/wait", t0, time.perf_counter())
+        t1 = time.perf_counter()
+        _emit_stage("device_loader/wait", t0, t1)
         if item is _END:
             self._done = True
             err = self._state["err"]
@@ -145,6 +147,12 @@ class _DeviceIterator:
                 self._state["err"] = None
                 raise err
             raise StopIteration
+        mon = _monitor._active
+        if mon is not None:
+            # feed-health telemetry: queue depth gauge + stall counter (a
+            # blocking get means the producer lost the race this step; the
+            # terminal END wait above is epoch teardown, not a stall)
+            mon.loader_wait(t1 - t0, self._q.qsize())
         return item
 
     def close(self):

@@ -18,6 +18,7 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from .. import monitor as _monitor
 from ..core import dispatch
 from ..core import random as _random
 from ..core.tensor import Parameter, Tensor
@@ -60,6 +61,10 @@ class TrainStep:
         self._fast = {}
         self._fast_state = None
         self._fast_meta = None
+        # recompile-sentinel state: the previous step's input signature, so a
+        # recompile event can name exactly which leaves diverged (only
+        # maintained while the monitor is enabled — zero stores otherwise)
+        self._mon_prev_sig = None
         self._opt._ensure_all_states()
         # ZeRO / hybrid optimizers place their states on construction paths that
         # run inside step(); trigger placement explicitly when present
@@ -222,17 +227,44 @@ class TrainStep:
     # ------------------------------------------------------------------ call
 
     def __call__(self, *inputs):
+        try:
+            return self._call_impl(inputs)
+        except BaseException as e:
+            # flight-recorder post-mortem: dump the recent-event ring before
+            # the exception unwinds out of the training loop
+            _monitor.on_crash(e)
+            raise
+
+    def _call_impl(self, inputs):
         input_arrays = tuple(t.value() if isinstance(t, Tensor) else jnp.asarray(t)
                              for t in inputs)
         if self._fast_path:
             return self._fast_call(input_arrays)
         if self._compiled is None:
             self._build(input_arrays)
+        mon = _monitor._active
+        # jit trace-cache size before the call: a growth across the call IS a
+        # recompile (the slow path compiles lazily inside __call__)
+        n0 = self._compiled._cache_size() if mon is not None else 0
         param_arrays, masters, states, buffer_arrays, scalars = \
             self._gather_args()
 
+        t0 = time.perf_counter() if mon is not None else 0.0
         loss, new_params, new_masters, new_states, new_buffers = self._compiled(
             param_arrays, masters, states, buffer_arrays, scalars, input_arrays)
+
+        if mon is not None:
+            sig = self._input_sig(input_arrays)
+            n1 = self._compiled._cache_size()
+            if n1 > n0:
+                mon.train_step_compiled(sig, self._mon_prev_sig,
+                                        compile_s=None, count=n1, path="jit")
+            else:
+                # steady-state dispatch latency; a cache-miss call is compile
+                # time, not dispatch, and is already covered by the recompile
+                # event
+                mon.step_event(time.perf_counter() - t0)
+            self._mon_prev_sig = sig
 
         opt = self._opt
         with dispatch.no_grad():
@@ -280,8 +312,18 @@ class TrainStep:
         if self._compiled is None:
             self._build(input_arrays)
         args = self._gather_args()
+        t_c = time.perf_counter()
         exe = self._compiled.lower(*args, input_arrays).compile()
-        self._fast[self._input_sig(input_arrays)] = exe
+        compile_s = time.perf_counter() - t_c
+        sig = self._input_sig(input_arrays)
+        self._fast[sig] = exe
+        mon = _monitor._active
+        if mon is not None:
+            # recompile sentinel: new AOT shape bucket — event carries the
+            # offending signature, compile wall-time, running executable
+            # count, and the executable's memory_analysis() as HBM gauges
+            mon.train_step_compiled(sig, self._mon_prev_sig, compile_s,
+                                    len(self._fast), "aot", compiled=exe)
         if self._fast_meta is None:
             opt = self._opt
             self._fast_meta = [
@@ -334,19 +376,28 @@ class TrainStep:
 
     def _fast_call(self, input_arrays):
         opt = self._opt
-        exe = self._fast.get(self._input_sig(input_arrays))
+        mon = _monitor._active
+        sig = self._input_sig(input_arrays)
+        exe = self._fast.get(sig)
         if exe is None:
             exe, scalars = self._build_fast(input_arrays)
         else:
             self._refresh_fast_state()
             scalars = opt._scalars(opt.get_lr())
+        if mon is not None:
+            self._mon_prev_sig = sig
         st = self._fast_state
 
-        t0 = time.perf_counter() if _prof_recorder.enabled else 0.0
+        t0 = time.perf_counter() if (_prof_recorder.enabled
+                                     or mon is not None) else 0.0
         loss, new_params, new_masters, new_states, new_buffers = exe(
             st[0], st[1], st[2], st[3], scalars, input_arrays)
         if t0:
-            record_stage("train_step/dispatch", t0, time.perf_counter())
+            t1 = time.perf_counter()
+            if _prof_recorder.enabled:
+                record_stage("train_step/dispatch", t0, t1)
+            if mon is not None:
+                mon.step_event(t1 - t0)
 
         # outputs become next step's inputs verbatim (donation-friendly: the
         # just-invalidated input buffers are replaced wholesale)

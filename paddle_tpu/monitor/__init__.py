@@ -1,0 +1,379 @@
+"""paddle_tpu.monitor — always-on structured runtime telemetry.
+
+The profiler (paddle_tpu.profiler) answers "where did this traced window's
+time go"; this subsystem answers "what is the run doing, all the time":
+
+* a metric **registry** (Counter/Gauge/Histogram) + buffered **JSONL sink**
+  — one schema-versioned record per step/event, per-process files under the
+  distributed launcher contract;
+* a **recompile sentinel** — every TrainStep trace-cache miss / new AOT
+  shape bucket emits the offending input signature, compile wall-time and a
+  running count, with a ``warn_after=N`` diagnostic naming the divergent
+  leaf shapes (the io/bucketing.py contract's runtime enforcement);
+* **memory accounting** — per-bucket HBM estimates from
+  ``compiled.memory_analysis()`` as gauges, plus a live-array census;
+* a **flight recorder** — a bounded ring of recent events dumped to JSON on
+  uncaught exceptions in ``TrainStep``/``Model.fit`` (or ``dump()``).
+
+Enable with ``monitor.enable("run.jsonl")`` or env ``PADDLE_MONITOR=path``.
+Disabled cost: every integration point guards on one module-global
+``monitor._active is None`` check (same pattern as the profiler hook), so
+the hot path stays a no-op.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+import warnings
+from typing import Optional
+
+from .memory import executable_memory_stats, live_array_census
+from .recorder import FlightRecorder
+from .registry import Counter, Gauge, Histogram, Registry
+from .sink import SCHEMA_VERSION, JsonlSink, resolve_sink_path
+
+__all__ = ["enable", "disable", "enabled", "get", "emit", "dump",
+           "counter", "gauge", "histogram", "snapshot",
+           "live_array_census", "executable_memory_stats",
+           "Monitor", "Registry", "Counter", "Gauge", "Histogram",
+           "SCHEMA_VERSION"]
+
+# THE hot-path flag: integration points read this one module global and do
+# nothing when it is None. Everything else in this file is cold path.
+_active: Optional["Monitor"] = None
+
+_lock = threading.Lock()
+
+# consumer-visible stall threshold: a q.get() that returns in under 1ms was
+# not a stall, it was queue bookkeeping
+_STALL_S = 1e-3
+
+
+def _sig_json(sig):
+    """Input signature tuple -> JSON-ready list (shapes/dtypes/shardings)."""
+    out = []
+    for entry in sig:
+        try:
+            shape, dtype, sharding = entry
+            out.append({"shape": list(shape), "dtype": str(dtype),
+                        "sharding": str(sharding)})
+        except Exception:
+            out.append({"repr": repr(entry)})
+    return out
+
+
+def _sig_divergence(prev, new):
+    """Name the leaves that changed between two input signatures — the
+    actionable half of a recompile event ("input[1].shape (16,128)->(16,256)"
+    points straight at the bucketing boundary that leaked)."""
+    if prev is None:
+        return []
+    diffs = []
+    if len(prev) != len(new):
+        diffs.append(f"arity {len(prev)}->{len(new)}")
+    for i, (p, n) in enumerate(zip(prev, new)):
+        pshape, pdt, pshard = p
+        nshape, ndt, nshard = n
+        if tuple(pshape) != tuple(nshape):
+            diffs.append(f"input[{i}].shape {tuple(pshape)}->{tuple(nshape)}")
+        if str(pdt) != str(ndt):
+            diffs.append(f"input[{i}].dtype {pdt}->{ndt}")
+        if str(pshard) != str(nshard):
+            diffs.append(f"input[{i}].sharding {pshard}->{nshard}")
+    return diffs
+
+
+class Monitor:
+    """One enabled telemetry session (registry + sink + flight recorder)."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 warn_after: Optional[int] = None, flush_every: int = 64,
+                 ring: int = 256):
+        self.registry = Registry()
+        self.sink = JsonlSink(path, flush_every) if path else None
+        self.flight = FlightRecorder(ring)
+        self.warn_after = warn_after
+        self._op_counts = {}
+        self._op_compiles = 0
+        self._t0 = time.time()
+        self.emit("meta", schema=SCHEMA_VERSION, pid=os.getpid(),
+                  proc=int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0),
+                  start=self._t0)
+
+    # ------------------------------------------------------------- plumbing
+
+    def emit(self, kind: str, **fields):
+        """One event record: into the flight-recorder ring always, into the
+        JSONL sink when one is attached."""
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind}
+        rec.update(fields)
+        self.flight.push(rec)
+        if self.sink is not None:
+            self.sink.write(rec)
+        return rec
+
+    def _emit_counters(self):
+        snap = self.registry.snapshot()
+        # copy first: op_hook inserts first-seen op names from other threads,
+        # and iterating the live dict would raise mid-dump
+        snap["counters"].update({f"op/{k}": v
+                                 for k, v in sorted(dict(self._op_counts)
+                                                    .items())})
+        self.emit("counters", metrics=snap)
+        return snap
+
+    def flush(self):
+        if self.sink is not None:
+            self.sink.flush()
+
+    def close(self):
+        self._emit_counters()
+        if self.sink is not None:
+            self.sink.close()
+
+    # -------------------------------------------------- integration: dispatch
+
+    def op_hook(self, name: str):
+        # dict.get + store under the GIL; a rare lost increment is acceptable
+        # for an op-mix profile, a per-op lock on the eager hot path is not
+        c = self._op_counts
+        c[name] = c.get(name, 0) + 1
+
+    def op_compile_hook(self, name: str, attr_key):
+        self._op_compiles += 1
+        self.registry.counter("dispatch/op_compiles").inc()
+        self.emit("op_compile", name=name, attrs=repr(attr_key),
+                  count=self._op_compiles)
+
+    # ------------------------------------------------ integration: train step
+
+    def train_step_compiled(self, sig, prev_sig, compile_s: Optional[float],
+                            count: int, path: str, compiled=None):
+        """Recompile-sentinel entry: a TrainStep minted a new executable.
+
+        path: "aot" (fast-path shape bucket) | "jit" (slow-path trace-cache
+        miss). Emits the recompile event, memory gauges for the new
+        executable, and the warn_after diagnostic.
+        """
+        self.registry.counter("train_step/recompiles").inc()
+        self.registry.gauge("train_step/executables").set(count)
+        if compile_s is not None:
+            self.registry.histogram("train_step/compile_s").observe(compile_s)
+        divergent = _sig_divergence(prev_sig, sig)
+        self.emit("recompile", path=path, count=count, compile_s=compile_s,
+                  sig=_sig_json(sig), divergent=divergent)
+        if compiled is not None:
+            stats = executable_memory_stats(compiled)
+            if stats is not None:
+                g = self.registry.gauge
+                g(f"train_step/bucket{count}/argument_bytes").set(
+                    stats["argument_bytes"])
+                g(f"train_step/bucket{count}/output_bytes").set(
+                    stats["output_bytes"])
+                g(f"train_step/bucket{count}/temp_bytes").set(
+                    stats["temp_bytes"])
+                g(f"train_step/bucket{count}/total_bytes").set(
+                    stats["total_bytes"])
+                peak = self.registry.gauge("train_step/hbm_peak_bytes")
+                if stats["total_bytes"] > peak.value:
+                    peak.set(stats["total_bytes"])
+                self.emit("memory", bucket=count, sig=_sig_json(sig), **stats)
+        if self.warn_after is not None and count > self.warn_after:
+            why = "; ".join(divergent) if divergent \
+                else "first signature unknown"
+            warnings.warn(
+                f"TrainStep recompiled {count} executables "
+                f"(warn_after={self.warn_after}): {why}. Unplanned shape "
+                f"churn defeats the bucketing contract (io/bucketing.py) — "
+                f"pad inputs to fixed boundaries or add the new shape to the "
+                f"bucket set.", RuntimeWarning, stacklevel=3)
+
+    def step_event(self, dur_s: float):
+        self.registry.counter("train_step/steps").inc()
+        self.registry.histogram("train_step/dispatch_s").observe(dur_s)
+        self.emit("step", dur_s=dur_s)
+
+    # ---------------------------------------------------- integration: loader
+
+    def loader_wait(self, wait_s: float, qsize: int):
+        self.registry.counter("loader/batches").inc()
+        self.registry.gauge("loader/queue_depth").set(qsize)
+        self.registry.histogram("loader/wait_s").observe(wait_s)
+        if wait_s > _STALL_S:
+            self.registry.counter("loader/stalls").inc()
+            self.emit("loader_stall", wait_s=wait_s, qsize=qsize)
+
+    # ------------------------------------------------------ integration: hapi
+
+    def epoch_event(self, epoch: int, steps: int, wall_s: float, logs: dict):
+        self.registry.counter("fit/epochs").inc()
+        self.registry.histogram("fit/epoch_s").observe(wall_s)
+        self.emit("epoch", epoch=epoch, steps=steps, wall_s=wall_s,
+                  logs={k: float(v) for k, v in (logs or {}).items()})
+
+    # -------------------------------------------------- integration: profiler
+
+    def stage_event(self, name: str, start: float, end: float, kind: str):
+        """Mirror of profiler stage/user ranges into the sink, so one JSONL
+        carries both the always-on metrics and any traced windows."""
+        self.emit("stage", name=name, stage_kind=kind,
+                  start=start, end=end, dur_s=end - start)
+
+    # --------------------------------------------------------- memory census
+
+    def memory_census(self, top: int = 10) -> dict:
+        census = live_array_census(top)
+        self.registry.gauge("memory/live_arrays").set(census["count"])
+        self.registry.gauge("memory/live_bytes").set(census["total_bytes"])
+        self.emit("census", **census)
+        return census
+
+    # ---------------------------------------------------------- post-mortems
+
+    def dump(self, path: Optional[str] = None,
+             exc: Optional[BaseException] = None) -> str:
+        if path is None:
+            base = self.sink.path if self.sink is not None \
+                else f"monitor_{os.getpid()}.jsonl"
+            root, _ = os.path.splitext(base)
+            path = root + ".flight.json"
+        snap = self._emit_counters()
+        self.flush()
+        return self.flight.dump(path, registry_snapshot=snap, exc=exc)
+
+    def on_crash(self, exc: BaseException):
+        # one dump per exception object: TrainStep.__call__ raising inside
+        # Model.fit would otherwise dump twice on the same failure. The mark
+        # lives ON the exception (not an id() set: a collected exception's id
+        # gets reused, which would silently suppress a later real dump)
+        if getattr(exc, "_paddle_monitor_dumped", False):
+            return
+        try:
+            exc._paddle_monitor_dumped = True
+        except Exception:
+            pass  # unmarkable exception: accept a possible double dump
+        try:
+            path = self.dump(exc=exc)
+            self.emit("crash", dump=path, exc_type=type(exc).__name__)
+            self.flush()
+        except Exception:
+            pass  # post-mortem tooling must never mask the real exception
+
+
+# ------------------------------------------------------------------ module API
+
+
+def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
+           flush_every: int = 64, ring: int = 256) -> Monitor:
+    """Turn the monitor on. ``path`` is the JSONL sink file (None: flight
+    recorder only); in multi-process runs each process writes
+    ``path.procN`` (see sink.resolve_sink_path). Idempotent-safe: enabling
+    while enabled closes the previous session first."""
+    global _active
+    with _lock:
+        if _active is not None:
+            _teardown_locked()
+        mon = Monitor(path, warn_after=warn_after, flush_every=flush_every,
+                      ring=ring)
+        _install_hooks(mon)
+        _active = mon
+        return mon
+
+
+def _install_hooks(mon: Monitor):
+    from ..core import dispatch
+    dispatch.set_monitor_hooks(mon.op_hook, mon.op_compile_hook)
+
+
+def _teardown_locked():
+    global _active
+    mon, _active = _active, None
+    from ..core import dispatch
+    dispatch.set_monitor_hooks(None, None)
+    if mon is not None:
+        mon.close()
+
+
+def disable():
+    """Flush + close the sink, uninstall dispatch hooks."""
+    with _lock:
+        _teardown_locked()
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def get() -> Optional[Monitor]:
+    return _active
+
+
+def emit(kind: str, **fields):
+    mon = _active
+    if mon is not None:
+        mon.emit(kind, **fields)
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write the flight-recorder post-mortem JSON now (enabled monitor only)."""
+    mon = _active
+    if mon is None:
+        return None
+    return mon.dump(path)
+
+
+def counter(name: str) -> Optional[Counter]:
+    mon = _active
+    return mon.registry.counter(name) if mon is not None else None
+
+
+def gauge(name: str) -> Optional[Gauge]:
+    mon = _active
+    return mon.registry.gauge(name) if mon is not None else None
+
+
+def histogram(name: str) -> Optional[Histogram]:
+    mon = _active
+    return mon.registry.histogram(name) if mon is not None else None
+
+
+def snapshot() -> Optional[dict]:
+    mon = _active
+    return mon.registry.snapshot() if mon is not None else None
+
+
+def on_crash(exc: BaseException):
+    """Integration-point crash hook (TrainStep/Model.fit except blocks)."""
+    mon = _active
+    if mon is not None:
+        mon.on_crash(exc)
+
+
+def _maybe_enable_from_env():
+    """PADDLE_MONITOR=<path|1> opt-in, read once at import. A bad value
+    (unparsable warn_after, unwritable path) must degrade to a warning —
+    telemetry can never be the reason `import paddle_tpu` fails."""
+    v = os.environ.get("PADDLE_MONITOR")
+    if not v:
+        return
+    path = v if v.lower() not in ("1", "true", "yes", "on") \
+        else f"monitor_{os.getpid()}.jsonl"
+    try:
+        wa = os.environ.get("PADDLE_MONITOR_WARN_AFTER")
+        enable(path, warn_after=int(wa) if wa else None)
+    except Exception as e:
+        warnings.warn(f"PADDLE_MONITOR={v!r}: could not enable the monitor "
+                      f"({type(e).__name__}: {e}); continuing without "
+                      f"telemetry", RuntimeWarning)
+
+
+@atexit.register
+def _atexit_flush():
+    mon = _active
+    if mon is not None:
+        try:
+            mon.close()
+        except Exception:
+            pass

@@ -1,0 +1,77 @@
+"""Memory accounting — per-executable HBM estimates and a live-array census.
+
+On TPU the second silent throughput killer (after recompiles) is HBM
+pressure: an OOM surfaces as an opaque allocator error long after the
+decision that caused it. XLA already knows the answer at compile time —
+``compiled.memory_analysis()`` reports argument/output/temp/generated-code
+bytes per executable — and the runtime knows the live-array population.
+This module turns both into numbers you can watch BEFORE the OOM.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["executable_memory_stats", "live_array_census"]
+
+_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+           "temp_size_in_bytes", "alias_size_in_bytes",
+           "generated_code_size_in_bytes")
+
+
+def executable_memory_stats(compiled) -> Optional[dict]:
+    """HBM footprint estimate of one compiled executable.
+
+    Returns ``{"argument_bytes", "output_bytes", "temp_bytes",
+    "alias_bytes", "generated_code_bytes", "total_bytes"}`` or None when the
+    backend does not expose memory analysis (older plugin runtimes).
+    ``total_bytes`` is the peak-resident estimate: args + outputs + temps
+    minus aliased (donated) buffers, which XLA reuses in place.
+    """
+    analyze = getattr(compiled, "memory_analysis", None)
+    if analyze is None:
+        return None
+    try:
+        ma = analyze()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    vals = {}
+    for f in _FIELDS:
+        vals[f.replace("_size_in_bytes", "_bytes")] = int(getattr(ma, f, 0))
+    vals["total_bytes"] = (vals["argument_bytes"] + vals["output_bytes"]
+                           + vals["temp_bytes"] - vals["alias_bytes"])
+    return vals
+
+
+def live_array_census(top: int = 10) -> dict:
+    """Snapshot of every live jax.Array on the host process.
+
+    Returns ``{"count", "total_bytes", "top": [{"shape", "dtype", "nbytes",
+    "sharded"}...]}`` sorted by size. This is the "why is HBM full" helper:
+    run it when memory gauges trend up and the biggest residents name
+    themselves.
+    """
+    import jax
+
+    arrs = [a for a in jax.live_arrays() if not getattr(a, "is_deleted",
+                                                        lambda: False)()]
+    sized = []
+    total = 0
+    for a in arrs:
+        try:
+            nb = int(a.nbytes)
+        except Exception:
+            continue
+        total += nb
+        sized.append((nb, a))
+    sized.sort(key=lambda t: t[0], reverse=True)
+    top_list = []
+    for nb, a in sized[:max(int(top), 0)]:
+        try:
+            sharded = len(a.sharding.device_set) > 1
+        except Exception:
+            sharded = False
+        top_list.append({"shape": list(a.shape), "dtype": str(a.dtype),
+                         "nbytes": nb, "sharded": sharded})
+    return {"count": len(sized), "total_bytes": total, "top": top_list}

@@ -16,11 +16,13 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from .. import monitor as _monitor
 from ..core import dispatch
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
@@ -47,7 +49,8 @@ class _HostEvent:
     start: float
     end: float
     kind: str = "op"          # "op" | "user" | "stage"
-    tid: int = 0
+    tid: int = 0              # OS thread ident of the emitting thread
+    tname: str = ""
 
 
 class _Recorder:
@@ -57,7 +60,19 @@ class _Recorder:
 
     def emit(self, name, start, end, kind="op"):
         if self.enabled:
-            self.events.append(_HostEvent(name, start, end, kind))
+            # real thread identity: the DeviceLoader producer emits fetch/h2d
+            # from its own thread — a Chrome trace must keep it on a separate
+            # row from the consumer's wait/dispatch events
+            th = threading.current_thread()
+            self.events.append(_HostEvent(name, start, end, kind,
+                                          th.ident or 0, th.name))
+        if kind != "op":
+            # stage/user ranges mirror into the monitor sink (one JSONL tells
+            # the whole story); op events stay out — the monitor counts those
+            # in aggregate via its dispatch hook
+            mon = _monitor._active
+            if mon is not None:
+                mon.stage_event(name, start, end, kind)
 
 
 _recorder = _Recorder()
@@ -69,8 +84,10 @@ def _dispatch_hook(name: str, start: float, end: float):
 
 def record_stage(name: str, start: float, end: float):
     """Emit a pipeline-stage event (``io.DeviceLoader`` and the TrainStep
-    fast path use this to attribute wall time to host-feed vs device-compute;
-    no-op unless a Profiler is recording)."""
+    fast path use this to attribute wall time to host-feed vs device-compute).
+    Recorded into the Profiler when one is recording, and mirrored as a
+    ``stage`` record into an enabled ``paddle_tpu.monitor`` sink — it is only
+    a no-op when BOTH are off."""
     _recorder.emit(name, start, end, "stage")
 
 
@@ -169,6 +186,11 @@ class Profiler:
         self._step_times: List[float] = []
         self._t_last = None
         self._device_tracing = False
+        # initialized here, not in start(): stop() without start() must be a
+        # clean no-op, not an AttributeError (and must not hand the GLOBAL
+        # recorder's events — possibly another run's — to on_trace_ready)
+        self._notified = False
+        self._started = False
         self.last_export_path: Optional[str] = None
 
     # ------------------------------------------------------------- lifecycle
@@ -176,6 +198,7 @@ class Profiler:
     def start(self):
         _recorder.events.clear()     # each profiler run owns a fresh recorder
         self._notified = False
+        self._started = True
         self._state = self._scheduler(self._step)
         self._apply_state()
         self._t_last = time.perf_counter()
@@ -187,8 +210,8 @@ class Profiler:
             import jax
             jax.profiler.stop_trace()
             self._device_tracing = False
-        if self._on_trace_ready is not None and _recorder.events \
-                and not self._notified:
+        if self._on_trace_ready is not None and self._started \
+                and _recorder.events and not self._notified:
             self._on_trace_ready(self)
             self._notified = True
         self._state = ProfilerState.CLOSED
@@ -240,7 +263,14 @@ class Profiler:
         return list(_recorder.events)
 
     def summary(self, sorted_by: str = "total", row_limit: int = 30) -> str:
-        """Aggregated per-name table (reference profiler_statistic tables)."""
+        """Aggregated per-name table (reference profiler_statistic tables).
+
+        ``sorted_by``: one of total/avg/max/min/count (milliseconds except
+        count)."""
+        if sorted_by not in ("total", "avg", "max", "min", "count"):
+            raise ValueError(
+                f"summary(sorted_by={sorted_by!r}): expected one of "
+                f"'total', 'avg', 'max', 'min', 'count'")
         agg = {}
         for e in _recorder.events:
             dur = (e.end - e.start) * 1e3
@@ -251,8 +281,10 @@ class Profiler:
             entry["total"] += dur
             entry["max"] = max(entry["max"], dur)
             entry["min"] = min(entry["min"], dur)
+        for entry in agg.values():
+            entry["avg"] = entry["total"] / max(entry["count"], 1)
         rows = sorted(agg.items(),
-                      key=lambda kv: kv[1].get(sorted_by, kv[1]["total"]),
+                      key=lambda kv: kv[1][sorted_by],
                       reverse=True)[:row_limit]
         out = [f"{'Name':<40}{'Kind':<8}{'Calls':>8}{'Total(ms)':>12}"
                f"{'Avg(ms)':>10}{'Max(ms)':>10}{'Min(ms)':>10}"]
@@ -308,12 +340,26 @@ class Profiler:
 
     def _export_chrome(self, path: str):
         t0 = min((e.start for e in _recorder.events), default=0.0)
-        events = [{"name": e.name, "ph": "X", "pid": os.getpid(),
-                   "tid": {"op": 1, "user": 2, "stage": 3}.get(e.kind, 9),
-                   "ts": (e.start - t0) * 1e6, "dur": (e.end - e.start) * 1e6,
-                   "cat": e.kind} for e in _recorder.events]
+        pid = os.getpid()
+        # real thread ids, compacted to stable small ints in order of first
+        # appearance, with thread_name metadata rows — the DeviceLoader
+        # producer thread lands on its own track instead of folding into the
+        # consumer's
+        tid_map = {}
+        meta = []
+        events = []
+        for e in _recorder.events:
+            tid = tid_map.get(e.tid)
+            if tid is None:
+                tid = tid_map[e.tid] = len(tid_map)
+                meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                             "tid": tid, "ts": 0.0, "dur": 0.0,
+                             "args": {"name": e.tname or f"thread-{e.tid}"}})
+            events.append({"name": e.name, "ph": "X", "pid": pid, "tid": tid,
+                           "ts": (e.start - t0) * 1e6,
+                           "dur": (e.end - e.start) * 1e6, "cat": e.kind})
         with open(path, "w") as f:
-            json.dump({"traceEvents": events,
+            json.dump({"traceEvents": meta + events,
                        "displayTimeUnit": "ms"}, f)
 
     def export(self, path: str, format: str = "json"):

@@ -3,6 +3,8 @@ import json
 import os
 
 import numpy as np
+import pytest
+
 import paddle_tpu as paddle
 from paddle_tpu import profiler
 
@@ -70,3 +72,118 @@ def test_profiler_off_has_no_overhead_path():
     before = len(_recorder.events)
     _ = paddle.matmul(x, x).numpy()
     assert len(_recorder.events) == before
+
+
+# ----------------------------------------------------- ISSUE 2 satellite fixes
+
+
+def test_stop_without_start_is_clean_noop():
+    """Regression: stop() before start() raised AttributeError (_notified
+    was only initialized in start())."""
+    prof = profiler.Profiler()
+    prof.stop()  # must not raise
+    prof = profiler.Profiler(
+        on_trace_ready=lambda p: (_ for _ in ()).throw(AssertionError(
+            "on_trace_ready must not fire for a never-started profiler")))
+    prof.stop()
+
+
+def test_host_events_carry_real_thread_ids():
+    import threading
+    prof = profiler.Profiler()
+    prof.reset()
+    with prof:
+        with profiler.RecordEvent("main_range"):
+            pass
+
+        def worker():
+            with profiler.RecordEvent("worker_range"):
+                pass
+
+        t = threading.Thread(target=worker, name="my-producer")
+        t.start()
+        t.join()
+    by_name = {e.name: e for e in prof.events if e.kind == "user"}
+    assert by_name["main_range"].tid == threading.get_ident()
+    assert by_name["worker_range"].tid != by_name["main_range"].tid
+    assert by_name["worker_range"].tname == "my-producer"
+
+
+def test_chrome_export_separates_threads(tmp_path):
+    import threading
+    prof = profiler.Profiler(
+        on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)))
+    prof.reset()
+    with prof:
+        with profiler.RecordEvent("consumer"):
+            pass
+        t = threading.Thread(
+            target=lambda: profiler.record_stage("producer/h2d", 0.0, 1.0),
+            name="DeviceLoader-prefetch")
+        t.start()
+        t.join()
+    trace = profiler.load_profiler_result(prof.last_export_path)
+    evs = trace["traceEvents"]
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert xs["consumer"]["tid"] != xs["producer/h2d"]["tid"]
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert "DeviceLoader-prefetch" in names
+
+
+def test_summary_sorted_by_avg_and_rejects_unknown_keys():
+    prof = profiler.Profiler()
+    prof.reset()
+    with prof:
+        # one slow call of "a", many fast calls of "b": total(b) can beat
+        # total(a) while avg(a) wins — the sort orders must differ
+        profiler._recorder.emit("a", 0.0, 1.0, "user")
+        for i in range(20):
+            profiler._recorder.emit("b", 0.0, 0.1, "user")
+    top_total = prof.summary(sorted_by="total").splitlines()[2]
+    top_avg = prof.summary(sorted_by="avg").splitlines()[2]
+    assert top_total.startswith("b")
+    assert top_avg.startswith("a")
+    for key in ("max", "min", "count"):
+        prof.summary(sorted_by=key)  # all documented keys accepted
+    with pytest.raises(ValueError, match="sorted_by"):
+        prof.summary(sorted_by="cpu_total")
+
+
+def test_make_scheduler_skip_first_and_repeat():
+    S = profiler.ProfilerState
+    sched = profiler.make_scheduler(closed=1, ready=0, record=1, repeat=2,
+                                    skip_first=3)
+    states = [sched(i) for i in range(9)]
+    # 3 skipped, then 2 repeats of (closed, record-and-return), then closed
+    assert states == [S.CLOSED, S.CLOSED, S.CLOSED,
+                      S.CLOSED, S.RECORD_AND_RETURN,
+                      S.CLOSED, S.RECORD_AND_RETURN,
+                      S.CLOSED, S.CLOSED]
+
+
+def test_make_scheduler_single_step_window():
+    S = profiler.ProfilerState
+    sched = profiler.make_scheduler(closed=0, ready=0, record=1, repeat=0)
+    # period of exactly one recording step: every step closes its window
+    assert [sched(i) for i in range(3)] == [S.RECORD_AND_RETURN] * 3
+    sched = profiler.make_scheduler(closed=0, ready=1, record=1, repeat=1)
+    assert [sched(i) for i in range(3)] == [S.READY, S.RECORD_AND_RETURN,
+                                            S.CLOSED]
+
+
+def test_chrome_trace_schema(tmp_path):
+    """Exported JSON loads and every event carries name/ph/ts/dur/tid."""
+    prof = profiler.Profiler(
+        on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)))
+    prof.reset()
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    with prof:
+        with profiler.RecordEvent("r"):
+            _ = paddle.matmul(x, x).numpy()
+    trace = profiler.load_profiler_result(prof.last_export_path)
+    assert trace["traceEvents"]
+    for e in trace["traceEvents"]:
+        for field in ("name", "ph", "ts", "dur", "tid"):
+            assert field in e, (field, e)
+        assert e["ph"] in ("X", "M")
+        assert e["dur"] >= 0 and e["ts"] >= 0

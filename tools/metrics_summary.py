@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Summarize paddle_tpu.monitor telemetry.
+
+Reads one or more monitor JSONL files (``monitor.enable(path)`` output, one
+per process in distributed runs) or flight-recorder dumps
+(``monitor.dump()`` / crash dumps) and prints per-metric aggregates plus the
+recompile timeline — the two questions a post-mortem starts with: "what was
+the run doing" and "why did it recompile".
+
+Usage:
+    python tools/metrics_summary.py run.jsonl [run.proc1.jsonl ...]
+    python tools/metrics_summary.py run.flight.json --events
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_records(path):
+    """Returns (event_records, final_metrics_snapshot_or_None)."""
+    with open(path) as f:
+        text = f.read()
+    # flight dump: one JSON object with kind == flight_dump
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and doc.get("kind") == "flight_dump":
+            return list(doc.get("events", [])), doc.get("metrics") or None
+        if isinstance(doc, dict):
+            return [doc], None
+    except json.JSONDecodeError:
+        pass
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # torn tail line from a crashed writer
+    return records, None
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _sig_brief(sig):
+    parts = []
+    for leaf in sig or []:
+        shape = "x".join(str(d) for d in leaf.get("shape", []))
+        parts.append(f"({shape}){leaf.get('dtype', '?')}")
+    return ", ".join(parts)
+
+
+def summarize(paths, show_events=False, out=sys.stdout):
+    all_records = []
+    metrics = None
+    for path in paths:
+        recs, snap = load_records(path)
+        all_records.extend(recs)
+        if snap is not None:
+            metrics = snap
+    all_records.sort(key=lambda r: r.get("ts", 0))
+    if not all_records:
+        print("no records", file=out)
+        return 1
+
+    # the last embedded counters record wins when no dump snapshot was given
+    for r in all_records:
+        if r.get("kind") == "counters" and isinstance(r.get("metrics"), dict):
+            metrics = r["metrics"]
+
+    t0 = all_records[0].get("ts", 0)
+    meta = next((r for r in all_records if r.get("kind") == "meta"), {})
+    span = all_records[-1].get("ts", t0) - t0
+    print(f"== monitor summary ==", file=out)
+    print(f"schema v{meta.get('schema', all_records[0].get('v', '?'))}  "
+          f"pid {meta.get('pid', '?')}  proc {meta.get('proc', 0)}  "
+          f"records {len(all_records)}  span {span:.3f}s", file=out)
+
+    by_kind = {}
+    for r in all_records:
+        by_kind.setdefault(r.get("kind", "?"), []).append(r)
+    print("events: " + "  ".join(f"{k}={len(v)}"
+                                 for k, v in sorted(by_kind.items())),
+          file=out)
+
+    if metrics:
+        counters = metrics.get("counters", {})
+        if counters:
+            print("\n== counters ==", file=out)
+            for name, v in sorted(counters.items()):
+                print(f"  {name:<44}{v:>12}", file=out)
+        gauges = metrics.get("gauges", {})
+        if gauges:
+            print("\n== gauges ==", file=out)
+            for name, v in sorted(gauges.items()):
+                shown = _fmt_bytes(v) if name.endswith("_bytes") else f"{v:g}"
+                print(f"  {name:<44}{shown:>12}", file=out)
+        hists = metrics.get("histograms", {})
+        if hists:
+            print("\n== histograms ==", file=out)
+            print(f"  {'name':<34}{'count':>8}{'avg':>12}{'min':>12}"
+                  f"{'max':>12}{'p99':>12}", file=out)
+            for name, h in sorted(hists.items()):
+                print(f"  {name:<34}{h.get('count', 0):>8}"
+                      f"{h.get('avg', 0):>12.6f}{h.get('min', 0):>12.6f}"
+                      f"{h.get('max', 0):>12.6f}{h.get('p99', 0):>12.6f}",
+                      file=out)
+
+    recompiles = by_kind.get("recompile", [])
+    print(f"\n== recompile timeline ({len(recompiles)}) ==", file=out)
+    for r in recompiles:
+        dt = r.get("ts", t0) - t0
+        cs = r.get("compile_s")
+        cs = f"compile {cs:.3f}s" if cs is not None else "compile n/a"
+        div = r.get("divergent") or []
+        tail = ("divergent: " + "; ".join(div)) if div \
+            else ("sig: " + _sig_brief(r.get("sig")))
+        print(f"  +{dt:9.3f}s  [{r.get('path', '?'):>3}] "
+              f"#{r.get('count', '?')}  {cs}  {tail}", file=out)
+
+    mems = by_kind.get("memory", [])
+    if mems:
+        print(f"\n== executable memory ({len(mems)} buckets) ==", file=out)
+        for r in mems:
+            print(f"  bucket {r.get('bucket', '?')}: "
+                  f"args {_fmt_bytes(r.get('argument_bytes', 0))}  "
+                  f"out {_fmt_bytes(r.get('output_bytes', 0))}  "
+                  f"temp {_fmt_bytes(r.get('temp_bytes', 0))}  "
+                  f"total {_fmt_bytes(r.get('total_bytes', 0))}", file=out)
+
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        print(f"\n== epochs ({len(epochs)}) ==", file=out)
+        for r in epochs:
+            logs = r.get("logs") or {}
+            logstr = "  ".join(f"{k}={v:.4f}" for k, v in logs.items())
+            print(f"  epoch {r.get('epoch', '?')}: {r.get('steps', '?')} "
+                  f"steps  {r.get('wall_s', 0):.3f}s  {logstr}", file=out)
+
+    stalls = by_kind.get("loader_stall", [])
+    if stalls:
+        total = sum(r.get("wait_s", 0) for r in stalls)
+        print(f"\n== loader stalls ==\n  {len(stalls)} stalls, "
+              f"{total:.3f}s total blocked", file=out)
+
+    crashes = by_kind.get("crash", [])
+    for r in crashes:
+        print(f"\n== crash ==\n  {r.get('exc_type', '?')} -> "
+              f"{r.get('dump', '?')}", file=out)
+
+    if show_events:
+        print("\n== raw events ==", file=out)
+        for r in all_records:
+            print(f"  {json.dumps(r)}", file=out)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="monitor JSONL file(s) and/or flight-recorder dumps")
+    ap.add_argument("--events", action="store_true",
+                    help="also print every raw event record")
+    args = ap.parse_args(argv)
+    return summarize(args.paths, show_events=args.events)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
