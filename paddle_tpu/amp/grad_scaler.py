@@ -96,6 +96,19 @@ class GradScaler:
         self.step(optimizer)
         self.update()
 
+    def _compiled_outcome(self, found_inf: bool):
+        """Host half of a jit-compiled AMP step (jit.TrainStep(grad_scaler=...)).
+
+        The executable already scaled the loss, unscaled the accumulated
+        grads and — on overflow anywhere in the microbatch window — discarded
+        the update on device. Replay the same dynamic-scale state machine the
+        eager ``step()+update()`` pair runs, so scale growth/shrink is
+        bit-identical between the two paths."""
+        self._found_inf = bool(found_inf)
+        self._cache_founf_inf = self._found_inf  # reference attr name (sic)
+        self._unscaled = True
+        self.update()
+
     def get_init_loss_scaling(self):
         return self._scale
 

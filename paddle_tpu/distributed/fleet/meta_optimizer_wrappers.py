@@ -36,7 +36,17 @@ class _Wrapper:
 
 
 class GradientMergeOptimizer(_Wrapper):
-    """Accumulate gradients for k_steps, then apply one update (avg option)."""
+    """Accumulate gradients for k_steps, then apply one update (avg option).
+
+    Eager path: the merge buffer below. Compiled path: ``jit.TrainStep``
+    recognizes this wrapper (``_gradient_merge`` marker) and compiles the
+    accumulation INTO the step executable — K stacked microbatches, one
+    ``lax.scan`` forward/backward sweep, one update — so the fleet
+    ``gradient_merge`` strategy is a thin adapter onto
+    ``TrainStep(accumulate_steps=k_steps, average_grads=avg)``."""
+
+    # adopted by jit.TrainStep while unwrapping the optimizer chain
+    _gradient_merge = True
 
     def __init__(self, optimizer, k_steps: int = 1, avg: bool = True):
         super().__init__(optimizer)

@@ -50,6 +50,24 @@ def _as_batch_tensors(data):
             for t in items]
 
 
+class _StackedBatches:
+    """Wrap a batch iterable so every K consecutive batches come out stacked
+    leaf-wise (leading axis K) — the input format of a
+    ``TrainStep(accumulate_steps=K)`` call. A trailing partial group is
+    dropped (the accumulation window needs exactly K microbatches)."""
+
+    def __init__(self, loader, k: int):
+        self.loader = loader
+        self.k = max(int(k), 1)
+
+    def __len__(self):
+        return len(self.loader) // self.k
+
+    def __iter__(self):
+        from ..io.device_loader import _stacked_iter
+        return _stacked_iter(iter(self.loader), self.k)
+
+
 class Model:
     """High-level train/eval/predict facade over a Layer."""
 
@@ -64,11 +82,19 @@ class Model:
         self._save_dir = None
         self._jit_compile = False
         self._train_step = None
+        self._accumulate_steps = 1
+        self._pending_microbatches = []
 
     # -------------------------------------------------------------- prepare
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
-                jit_compile: bool = False):
+                jit_compile: bool = False, accumulate_steps: int = 1):
+        """``accumulate_steps=K`` (K>1) trains through the compiled
+        accumulation path: one ``jit.TrainStep`` executable consumes K
+        stacked microbatches, runs forward/backward K times and applies ONE
+        optimizer update — effective batch ×K with flat parameter/optimizer
+        HBM. Implies ``jit_compile=True`` (accumulation is compiled into the
+        step; see ``train_batch`` for the eager-API adapter)."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -77,13 +103,21 @@ class Model:
             self._metrics = list(metrics)
         else:
             self._metrics = [metrics]
+        self._accumulate_steps = max(int(accumulate_steps), 1)
+        if self._accumulate_steps > 1:
+            jit_compile = True
         if jit_compile and self._metrics:
             raise ValueError(
-                "jit_compile=True trains through jit.TrainStep, which returns "
-                "only the loss; hapi metrics need eager outputs — drop the "
-                "metrics or jit_compile")
+                ("accumulate_steps>1 trains through jit.TrainStep, which "
+                 "returns only the loss; hapi metrics need eager outputs — "
+                 "drop the metrics or accumulate_steps"
+                 if self._accumulate_steps > 1 else
+                 "jit_compile=True trains through jit.TrainStep, which "
+                 "returns only the loss; hapi metrics need eager outputs — "
+                 "drop the metrics or jit_compile"))
         self._jit_compile = jit_compile
         self._train_step = None
+        self._pending_microbatches = []
         return self
 
     # -------------------------------------------------------------- batches
@@ -96,6 +130,9 @@ class Model:
         inputs = _as_batch_tensors(inputs)
         labels = _as_batch_tensors(labels) if labels is not None else []
         if self._jit_compile and self._optimizer is not None:
+            K = self._accumulate_steps
+            if K > 1:
+                return self._accum_train_batch(inputs, labels, update, sync)
             if not update:
                 # the eager path would accumulate p._grad across calls, but
                 # the TrainStep executable computes grads from its own batch
@@ -103,10 +140,12 @@ class Model:
                 # the accumulated batches, so refuse loudly
                 raise ValueError(
                     "prepare(jit_compile=True) compiles forward+backward+"
-                    "update into one TrainStep executable; gradient "
-                    "accumulation via train_batch(update=False) is not "
-                    "supported there — use jit_compile=False for "
-                    "accumulation")
+                    "update into one TrainStep executable; for gradient "
+                    "accumulation under the compiled step, use "
+                    "prepare(..., accumulate_steps=K) — it compiles the "
+                    "whole K-microbatch accumulation window into ONE "
+                    "executable (train_batch(update=False) then buffers "
+                    "microbatches instead of refusing)")
             step = self._ensure_train_step(len(labels))
             loss = step(*inputs, *labels)
             # same return shape as the eager no-metrics path: a bare scalar
@@ -124,6 +163,52 @@ class Model:
             metrics.append(m.accumulate())
         return metrics if len(metrics) > 1 else metrics[0]
 
+    def _accum_train_batch(self, inputs, labels, update, sync):
+        """Compiled-accumulation adapter for the eager train_batch API.
+
+        Two entry conventions:
+        * ``update=False`` buffers ONE microbatch and returns None (the loss
+          is not observable until the window's single compiled call);
+          the closing ``update=True`` call contributes the last microbatch,
+          stacks the window and runs it.
+        * ``update=True`` with nothing buffered expects inputs ALREADY
+          stacked (leading axis K — the fit loop's path via _StackedBatches /
+          DeviceLoader(stack_batches=K)).
+        Returns the mean loss over the window's microbatches."""
+        if not update:
+            self._pending_microbatches.append((inputs, labels))
+            return None
+        if self._pending_microbatches:
+            from ..io.device_loader import stack_microbatches
+            self._pending_microbatches.append((inputs, labels))
+            window, self._pending_microbatches = \
+                self._pending_microbatches, []
+            if len(window) != self._accumulate_steps:
+                raise ValueError(
+                    f"accumulation window closed with {len(window)} "
+                    f"microbatch(es) but prepare(accumulate_steps="
+                    f"{self._accumulate_steps}): call train_batch("
+                    f"update=False) exactly K-1 times before the "
+                    f"update=True call (a mismatched window would silently "
+                    f"train on a different effective batch and mint a new "
+                    f"executable per distinct length)")
+            inputs = stack_microbatches([ins for ins, _ in window])
+            labels = stack_microbatches([lbs for _, lbs in window])
+        else:
+            K = self._accumulate_steps
+            for t in list(inputs) + list(labels):
+                if t.ndim == 0 or t.shape[0] != K:
+                    raise ValueError(
+                        f"prepare(accumulate_steps={K}) expects either "
+                        f"update=False microbatch buffering or inputs "
+                        f"stacked with leading axis {K} (got shape "
+                        f"{tuple(t.shape)}); stack with "
+                        f"io.stack_microbatches or feed fit() a "
+                        f"DeviceLoader(stack_batches={K})")
+        step = self._ensure_train_step(len(labels))
+        loss = step(*inputs, *labels)
+        return float(loss) if sync else AsyncScalar(loss.value())
+
     def _ensure_train_step(self, n_labels: int):
         """Build the one-executable TrainStep behind prepare(jit_compile=True)
         lazily (label arity is only known at the first batch)."""
@@ -132,7 +217,9 @@ class Model:
             net = self.network
             if self._loss is not None:
                 net = _LossNet(self.network, self._loss, n_labels)
-            self._train_step = TrainStep(net, self._optimizer)
+            self._train_step = TrainStep(
+                net, self._optimizer,
+                accumulate_steps=self._accumulate_steps)
         return self._train_step
 
     @no_grad()
@@ -173,12 +260,34 @@ class Model:
             num_workers: int = 0, callbacks=None, metric_lag: int = 0):
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
+        if self._accumulate_steps > 1 and getattr(
+                train_loader, "stack_batches", 1) != self._accumulate_steps:
+            if hasattr(train_loader, "stack_batches"):
+                # a DeviceLoader configured for the wrong window: re-stacking
+                # its device-resident batches here would undo the prefetch
+                # overlap and the sharded placement — misconfiguration, not
+                # something to paper over
+                raise ValueError(
+                    f"fit() with prepare(accumulate_steps="
+                    f"{self._accumulate_steps}) needs the DeviceLoader "
+                    f"constructed with stack_batches="
+                    f"{self._accumulate_steps} (got "
+                    f"{train_loader.stack_batches}) so whole accumulation "
+                    f"windows are stacked before the device transfer")
+            # one fit step = one K-microbatch accumulation window; plain
+            # host-side loaders stack here
+            train_loader = _StackedBatches(train_loader,
+                                           self._accumulate_steps)
         eval_loader = (self._to_loader(eval_data, batch_size, False, False,
                                        num_workers)
                        if eval_data is not None else None)
         self._save_dir = save_dir
         self.stop_training = False
-        steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        try:
+            steps = len(train_loader) if hasattr(train_loader, "__len__") \
+                else None
+        except TypeError:  # sized wrapper over an unsized iterable
+            steps = None
         cbks = config_callbacks(callbacks, self, epochs, steps,
                                 verbose=verbose, save_dir=save_dir,
                                 log_freq=log_freq)

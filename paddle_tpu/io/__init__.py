@@ -7,7 +7,7 @@ from .sampler import (  # noqa: F401
     DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
-from .device_loader import DeviceLoader, batch_sharding  # noqa: F401
+from .device_loader import DeviceLoader, batch_sharding, stack_microbatches  # noqa: F401
 from .bucketing import (  # noqa: F401
     DEFAULT_BOUNDARIES, bucket_length, pad_to_bucket, padding_attn_mask,
     BucketingCollate, LengthGroupedBatchSampler,

@@ -35,7 +35,54 @@ import numpy as np
 from .. import monitor as _monitor
 from ..core.tensor import Tensor
 
-__all__ = ["DeviceLoader", "batch_sharding"]
+__all__ = ["DeviceLoader", "batch_sharding", "stack_microbatches"]
+
+
+def stack_microbatches(batches):
+    """Stack K collated batches leaf-wise along a NEW leading axis.
+
+    The result is the input format of ``jit.TrainStep(accumulate_steps=K)``:
+    every array leaf gains a leading axis of length K. Host leaves (ndarray)
+    stack on host — the cheap place, before the H2D transfer; device leaves
+    (Tensor / jax.Array) stack on device to avoid a D2H round-trip."""
+    b0 = batches[0]
+    if isinstance(b0, tuple) and hasattr(b0, "_fields"):
+        return type(b0)(*(stack_microbatches([b[i] for b in batches])
+                          for i in range(len(b0))))
+    if isinstance(b0, (list, tuple)):
+        return type(b0)(stack_microbatches([b[i] for b in batches])
+                        for i in range(len(b0)))
+    if isinstance(b0, dict):
+        return {k: stack_microbatches([b[k] for b in batches]) for k in b0}
+    if isinstance(b0, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([t.value() for t in batches]))
+    if isinstance(b0, jax.Array):
+        import jax.numpy as jnp
+        return jnp.stack(list(batches))
+    return np.stack([np.asarray(b) for b in batches])
+
+
+def _stacked_iter(inner, k: int):
+    """Group the inner iterator into stacks of K microbatches (one TrainStep
+    call each). A trailing group of fewer than K batches is dropped —
+    ``drop_last`` semantics, the accumulation window needs exactly K."""
+    try:
+        while True:
+            group = []
+            for _ in range(k):
+                try:
+                    group.append(next(inner))
+                except StopIteration:
+                    return
+            yield stack_microbatches(group)
+    finally:
+        close = getattr(inner, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
 
 
 def batch_sharding(mesh, axis_name: str = "data"):
@@ -185,17 +232,23 @@ class DeviceLoader:
             ``jax.sharding.Sharding`` applied to every leaf, or a callable
             ``leaf_array -> Sharding`` (see :func:`batch_sharding`).
         device: optional ``jax.Device`` target when ``sharding`` is None.
+        stack_batches: K > 1 stacks every K consecutive collated batches
+            leaf-wise along a new leading axis *before* the H2D transfer —
+            one prefetch slot then carries a full
+            ``jit.TrainStep(accumulate_steps=K)`` accumulation window. A
+            trailing partial group is dropped (``drop_last`` semantics).
     """
 
     def __init__(self, loader, prefetch_depth: int = 2,
                  sharding: Union[None, Callable, "jax.sharding.Sharding"] = None,
-                 device=None):
+                 device=None, stack_batches: int = 1):
         if sharding is not None and device is not None:
             raise ValueError("pass either sharding or device, not both")
         self.loader = loader
         self.prefetch_depth = max(int(prefetch_depth), 1)
         self._sharding = sharding
         self._device = device
+        self.stack_batches = max(int(stack_batches), 1)
         # weakref: abandoning an iteration (break/exception without close())
         # must let the iterator be collected, so its __del__ stops the
         # producer thread and frees the prefetched device batches — a strong
@@ -203,7 +256,7 @@ class DeviceLoader:
         self._live: Optional[weakref.ref] = None
 
     def __len__(self):
-        return len(self.loader)
+        return len(self.loader) // self.stack_batches
 
     # ------------------------------------------------------------- transfer
 
@@ -211,6 +264,24 @@ class DeviceLoader:
         s = self._sharding
         if s is None:
             return self._device
+        if self.stack_batches > 1 and getattr(arr, "ndim", 0) > 0:
+            # leaves arrive STACKED (leading microbatch axis K): the user's
+            # sharding describes ONE collated batch — resolve it against a
+            # microbatch view and replicate the stacking axis in front, so
+            # batch_sharding still shards the BATCH axis, not the K axis
+            sh = s(arr[0]) if callable(s) else s
+            from jax.sharding import (NamedSharding, PartitionSpec,
+                                      SingleDeviceSharding)
+            if isinstance(sh, NamedSharding):
+                return NamedSharding(sh.mesh, PartitionSpec(None, *sh.spec))
+            if sh is None or isinstance(sh, SingleDeviceSharding):
+                return sh  # no axis semantics to shift
+            raise ValueError(
+                f"stack_batches={self.stack_batches} needs a NamedSharding "
+                f"(its axis spec shifts past the new stacking axis); got "
+                f"{type(sh).__name__}, whose placement would land on the "
+                f"microbatch axis instead of the batch axis — use "
+                f"batch_sharding(mesh) or an explicit NamedSharding")
         return s(arr) if callable(s) else s
 
     def _put_leaf(self, leaf):
@@ -235,7 +306,10 @@ class DeviceLoader:
 
     def __iter__(self):
         self.close()
-        it = _DeviceIterator(iter(self.loader), self._put_batch,
+        inner = iter(self.loader)
+        if self.stack_batches > 1:
+            inner = _stacked_iter(inner, self.stack_batches)
+        it = _DeviceIterator(inner, self._put_batch,
                              self.prefetch_depth, owner=self)
         self._live = weakref.ref(it)
         return it

@@ -9,9 +9,29 @@ reshards) and overlaps them with compute — nothing returns to Python between o
 
 Works over any current parameter placement: in_shardings are taken from the live
 arrays, so the same TrainStep expresses single-chip, DP, TP, and ZeRO runs.
+
+Gradient accumulation (``accumulate_steps=K``) compiles the reference fleet
+``gradient_merge`` strategy INTO the step: the executable consumes K stacked
+microbatches (every input carries a leading axis of length K), runs the
+forward/backward K times via ``jax.lax.scan`` accumulating gradients in fp32
+carry buffers, and applies exactly ONE optimizer update per call. Effective
+batch grows ×K while parameter and optimizer-state HBM stay flat — the scan
+keeps only ONE microbatch's activations live at a time, and the
+per-shape-bucket compile count stays 1 regardless of K. ``scan_unroll=K``
+unrolls the loop for scheduling freedom at the cost of peak temp memory
+(unrolled microbatch temps overlap — measured ~K× temp growth on CPU XLA),
+so the default stays a sequential loop.
+
+AMP dynamic loss scaling (``grad_scaler=``) also compiles in: the loss is
+scaled before backward, accumulated gradients are unscaled inside the
+executable, and a single found-inf flag over ALL K microbatches gates the
+update on device (``jnp.where`` keeps params/optimizer state bit-identical on
+overflow). The host then replays the eager GradScaler's scale-adjustment
+state machine on the flag.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -27,6 +47,19 @@ from ..profiler import _recorder as _prof_recorder, record_stage
 
 __all__ = ["TrainStep"]
 
+# Default scan unroll for the accumulation loop. 1 (a real XLA while loop) is
+# the memory-safe choice: the scheduler can only hold ONE microbatch's
+# activations live, which is the whole point of accumulating. Unrolling lets
+# the scheduler overlap microbatches for speed but measurably inflates peak
+# temp memory (observed ~K× on CPU XLA) — opt in via scan_unroll=K only when
+# HBM headroom allows.
+_DEFAULT_SCAN_UNROLL = 1
+
+
+class _PlacementDropNeeded(Exception):
+    """An adopted array cannot be restored to the compiled placement — the
+    AOT executables are stale and must be rebuilt against the new layout."""
+
 
 class TrainStep:
     """Compile (model fwd → loss → grads → optimizer update) into one executable.
@@ -34,10 +67,26 @@ class TrainStep:
     loss_fn(outputs, *labels) -> scalar Tensor; if None, the model must return the
     loss itself (paddle GPTForCausalLM-style `model(ids, labels=...)` works by
     passing labels through inputs).
+
+    accumulate_steps=K (K>1): every input must be K stacked microbatches
+    (leading axis K, e.g. via ``io.DeviceLoader(stack_batches=K)``); one call
+    runs K fwd/bwd passes and ONE optimizer update on the accumulated
+    gradients. ``average_grads=True`` (default) divides the accumulated sum
+    by K — the fleet ``gradient_merge_configs["avg"]`` semantics; False keeps
+    the raw sum, matching an eager loop of ``loss.backward()`` calls.
+    Wrapping the optimizer in ``fleet.GradientMergeOptimizer`` (or enabling
+    the ``gradient_merge`` strategy) sets both automatically.
+
+    grad_scaler: an ``amp.GradScaler`` whose dynamic loss scaling should be
+    compiled into the step (found-inf detection across all microbatches,
+    on-device skip-update, host-side scale adjustment).
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
-                 donate_params: bool = True, fast_path: bool = True):
+                 donate_params: bool = True, fast_path: bool = True,
+                 accumulate_steps: Optional[int] = None,
+                 average_grads: Optional[bool] = None,
+                 grad_scaler=None, scan_unroll: int = _DEFAULT_SCAN_UNROLL):
         # unwrap distributed facades down to the real Layer
         self._model = model
         while hasattr(self._model, "_layers"):
@@ -46,8 +95,20 @@ class TrainStep:
         # ZeRO>=2 wrappers declare how grads must come out of backward; capture
         # before unwrapping so the constraint compiles into the step
         self._grad_spec_fn = getattr(optimizer, "_grad_spec", None)
+        # fleet.GradientMergeOptimizer is a thin adapter onto the compiled
+        # accumulation machinery: adopt its k_steps/avg while unwrapping
         while hasattr(self._opt, "_inner_opt"):
+            if getattr(self._opt, "_gradient_merge", False):
+                if accumulate_steps is None:
+                    accumulate_steps = self._opt.k_steps
+                if average_grads is None:
+                    average_grads = self._opt.avg
             self._opt = self._opt._inner_opt
+        self._acc_steps = max(int(accumulate_steps or 1), 1)
+        self._avg = True if average_grads is None else bool(average_grads)
+        self._scan_unroll = max(int(scan_unroll), 1)
+        self._scaler = grad_scaler
+        self._scaler_on = grad_scaler is not None and grad_scaler.is_enable()
         self._loss_fn = loss_fn
         self._donate = donate_params
         self._params: List[Parameter] = [p for _, p in
@@ -154,6 +215,120 @@ class TrainStep:
         # bf16/fp16 working copy in the model — reference multi_precision path)
         use_master = [p.trainable and id(p) in opt._master_weights for p in params]
 
+        acc_on = self._acc_steps > 1
+        scaler_on = self._scaler_on
+        avg = self._avg
+
+        def microbatch_grads(param_arrays, buffer_arrays, input_arrays,
+                             scalars):
+            """One fwd/bwd over a single microbatch. With a scaler, the
+            differentiated quantity is the SCALED loss (reference
+            scaler.scale(loss).backward()); the reported loss stays raw."""
+            def loss_of(diff_params):
+                full = []
+                di = iter(diff_params)
+                for a, t in zip(param_arrays, trainables):
+                    full.append(next(di) if t else a)
+                loss, new_buffers = run_model(tuple(full), buffer_arrays,
+                                              input_arrays)
+                if scaler_on:
+                    return (loss * scalars["loss_scale"].astype(loss.dtype),
+                            (loss, new_buffers))
+                return loss, (loss, new_buffers)
+
+            diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_in)
+            return loss, new_buffers, grads
+
+        def step_fn_accum(param_arrays, masters, states, buffer_arrays,
+                          scalars, input_arrays):
+            diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
+            if acc_on:
+                # K from the traced shape: a different microbatch count is
+                # just another shape bucket, not a different TrainStep
+                k = int(input_arrays[0].shape[0])
+                acc0 = tuple(jnp.zeros(a.shape, jnp.float32) for a in diff_in)
+
+                def body(carry, mb_inputs):
+                    bufs, acc = carry
+                    loss, new_bufs, g = microbatch_grads(
+                        param_arrays, bufs, mb_inputs, scalars)
+                    acc = tuple(a + gi.astype(jnp.float32)
+                                for a, gi in zip(acc, g))
+                    return (new_bufs, acc), loss
+
+                (new_buffers, grads), losses = jax.lax.scan(
+                    body, (tuple(buffer_arrays), acc0), input_arrays,
+                    unroll=min(self._scan_unroll, k))
+                loss = jnp.mean(losses)
+                factor = (1.0 / k) if avg else 1.0
+            else:
+                k = 1
+                loss, new_buffers, grads = microbatch_grads(
+                    param_arrays, buffer_arrays, input_arrays, scalars)
+                factor = 1.0
+
+            found_inf = None
+            if scaler_on:
+                # unscale once over the accumulated sum (1/scale · 1/K fused
+                # into one multiply); a non-finite value produced by ANY of
+                # the K microbatches survives summation, so one flag over the
+                # accumulated grads covers the whole window
+                scale_f = factor / scalars["loss_scale"]
+                grads = tuple(g * scale_f.astype(g.dtype) for g in grads)
+                finite = [jnp.all(jnp.isfinite(g)) for g in grads]
+                found_inf = (jnp.logical_not(jnp.all(jnp.stack(finite)))
+                             if finite else jnp.asarray(False))
+            elif factor != 1.0:
+                grads = tuple(g * jnp.asarray(factor, g.dtype) for g in grads)
+
+            if grad_shardings is not None:
+                grads = tuple(
+                    g if sh is None else jax.lax.with_sharding_constraint(g, sh)
+                    for g, sh in zip(grads, grad_shardings))
+            if grad_clip is not None:
+                grads = [g for _, g in grad_clip(list(zip(diff_in, grads)))]
+
+            upd_in = [m if um else a
+                      for a, m, um, t in zip(param_arrays, masters, use_master,
+                                             trainables) if t]
+            diff_states = [s for s, t in zip(states, trainables) if t]
+            new_upd, new_states_diff = opt_cls._update_rule(
+                upd_in, [g.astype(u.dtype) for g, u in zip(grads, upd_in)],
+                diff_states, scalars, **static)
+            if scaler_on:
+                # overflow anywhere in the window: the whole K-step update is
+                # discarded on device (params/state bit-identical), exactly
+                # the eager scaler.step() skip
+                new_upd = [jnp.where(found_inf, u, nu)
+                           for u, nu in zip(upd_in, new_upd)]
+                new_states_diff = [
+                    {name: jnp.where(found_inf, s[name], ns[name])
+                     for name in ns}
+                    for s, ns in zip(diff_states, new_states_diff)]
+            new_params, new_masters, new_states = [], [], []
+            ui, si = iter(new_upd), iter(new_states_diff)
+            for a, m, s, t, um in zip(param_arrays, masters, states, trainables,
+                                      use_master):
+                if not t:
+                    new_params.append(a)
+                    new_masters.append(m)
+                    new_states.append(s)
+                    continue
+                u = next(ui)
+                new_states.append(next(si))
+                if um:
+                    new_masters.append(u)
+                    new_params.append(u.astype(a.dtype))
+                else:
+                    new_masters.append(m)
+                    new_params.append(u)
+            loss_out = ({"loss": loss, "found_inf": found_inf} if scaler_on
+                        else loss)
+            return (loss_out, tuple(new_params), tuple(new_masters),
+                    tuple(new_states), tuple(new_buffers))
+
         def step_fn(param_arrays, masters, states, buffer_arrays, scalars,
                     input_arrays):
             def loss_of(diff_params):
@@ -209,7 +384,10 @@ class TrainStep:
         # buffers are dead after dispatch — donating them lets XLA alias
         # new_params onto them (saves a params-sized allocation + copy)
         donate = (0, 1, 2, 3) if self._donate else ()
-        self._compiled = jax.jit(step_fn, donate_argnums=donate)
+        # the plain path stays byte-for-byte the program it always was;
+        # accumulation/scaler compile through the extended step function
+        fn = step_fn_accum if (acc_on or scaler_on) else step_fn
+        self._compiled = jax.jit(fn, donate_argnums=donate)
 
     @property
     def num_compiles(self) -> int:
@@ -238,6 +416,22 @@ class TrainStep:
     def _call_impl(self, inputs):
         input_arrays = tuple(t.value() if isinstance(t, Tensor) else jnp.asarray(t)
                              for t in inputs)
+        if self._acc_steps > 1:
+            # the scan takes K from the traced shape — an unstacked batch
+            # would silently run shape[0] SINGLE-SAMPLE microbatches (wrong
+            # batch semantics, K× the intended update count), so enforce the
+            # stacking contract loudly
+            for i, a in enumerate(input_arrays):
+                if getattr(a, "ndim", 0) == 0 \
+                        or a.shape[0] != self._acc_steps:
+                    raise ValueError(
+                        f"TrainStep(accumulate_steps={self._acc_steps}) "
+                        f"expects every input stacked with leading axis "
+                        f"{self._acc_steps} (K microbatches per call); "
+                        f"input[{i}] has shape "
+                        f"{tuple(getattr(a, 'shape', ()))} — stack with "
+                        f"io.stack_microbatches or "
+                        f"DeviceLoader(stack_batches={self._acc_steps})")
         if self._fast_path:
             return self._fast_call(input_arrays)
         if self._compiled is None:
@@ -250,8 +444,9 @@ class TrainStep:
             self._gather_args()
 
         t0 = time.perf_counter() if mon is not None else 0.0
-        loss, new_params, new_masters, new_states, new_buffers = self._compiled(
-            param_arrays, masters, states, buffer_arrays, scalars, input_arrays)
+        loss_out, new_params, new_masters, new_states, new_buffers = \
+            self._compiled(param_arrays, masters, states, buffer_arrays,
+                           scalars, input_arrays)
 
         if mon is not None:
             sig = self._input_sig(input_arrays)
@@ -259,11 +454,14 @@ class TrainStep:
             if n1 > n0:
                 mon.train_step_compiled(sig, self._mon_prev_sig,
                                         compile_s=None, count=n1, path="jit")
+                if self._acc_steps > 1:
+                    mon.accum_config(self._acc_steps, self._grad_acc_bytes())
             else:
                 # steady-state dispatch latency; a cache-miss call is compile
                 # time, not dispatch, and is already covered by the recompile
                 # event
-                mon.step_event(time.perf_counter() - t0)
+                mon.step_event(time.perf_counter() - t0,
+                               microbatches=self._microbatches(input_arrays))
             self._mon_prev_sig = sig
 
         opt = self._opt
@@ -277,7 +475,7 @@ class TrainStep:
                     opt._master_weights[id(p)] = m
             for b, a in zip(self._buffers, new_buffers):
                 b._data = a
-        return Tensor(loss)
+        return Tensor(self._finish_loss(loss_out))
 
     def _gather_args(self):
         """Rebuild the full argument pytrees from the live framework objects
@@ -293,8 +491,50 @@ class TrainStep:
             {name: opt._accumulators[id(p)][name] for name in opt._state_names}
             if p.trainable else {} for p in params)
         buffer_arrays = tuple(b.value() for b in self._buffers)
-        scalars = opt._scalars(opt.get_lr())
+        scalars = self._step_scalars()
         return param_arrays, masters, states, buffer_arrays, scalars
+
+    def _step_scalars(self):
+        """The per-step device scalars: the optimizer's lr/step, plus the
+        current loss scale when a GradScaler is compiled in (a device input,
+        so dynamic scale changes never recompile)."""
+        scalars = self._opt._scalars(self._opt.get_lr())
+        if self._scaler_on:
+            from ..core.lazy import scalar_const
+            scalars = dict(scalars)
+            scalars["loss_scale"] = scalar_const(
+                float(self._scaler._scale)).astype(jnp.float32)
+        return scalars
+
+    def _microbatches(self, input_arrays) -> int:
+        if self._acc_steps > 1 and input_arrays \
+                and getattr(input_arrays[0], "ndim", 0) > 0:
+            return int(input_arrays[0].shape[0])
+        return 1
+
+    def _grad_acc_bytes(self) -> int:
+        """HBM held by the fp32 gradient accumulators inside the executable."""
+        return sum(4 * int(math.prod(p.shape) if p.ndim else 1)
+                   for p in self._params if p.trainable)
+
+    def _finish_loss(self, loss_out):
+        """Unpack the step's loss output; with a compiled-in scaler, replay
+        the eager GradScaler state machine on the device found-inf flag."""
+        if not self._scaler_on:
+            return loss_out
+        # one host sync per step — the same sync the eager scaler's
+        # bool(all(isfinite)) already pays
+        found = bool(loss_out["found_inf"])
+        if found:
+            # the executable discarded the update; un-advance the step
+            # counter so bias correction replays this step number, exactly
+            # as the eager path where optimizer.step() never ran
+            self._opt._rollback_step()
+            mon = _monitor._active
+            if mon is not None:
+                mon.update_skipped(self._acc_steps)
+        self._scaler._compiled_outcome(found)
+        return loss_out["loss"]
 
     # ------------------------------------------------------------- fast path
 
@@ -311,7 +551,16 @@ class TrainStep:
         """
         if self._compiled is None:
             self._build(input_arrays)
-        args = self._gather_args()
+        if self._fast_state is not None:
+            # adding a bucket to a live fast path: lower from the ADOPTED
+            # state (same placements as the existing executables), not from
+            # the live objects — a user-installed array with drifted sharding
+            # has already been restored/dropped by _refresh_fast_state, and
+            # re-gathering here would seed this bucket with a layout the
+            # older buckets were never lowered for
+            args = (*self._fast_state, self._step_scalars())
+        else:
+            args = self._gather_args()
         t_c = time.perf_counter()
         exe = self._compiled.lower(*args, input_arrays).compile()
         compile_s = time.perf_counter() - t_c
@@ -324,6 +573,8 @@ class TrainStep:
             # count, and the executable's memory_analysis() as HBM gauges
             mon.train_step_compiled(sig, self._mon_prev_sig, compile_s,
                                     len(self._fast), "aot", compiled=exe)
+            if self._acc_steps > 1:
+                mon.accum_config(self._acc_steps, self._grad_acc_bytes())
         if self._fast_meta is None:
             opt = self._opt
             self._fast_meta = [
@@ -335,10 +586,56 @@ class TrainStep:
         # step; the first execution must use them, not advance again
         return exe, args[4]
 
-    def _refresh_fast_state(self):
+    def _readopt(self, new, old):
+        """Adopt a user-installed array into the fast state. When its sharding
+        differs from the compiled placement (``set_state_dict`` restoring a
+        checkpoint laid out for a different mesh, ``.to(device)`` moves), the
+        AOT executable would reject it — ``device_put`` it back to the
+        placement the executable was lowered for. Raises _PlacementDropNeeded
+        when that transfer is impossible (e.g. non-addressable target), which
+        drops the stale executables instead of failing the step."""
+        if old is None or isinstance(old, tuple) or new is old:
+            return new
+        try:
+            same = new.sharding == old.sharding
+        except Exception:
+            return new
+        if same:
+            return new
+        mon = _monitor._active
+        try:
+            moved = jax.device_put(new, old.sharding)
+        except Exception as e:
+            raise _PlacementDropNeeded(str(e)) from e
+        if mon is not None:
+            mon.placement_restored()
+        return moved
+
+    def _drop_fast_executables(self, why: str):
+        """Forget every AOT executable + the flat arg state; the next call
+        re-lowers against the live placements (recompile sentinel fires)."""
+        n = len(self._fast)
+        self._fast.clear()
+        self._fast_state = None
+        self._compiled = None
+        mon = _monitor._active
+        if mon is not None:
+            mon.fast_state_dropped(why, n)
+
+    def _refresh_fast_state(self) -> bool:
         """Re-adopt any array a user replaced between steps (set_state_dict,
         eager ops on params/rng). Identity checks only — O(n) `is`, no dict
-        or tuple construction on the no-change path."""
+        or tuple construction on the no-change path. Replacement arrays whose
+        sharding no longer matches the compiled placement are device_put back
+        (see _readopt); returns False when the executables had to be dropped
+        instead (caller must rebuild)."""
+        try:
+            return self._refresh_fast_state_impl()
+        except _PlacementDropNeeded as e:
+            self._drop_fast_executables(str(e))
+            return False
+
+    def _refresh_fast_state_impl(self) -> bool:
         st = self._fast_state
         params_t, masters_t, states_t, buffers_t = st
         opt = self._opt
@@ -348,18 +645,22 @@ class TrainStep:
                 if not dirty_p:
                     params_t = list(params_t)
                     dirty_p = True
-                params_t[i] = p.value()
+                params_t[i] = self._readopt(p.value(), params_t[i])
             if trainable and opt._accumulators[pid] is not states_t[i]:
                 if not dirty_s:
                     states_t = list(states_t)
                     dirty_s = True
-                states_t[i] = {name: opt._accumulators[pid][name]
+                old = states_t[i]
+                states_t[i] = {name: self._readopt(
+                                   opt._accumulators[pid][name],
+                                   old.get(name))
                                for name in opt._state_names}
             if has_master and opt._master_weights[pid] is not masters_t[i]:
                 if not dirty_m:
                     masters_t = list(masters_t)
                     dirty_m = True
-                masters_t[i] = opt._master_weights[pid]
+                masters_t[i] = self._readopt(opt._master_weights[pid],
+                                             masters_t[i])
         if dirty_p:
             st[0] = tuple(params_t)
         if dirty_m:
@@ -368,11 +669,13 @@ class TrainStep:
             st[2] = tuple(states_t)
         for i, b in enumerate(self._buffers):
             if b._data is not buffers_t[i]:
+                old = buffers_t[i]
                 if not isinstance(buffers_t, list):
                     buffers_t = list(buffers_t)
-                buffers_t[i] = b.value()
+                buffers_t[i] = self._readopt(b.value(), old)
         if isinstance(buffers_t, list):
             st[3] = tuple(buffers_t)
+        return True
 
     def _fast_call(self, input_arrays):
         opt = self._opt
@@ -380,24 +683,33 @@ class TrainStep:
         sig = self._input_sig(input_arrays)
         exe = self._fast.get(sig)
         if exe is None:
+            # re-adopt user-installed arrays BEFORE lowering a new bucket so
+            # every bucket shares one placement story (on drop, _fast_state
+            # clears and the build gathers fresh)
+            if self._fast_state is not None:
+                self._refresh_fast_state()
+            exe, scalars = self._build_fast(input_arrays)
+        elif not self._refresh_fast_state():
+            # placement drift dropped the executables: rebuild for this
+            # signature against the live layout
             exe, scalars = self._build_fast(input_arrays)
         else:
-            self._refresh_fast_state()
-            scalars = opt._scalars(opt.get_lr())
+            scalars = self._step_scalars()
         if mon is not None:
             self._mon_prev_sig = sig
         st = self._fast_state
 
         t0 = time.perf_counter() if (_prof_recorder.enabled
                                      or mon is not None) else 0.0
-        loss, new_params, new_masters, new_states, new_buffers = exe(
+        loss_out, new_params, new_masters, new_states, new_buffers = exe(
             st[0], st[1], st[2], st[3], scalars, input_arrays)
         if t0:
             t1 = time.perf_counter()
             if _prof_recorder.enabled:
                 record_stage("train_step/dispatch", t0, t1)
             if mon is not None:
-                mon.step_event(t1 - t0)
+                mon.step_event(t1 - t0,
+                               microbatches=self._microbatches(input_arrays))
 
         # outputs become next step's inputs verbatim (donation-friendly: the
         # just-invalidated input buffers are replaced wholesale)
@@ -417,4 +729,4 @@ class TrainStep:
                 mw[pid] = m
         for b, a in zip(self._buffers, new_buffers):
             b._data = a
-        return Tensor(loss)
+        return Tensor(self._finish_loss(loss_out))

@@ -190,10 +190,41 @@ class Monitor:
                 f"pad inputs to fixed boundaries or add the new shape to the "
                 f"bucket set.", RuntimeWarning, stacklevel=3)
 
-    def step_event(self, dur_s: float):
+    def step_event(self, dur_s: float, microbatches: int = 1):
         self.registry.counter("train_step/steps").inc()
+        if microbatches > 1:
+            self.registry.counter("train_step/microbatches").inc(microbatches)
         self.registry.histogram("train_step/dispatch_s").observe(dur_s)
         self.emit("step", dur_s=dur_s)
+
+    # ------------------------------------------- integration: grad accumulation
+
+    def accum_config(self, k: int, accumulator_bytes: int):
+        """Gradient-accumulation gauges: microbatch count per update and the
+        HBM held by the in-executable fp32 gradient accumulators."""
+        self.registry.gauge("train_step/accumulate_steps").set(k)
+        self.registry.gauge("train_step/grad_accumulator_bytes").set(
+            accumulator_bytes)
+        self.emit("accumulation", k=k, accumulator_bytes=accumulator_bytes)
+
+    def update_skipped(self, microbatches: int = 1):
+        """AMP found-inf: the compiled step discarded its whole update."""
+        self.registry.counter("train_step/skipped_updates").inc()
+        self.emit("skip_update", microbatches=microbatches)
+
+    def placement_restored(self):
+        """A user-installed array was device_put back to the compiled
+        placement during fast-state refresh (cheaper than a recompile)."""
+        self.registry.counter("train_step/placement_restores").inc()
+
+    def fast_state_dropped(self, why: str, executables: int):
+        """Fast-path executables dropped due to an unrestorable placement
+        change; the next step re-lowers (recompile sentinel will fire)."""
+        self.registry.counter("train_step/fast_state_drops").inc()
+        # the rebuilt executables re-number from bucket 1: stale per-bucket
+        # memory gauges would misattribute HBM to dead executables
+        self.registry.remove_prefix("train_step/bucket")
+        self.emit("fast_state_dropped", reason=why, executables=executables)
 
     # ---------------------------------------------------- integration: loader
 

@@ -200,6 +200,15 @@ class Registry:
                 out["histograms"][name] = m.snapshot()
         return out
 
+    def remove_prefix(self, prefix: str):
+        """Unregister every metric whose name starts with ``prefix`` — for
+        metrics scoped to an object that no longer exists (e.g. per-bucket
+        executable gauges after the executables are dropped), where a stale
+        value would misattribute live state."""
+        with self._lock:
+            for name in [n for n in self._metrics if n.startswith(prefix)]:
+                del self._metrics[name]
+
     def reset(self):
         with self._lock:
             self._metrics.clear()

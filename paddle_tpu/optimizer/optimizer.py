@@ -138,6 +138,15 @@ class Optimizer:
         return {"lr": scalar_const(float(lr)).astype(jnp.float32),
                 "step": step}
 
+    def _rollback_step(self):
+        """Un-advance the per-step scalars after a compiled step whose update
+        was discarded on device (AMP found-inf skip): the next step must
+        reuse this step number for bias correction, matching the eager path
+        where ``scaler.step`` never calls ``optimizer.step``."""
+        self._step_count = max(self._step_count - 1, 0)
+        self._step_dev = None
+        self._step_dev_count = None
+
     # ------------------------------------------------------------ step
 
     @no_grad()

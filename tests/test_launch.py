@@ -11,6 +11,9 @@ import sys
 import numpy as np
 import pytest
 
+# tier-1 budget: multi-process launch e2e (~30s spawn/join per case); env-limited in single-host CI images
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "launch_worker.py")
 

@@ -8,6 +8,9 @@ import sys
 
 import pytest
 
+# tier-1 budget: multi-process PS launch e2e (~25s); env-limited in single-host CI images
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "ps_worker.py")
 

@@ -461,6 +461,58 @@ def test_metrics_summary_cli_smoke(tmp_path):
     assert "train_step/recompiles" in r2.stdout
 
 
+def test_metrics_summary_merges_ranks(tmp_path):
+    """Multiple per-process sinks merge into ONE rank-tagged report: counters
+    sum with per-rank breakdown, timeline entries name their rank, recompile
+    signatures correlate across ranks."""
+    import io
+
+    def _fake_sink(path, proc, shapes):
+        recs = [{"v": 1, "ts": 1000.0 + proc, "kind": "meta", "schema": 1,
+                 "pid": 100 + proc, "proc": proc, "start": 1000.0}]
+        for i, shape in enumerate(shapes):
+            recs.append({"v": 1, "ts": 1001.0 + i, "kind": "recompile",
+                         "path": "aot", "count": i + 1, "compile_s": 0.5,
+                         "sig": [{"shape": list(shape), "dtype": "float32",
+                                  "sharding": "x"}],
+                         "divergent": []})
+        recs.append({"v": 1, "ts": 1010.0, "kind": "counters",
+                     "metrics": {"counters": {"train_step/steps": 5 + proc},
+                                 "gauges": {"train_step/executables":
+                                            len(shapes)},
+                                 "histograms": {}}})
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    p0 = str(tmp_path / "run.jsonl")
+    p1 = str(tmp_path / "run.proc1.jsonl")
+    # (16, 32) recompiles on BOTH ranks (data skew pattern); (64, 32) only
+    # on rank 1 (placement-bug pattern)
+    _fake_sink(p0, 0, [(16, 32)])
+    _fake_sink(p1, 1, [(16, 32), (64, 32)])
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_summary
+    finally:
+        sys.path.pop(0)
+    buf = io.StringIO()
+    rc = metrics_summary.summarize([p0, p1], out=buf)
+    out = buf.getvalue()
+    assert rc == 0
+    assert "ranks 0,1" in out
+    # counters summed across ranks with breakdown
+    assert "train_step/steps" in out and "11" in out
+    assert "p0=5" in out and "p1=6" in out
+    # timeline entries are rank-tagged
+    assert "[p0]" in out and "[p1]" in out
+    # recompile rank correlation separates skew from placement
+    assert "recompile rank correlation" in out
+    assert "all ranks" in out
+    assert "rank 1" in out and "(64x32)float32" in out
+
+
 def test_metrics_summary_importable_api(tmp_path):
     """The CLI is also a library: summarize() over multiple files."""
     import io

@@ -2,10 +2,15 @@
 """Summarize paddle_tpu.monitor telemetry.
 
 Reads one or more monitor JSONL files (``monitor.enable(path)`` output, one
-per process in distributed runs) or flight-recorder dumps
-(``monitor.dump()`` / crash dumps) and prints per-metric aggregates plus the
-recompile timeline — the two questions a post-mortem starts with: "what was
-the run doing" and "why did it recompile".
+per process in distributed runs — ``run.jsonl``, ``run.proc1.jsonl``, ...) or
+flight-recorder dumps (``monitor.dump()`` / crash dumps) and prints
+per-metric aggregates plus the recompile timeline — the two questions a
+post-mortem starts with: "what was the run doing" and "why did it recompile".
+
+Multiple files merge into ONE rank-tagged report: counters sum across ranks
+with a per-rank breakdown, timeline entries carry their rank, and recompile
+signatures are correlated across ranks (the same divergent signature on all
+ranks points at data skew; on one rank, at a placement bug).
 
 Usage:
     python tools/metrics_summary.py run.jsonl [run.proc1.jsonl ...]
@@ -15,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 
@@ -43,6 +49,18 @@ def load_records(path):
     return records, None
 
 
+def _proc_of(path, records):
+    """Rank of one sink file: the meta record's proc field, else the
+    ``.proc<K>.`` launcher naming convention, else None (caller assigns an
+    unused rank — rank-less files must not silently collapse onto an
+    existing rank and overwrite its metrics)."""
+    for r in records:
+        if r.get("kind") == "meta" and "proc" in r:
+            return int(r["proc"])
+    m = re.search(r"\.proc(\d+)\.", path)
+    return int(m.group(1)) if m else None
+
+
 def _fmt_bytes(n):
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -59,31 +77,98 @@ def _sig_brief(sig):
     return ", ".join(parts)
 
 
+def _merge_metrics(per_proc):
+    """Merge {proc: snapshot} into one rank-tagged view.
+
+    counters sum (breakdown kept), gauges keep the max (breakdown kept),
+    histograms pool count/avg/min/max; p99 conservatively takes the max."""
+    merged = {"counters": {}, "gauges": {}, "histograms": {}}
+    breakdown = {"counters": {}, "gauges": {}}
+    for proc, snap in sorted(per_proc.items()):
+        for name, v in (snap.get("counters") or {}).items():
+            merged["counters"][name] = merged["counters"].get(name, 0) + v
+            breakdown["counters"].setdefault(name, {})[proc] = v
+        for name, v in (snap.get("gauges") or {}).items():
+            merged["gauges"][name] = max(merged["gauges"].get(name, v), v)
+            breakdown["gauges"].setdefault(name, {})[proc] = v
+        for name, h in (snap.get("histograms") or {}).items():
+            m = merged["histograms"].get(name)
+            if m is None:
+                merged["histograms"][name] = dict(h)
+                continue
+            n0, n1 = m.get("count", 0), h.get("count", 0)
+            tot = n0 + n1
+            if tot:
+                m["avg"] = (m.get("avg", 0) * n0 + h.get("avg", 0) * n1) / tot
+            m["count"] = tot
+            m["min"] = min(m.get("min", 0), h.get("min", 0))
+            m["max"] = max(m.get("max", 0), h.get("max", 0))
+            m["p99"] = max(m.get("p99", 0), h.get("p99", 0))
+    return merged, breakdown
+
+
+def _brk(breakdown, kind, name, fmt=lambda v: f"{v:g}"):
+    per = breakdown.get(kind, {}).get(name)
+    if not per or len(per) < 2:
+        return ""
+    return "  (" + " ".join(f"p{p}={fmt(v)}" for p, v in sorted(per.items())) \
+        + ")"
+
+
 def summarize(paths, show_events=False, out=sys.stdout):
     all_records = []
-    metrics = None
-    for path in paths:
-        recs, snap = load_records(path)
+    # per-proc final metrics snapshot: dump snapshot if given, else the last
+    # embedded counters record of that proc's stream
+    proc_metrics = {}
+    loaded = [(path,) + load_records(path) for path in paths]
+    known = {_proc_of(p, recs) for p, recs, _ in loaded} - {None}
+    next_free = 0
+    for path, recs, snap in loaded:
+        proc = _proc_of(path, recs)
+        if proc is None:
+            # rank-less file: claim an UNUSED rank (a positional default
+            # could collide with another file's explicit rank and silently
+            # swallow its metrics); single-file invocations stay rank 0
+            if len(loaded) == 1:
+                proc = 0
+            else:
+                while next_free in known:
+                    next_free += 1
+                proc = next_free
+                known.add(proc)
+        for r in recs:
+            r.setdefault("_proc", proc)
         all_records.extend(recs)
         if snap is not None:
-            metrics = snap
+            proc_metrics[proc] = snap
+        else:
+            for r in recs:
+                if r.get("kind") == "counters" and isinstance(
+                        r.get("metrics"), dict):
+                    proc_metrics[proc] = r["metrics"]
     all_records.sort(key=lambda r: r.get("ts", 0))
     if not all_records:
         print("no records", file=out)
         return 1
 
-    # the last embedded counters record wins when no dump snapshot was given
-    for r in all_records:
-        if r.get("kind") == "counters" and isinstance(r.get("metrics"), dict):
-            metrics = r["metrics"]
+    procs = sorted({r["_proc"] for r in all_records})
+    multi = len(procs) > 1
+
+    def tag(r):
+        return f"[p{r['_proc']}] " if multi else ""
 
     t0 = all_records[0].get("ts", 0)
     meta = next((r for r in all_records if r.get("kind") == "meta"), {})
     span = all_records[-1].get("ts", t0) - t0
     print(f"== monitor summary ==", file=out)
-    print(f"schema v{meta.get('schema', all_records[0].get('v', '?'))}  "
-          f"pid {meta.get('pid', '?')}  proc {meta.get('proc', 0)}  "
-          f"records {len(all_records)}  span {span:.3f}s", file=out)
+    if multi:
+        print(f"schema v{meta.get('schema', all_records[0].get('v', '?'))}  "
+              f"ranks {','.join(str(p) for p in procs)}  "
+              f"records {len(all_records)}  span {span:.3f}s", file=out)
+    else:
+        print(f"schema v{meta.get('schema', all_records[0].get('v', '?'))}  "
+              f"pid {meta.get('pid', '?')}  proc {meta.get('proc', 0)}  "
+              f"records {len(all_records)}  span {span:.3f}s", file=out)
 
     by_kind = {}
     for r in all_records:
@@ -92,18 +177,30 @@ def summarize(paths, show_events=False, out=sys.stdout):
                                  for k, v in sorted(by_kind.items())),
           file=out)
 
+    metrics, breakdown = _merge_metrics(proc_metrics)
+    if not any(metrics.values()):
+        metrics = None
     if metrics:
         counters = metrics.get("counters", {})
         if counters:
-            print("\n== counters ==", file=out)
+            print(f"\n== counters =="
+                  + (f" (sum over {len(procs)} ranks)" if multi else ""),
+                  file=out)
             for name, v in sorted(counters.items()):
-                print(f"  {name:<44}{v:>12}", file=out)
+                print(f"  {name:<44}{v:>12}"
+                      + _brk(breakdown, "counters", name), file=out)
         gauges = metrics.get("gauges", {})
         if gauges:
-            print("\n== gauges ==", file=out)
+            print(f"\n== gauges =="
+                  + (f" (max over {len(procs)} ranks)" if multi else ""),
+                  file=out)
             for name, v in sorted(gauges.items()):
-                shown = _fmt_bytes(v) if name.endswith("_bytes") else f"{v:g}"
-                print(f"  {name:<44}{shown:>12}", file=out)
+                is_b = name.endswith("_bytes")
+                shown = _fmt_bytes(v) if is_b else f"{v:g}"
+                print(f"  {name:<44}{shown:>12}"
+                      + _brk(breakdown, "gauges", name,
+                             _fmt_bytes if is_b else (lambda x: f"{x:g}")),
+                      file=out)
         hists = metrics.get("histograms", {})
         if hists:
             print("\n== histograms ==", file=out)
@@ -124,14 +221,26 @@ def summarize(paths, show_events=False, out=sys.stdout):
         div = r.get("divergent") or []
         tail = ("divergent: " + "; ".join(div)) if div \
             else ("sig: " + _sig_brief(r.get("sig")))
-        print(f"  +{dt:9.3f}s  [{r.get('path', '?'):>3}] "
+        print(f"  +{dt:9.3f}s  {tag(r)}[{r.get('path', '?'):>3}] "
               f"#{r.get('count', '?')}  {cs}  {tail}", file=out)
+    if multi and recompiles:
+        # rank correlation: which ranks minted each signature (ROADMAP
+        # "distributed metric aggregation" — same sig everywhere = data
+        # skew reaching all ranks; one rank = that rank's placement bug)
+        by_sig = {}
+        for r in recompiles:
+            by_sig.setdefault(_sig_brief(r.get("sig")), set()).add(r["_proc"])
+        print("\n== recompile rank correlation ==", file=out)
+        for sig, ps in sorted(by_sig.items()):
+            where = "all ranks" if set(procs) <= ps else \
+                "rank " + ",".join(str(p) for p in sorted(ps))
+            print(f"  {where:<16} {sig}", file=out)
 
     mems = by_kind.get("memory", [])
     if mems:
         print(f"\n== executable memory ({len(mems)} buckets) ==", file=out)
         for r in mems:
-            print(f"  bucket {r.get('bucket', '?')}: "
+            print(f"  {tag(r)}bucket {r.get('bucket', '?')}: "
                   f"args {_fmt_bytes(r.get('argument_bytes', 0))}  "
                   f"out {_fmt_bytes(r.get('output_bytes', 0))}  "
                   f"temp {_fmt_bytes(r.get('temp_bytes', 0))}  "
@@ -143,7 +252,8 @@ def summarize(paths, show_events=False, out=sys.stdout):
         for r in epochs:
             logs = r.get("logs") or {}
             logstr = "  ".join(f"{k}={v:.4f}" for k, v in logs.items())
-            print(f"  epoch {r.get('epoch', '?')}: {r.get('steps', '?')} "
+            print(f"  {tag(r)}epoch {r.get('epoch', '?')}: "
+                  f"{r.get('steps', '?')} "
                   f"steps  {r.get('wall_s', 0):.3f}s  {logstr}", file=out)
 
     stalls = by_kind.get("loader_stall", [])
@@ -154,7 +264,7 @@ def summarize(paths, show_events=False, out=sys.stdout):
 
     crashes = by_kind.get("crash", [])
     for r in crashes:
-        print(f"\n== crash ==\n  {r.get('exc_type', '?')} -> "
+        print(f"\n== crash ==\n  {tag(r)}{r.get('exc_type', '?')} -> "
               f"{r.get('dump', '?')}", file=out)
 
     if show_events:
