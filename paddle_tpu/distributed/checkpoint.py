@@ -1,30 +1,102 @@
-"""Distributed (sharded) checkpointing + auto-resume.
+"""Fault-tolerant distributed (sharded) checkpointing + auto-resume.
 
 Reference analogs: GroupSharded save paths (each rank persists its shard),
 python/paddle/framework/io.py:646 (>4GB chunked pickle), and
 fluid/incubate/checkpoint/auto_checkpoint.py:72 (periodic job snapshots with
-automatic resume by job id).
+automatic resume by job id). The reference's elastic manager restarts jobs by
+"checkpoint + relaunch" — which only works if a snapshot interrupted by the
+crash can never be mistaken for a resume target. This module provides that
+guarantee:
+
+* **Atomic commits** — a snapshot is written into ``step_<N>.tmp``, fsynced,
+  renamed to ``step_<N>``, and only then stamped with a ``COMMIT`` manifest
+  (schema version, step, world size, per-file SHA-256 + sizes). A snapshot
+  without a valid manifest does not exist as far as
+  :func:`latest_checkpoint`/:func:`load_checkpoint` are concerned; a crash at
+  ANY point leaves either a ``.tmp`` dir or a manifest-less dir — never a
+  resume candidate (the resume scan quarantines the latter as evidence).
+* **Verification + quarantine** — auto-resume re-hashes the manifest's files
+  before restoring; a torn or bit-rotted snapshot is renamed to
+  ``step_<N>.corrupt`` (evidence, not a resume candidate) and resume falls
+  back to the previous committed snapshot.
+* **Async saves** — :class:`AsyncCheckpointer` snapshots device arrays to
+  host synchronously (cheap), then runs the TensorStore/pickle writes on a
+  background thread with at most one save in flight; ``wait()`` is the
+  barrier and write errors surface on the next ``save()`` or at ``close()``.
+* **Retry** — transient filesystem errors retry with exponential backoff +
+  jitter (:class:`paddle_tpu.utils.retry.RetryPolicy`).
 
 TPU-native: sharded state dicts go through Orbax (the jax-ecosystem checkpoint
 library baked into this image): every host writes ONLY its addressable shards,
 restore re-assembles arrays directly onto their target shardings — no
 gather-to-host-0, so a 1.3B+ ZeRO-3 run checkpoints without materializing the
-full model anywhere (the exact failure VERDICT flagged in
-save_group_sharded_model).
+full model anywhere.
+
+Fault injection (tests only): the module routes its state-changing filesystem
+calls through the ``_fs`` seam (monkeypatch to inject transient errors), and
+honors ``PADDLE_CKPT_FAULT=<stage>:<step>`` (stage ``die_before_rename`` or
+``die_before_commit``) by SIGKILLing itself mid-save — the torn-write drill
+behind the kill-and-resume e2e test.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
-from typing import Any, Dict, Optional
+import shutil
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
+from ..utils.retry import RetryPolicy
 
 __all__ = ["save_state_dict", "load_state_dict", "save_checkpoint",
-           "load_checkpoint", "latest_checkpoint"]
+           "load_checkpoint", "latest_checkpoint", "committed_steps",
+           "read_manifest", "verify_snapshot", "AsyncCheckpointer",
+           "CheckpointError", "MANIFEST_NAME", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+MANIFEST_NAME = "COMMIT"
+_HASH_CHUNK = 1 << 20
+
+
+class CheckpointError(RuntimeError):
+    """A snapshot could not be loaded/validated; the message names the
+    snapshot and what exactly is wrong with it."""
+
+
+class _Filesystem:
+    """Fault-injection seam: every state-changing filesystem call of the
+    commit protocol goes through here so tests can inject transient errors
+    (fail N times), truncation, or death without touching the real fs API."""
+
+    open = staticmethod(open)
+    replace = staticmethod(os.replace)
+    fsync = staticmethod(os.fsync)
+    rename = staticmethod(os.rename)
+
+
+_fs = _Filesystem()
+
+
+def _maybe_die(stage: str, step: int):
+    """PADDLE_CKPT_FAULT=<stage>:<step> → SIGKILL ourselves right here.
+    Emulates preemption/power loss at the two interesting commit-protocol
+    windows; only tests set the env var."""
+    if os.environ.get("PADDLE_CKPT_FAULT") == f"{stage}:{step}":
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _default_retry() -> RetryPolicy:
+    return RetryPolicy(max_attempts=3, base_delay=0.1, max_delay=2.0,
+                       retry_on=(OSError,))
 
 
 def _to_arrays(state: Dict[str, Any]) -> Dict[str, Any]:
@@ -46,15 +118,31 @@ def save_state_dict(state_dict: Dict[str, Any], path: str):
 def load_state_dict(path: str, state_dict: Optional[Dict[str, Any]] = None
                     ) -> Dict[str, Any]:
     """Restore; when `state_dict` (a template with live placements) is given,
-    arrays restore DIRECTLY onto those shardings (resharding on load)."""
+    arrays restore DIRECTLY onto those shardings (resharding on load).
+
+    Shapes are validated against the checkpoint's metadata first: restoring
+    through a mismatched template would otherwise silently truncate/pad the
+    saved arrays to the template shape — corruption, not an error."""
     import orbax.checkpoint as ocp
     ckptr = _ckptr()
     path = os.path.abspath(path)
     if state_dict is None:
         return ckptr.restore(path)
+    try:
+        saved_meta = ckptr.metadata(path)
+    except Exception:
+        saved_meta = None  # older orbax: restore still works, unvalidated
     template = {}
     for k, v in state_dict.items():
         arr = v.value() if isinstance(v, Tensor) else v
+        if isinstance(saved_meta, dict):
+            saved_shape = getattr(saved_meta.get(k), "shape", None)
+            if saved_shape is not None \
+                    and tuple(saved_shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"load_state_dict: {k!r} is {tuple(arr.shape)} in this "
+                    f"model but {tuple(saved_shape)} in the checkpoint "
+                    f"({path}) — the snapshot does not fit this network")
         template[k] = jax.ShapeDtypeStruct(arr.shape, arr.dtype,
                                            sharding=arr.sharding)
     restored = ckptr.restore(path, restore_args=ocp.checkpoint_utils
@@ -65,64 +153,597 @@ def load_state_dict(path: str, state_dict: Optional[Dict[str, Any]] = None
     return restored
 
 
-# ------------------------------------------------------------------ auto-resume
+# --------------------------------------------------------------- commit proto
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
+def _snapshot_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step}")
+
+
+def _world_size() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    except ValueError:
+        return 1
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(_HASH_CHUNK), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_files(base: str) -> List[str]:
+    """Relative (posix-separated) paths of every regular file under base,
+    excluding the manifest itself and its tmp."""
+    out = []
+    for root, _dirs, files in os.walk(base):
+        for name in files:
+            rel = os.path.relpath(os.path.join(root, name), base)
+            rel = rel.replace(os.sep, "/")
+            if rel in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def _fsync_tree(base: str):
+    """fsync every file, then every directory, bottom-up — the payload must
+    be durable BEFORE the rename publishes it."""
+    for root, dirs, files in os.walk(base, topdown=False):
+        for name in files:
+            fd = os.open(os.path.join(root, name), os.O_RDONLY)
+            try:
+                _fs.fsync(fd)
+            finally:
+                os.close(fd)
+        fd = os.open(root, os.O_RDONLY)
+        try:
+            _fs.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        _fs.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _build_manifest(base: str, step: int, hash_files: bool = True) -> dict:
+    files = {}
+    for rel in _walk_files(base):
+        p = os.path.join(base, rel.replace("/", os.sep))
+        files[rel] = {"sha256": _file_sha256(p) if hash_files else None,
+                      "bytes": os.path.getsize(p)}
+    return {"schema": SCHEMA_VERSION, "step": int(step),
+            "world_size": _world_size(), "wall": time.time(), "files": files}
+
+
+def _write_manifest(base: str, manifest: dict):
+    tmp = os.path.join(base, MANIFEST_NAME + ".tmp")
+    with _fs.open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        _fs.fsync(f.fileno())
+    _fs.replace(tmp, os.path.join(base, MANIFEST_NAME))
+    _fsync_dir(base)
+
+
+def read_manifest(base: str) -> Optional[dict]:
+    """The snapshot's COMMIT manifest, or None when the snapshot is
+    uncommitted (torn, in-progress, or pre-manifest legacy)."""
+    path = os.path.join(base, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        if not isinstance(m, dict) or not isinstance(m.get("files"), dict):
+            return None
+        if int(m.get("schema", -1)) > SCHEMA_VERSION:
+            return None  # written by a future version we cannot validate
+        name = os.path.basename(os.path.normpath(base))
+        mm = _STEP_RE.match(name)
+        if mm and m.get("step") is not None \
+                and int(m["step"]) != int(mm.group(1)):
+            return None  # manifest does not belong here (copied/renamed)
+    except (OSError, ValueError, TypeError):
+        # unreadable, or rotted fields that still parse as JSON (a string
+        # schema/step): uncommitted either way — resume must not crash on it
+        return None
+    return m
+
+
+def verify_snapshot(base: str, manifest: Optional[dict] = None) -> List[str]:
+    """Re-hash a snapshot against its manifest. Returns problem strings
+    (empty == verified committed snapshot)."""
+    if manifest is None:
+        manifest = read_manifest(base)
+    if manifest is None:
+        if not os.path.isdir(base):
+            return [f"{base}: snapshot directory does not exist"]
+        return [f"{base}: no {MANIFEST_NAME} manifest "
+                f"(torn or in-progress save)"]
+    problems = []
+    for rel, meta in sorted(manifest["files"].items()):
+        p = os.path.join(base, rel.replace("/", os.sep))
+        if not os.path.isfile(p):
+            problems.append(f"{base}: missing file {rel}")
+            continue
+        size = os.path.getsize(p)
+        if size != meta.get("bytes"):
+            problems.append(f"{base}: {rel} is {size} bytes, manifest says "
+                            f"{meta.get('bytes')} (truncated?)")
+            continue
+        # emergency manifests record sizes only (sha256 null)
+        if meta.get("sha256") and _file_sha256(p) != meta["sha256"]:
+            problems.append(f"{base}: {rel} checksum mismatch")
+    return problems
+
+
+# --------------------------------------------------------------- state capture
+
+def _host_copy(obj):
+    """Deep-copy a state structure to host numpy — the async writer's
+    snapshot, immune to subsequent training steps and device donation.
+
+    Arrays spanning NON-addressable devices (multi-host shardings) cannot be
+    materialized on this host: those keep their jax.Array reference — jax
+    arrays are immutable and training replaces rather than mutates them, so
+    the reference is still a consistent snapshot, and Orbax then writes only
+    our addressable shards (the device buffers stay live until the write
+    finishes; per-shard host staging is the ROADMAP follow-up)."""
+    if isinstance(obj, Tensor):
+        obj = obj.value()
+    if isinstance(obj, jax.Array):
+        if not getattr(obj, "is_fully_addressable", True):
+            return obj
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _host_copy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        c = [_host_copy(v) for v in obj]
+        return c if isinstance(obj, list) else tuple(c)
+    return obj
+
+
+def _capture(model, optimizer, grad_scaler, extra
+             ) -> Tuple[Optional[dict], Optional[dict], dict]:
+    model_state = dict(model.state_dict()) if model is not None else None
+    opt_state = (optimizer.state_dict()
+                 if optimizer is not None and hasattr(optimizer, "state_dict")
+                 else None)
+    ex = dict(extra or {})
+    if grad_scaler is not None and hasattr(grad_scaler, "state_dict"):
+        ex["grad_scaler"] = grad_scaler.state_dict()
+    return model_state, opt_state, ex
+
+
+# ------------------------------------------------------------------ write path
+
+def _write_snapshot(directory: str, step: int, model_state, opt_state, extra,
+                    retry: Optional[RetryPolicy], mode: str) -> str:
+    """The commit protocol. Returns the committed snapshot path.
+
+    Emergency saves (mode="emergency") skip per-file hashing: re-reading a
+    multi-GB payload to checksum it would spend the preemption grace window
+    on I/O that only guards against later bit-rot — their manifests record
+    sizes only, which still catches truncation."""
+    from .. import framework
+    t0 = time.perf_counter()
+    final = _snapshot_dir(directory, step)
+    tmp = final + ".tmp"
+    old = final + ".old"
+    hash_files = mode != "emergency"
+
+    attempts = {"n": 0}
+
+    def body():
+        attempts["n"] += 1
+        if attempts["n"] > 1:
+            mon = _monitor._active
+            if mon is not None:
+                mon.ckpt_retry(step, attempts["n"] - 1)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        if model_state is not None:
+            save_state_dict(model_state, os.path.join(tmp, "model"))
+        if opt_state is not None:
+            framework.io.save(opt_state, os.path.join(tmp, "optimizer.pdopt"))
+        if extra:
+            framework.io.save(extra, os.path.join(tmp, "extra.pkl"))
+        _fsync_tree(tmp)
+        _maybe_die("die_before_rename", step)
+        if os.path.isdir(final):
+            # only ever a torn payload from a previous ATTEMPT of this call
+            # (the pre-existing committed snapshot is parked at .old)
+            shutil.rmtree(final, ignore_errors=True)
+        _fs.replace(tmp, final)          # atomic publish of the payload
+        _fsync_dir(directory)
+        _maybe_die("die_before_commit", step)
+        manifest = _build_manifest(final, step, hash_files)
+        _write_manifest(final, manifest)  # the snapshot now EXISTS
+        return manifest
+
+    policy = retry or _default_retry()
+    with _aside_lock:  # _recover_aside must not "heal" this live window
+        # Re-saving an existing step (post-rollback): park the current
+        # snapshot at .old ONCE, before any attempt — inside the retry body
+        # it would see its own torn earlier attempt at `final` and destroy
+        # the parked copy. It is dropped only after the new COMMIT lands;
+        # _recover_aside puts it back if we die in between.
+        if os.path.isdir(final):
+            if os.path.isdir(old):
+                shutil.rmtree(old, ignore_errors=True)
+            _fs.rename(final, old)
+        try:
+            manifest = policy(body)
+        except BaseException:
+            # a persistently-failing RE-save must not strand the previously
+            # committed snapshot at .old (invisible to resume): put it back
+            # — including over a published-but-never-committed (torn) new
+            # payload, which the committed old strictly beats
+            if os.path.isdir(old):
+                try:
+                    if os.path.isdir(final):
+                        shutil.rmtree(final, ignore_errors=True)
+                    _fs.rename(old, final)
+                except OSError:
+                    pass
+            raise
+        if os.path.isdir(old):  # replaced snapshot, kept until the commit
+            shutil.rmtree(old, ignore_errors=True)
+    mon = _monitor._active
+    if mon is not None:
+        mon.ckpt_saved(step=step,
+                       nbytes=sum(f["bytes"]
+                                  for f in manifest["files"].values()),
+                       dur_s=time.perf_counter() - t0, mode=mode,
+                       attempts=attempts["n"])
+    return final
+
+
+def _prune_committed(directory: str, keep: int, protect: str):
+    """Prune to the newest `keep` snapshots by mtime (NOT step number — a
+    post-rollback save with a lower step must survive). Only COMMITTED
+    snapshots are prunable: an in-flight ``.tmp``, a torn manifest-less dir
+    (evidence for the operator) and quarantined ``.corrupt`` dirs are never
+    touched, and the snapshot just written never prunes itself."""
+    if not keep or not os.path.isdir(directory):
+        return
+    protect = os.path.abspath(protect)
+    entries = []
+    for d in os.listdir(directory):
+        if not _STEP_RE.match(d):
+            continue
+        p = os.path.join(directory, d)
+        if os.path.abspath(p) == protect:
+            continue
+        if read_manifest(p) is None:
+            continue
+        entries.append((os.path.getmtime(p), p))
+    for _, p in sorted(entries, reverse=True)[max(keep - 1, 0):]:
+        shutil.rmtree(p, ignore_errors=True)
+
+
 def save_checkpoint(directory: str, step: int, model=None, optimizer=None,
-                    extra: Optional[Dict[str, Any]] = None, keep: int = 3):
-    """Periodic job snapshot: <dir>/step_<N>/{model,opt,extra} (reference
-    auto_checkpoint). Prunes to the newest `keep` snapshots."""
-    base = os.path.join(directory, f"step_{step}")
-    if model is not None:
-        save_state_dict(dict(model.state_dict()), os.path.join(base, "model"))
-    if optimizer is not None and hasattr(optimizer, "state_dict"):
-        from .. import framework
-        framework.io.save(optimizer.state_dict(),
-                          os.path.join(base, "optimizer.pdopt"))
-    if extra:
-        from .. import framework
-        framework.io.save(extra, os.path.join(base, "extra.pkl"))
-    # prune old snapshots: keep the `keep` most RECENTLY WRITTEN (mtime, not
-    # step number — a post-rollback save with a lower step must survive)
-    if keep and os.path.isdir(directory):
-        import shutil
-        entries = []
-        for d in os.listdir(directory):
-            if _STEP_RE.match(d):
-                p = os.path.join(directory, d)
-                entries.append((os.path.getmtime(p), p))
-        for _, p in sorted(entries, reverse=True)[keep:]:
-            shutil.rmtree(p, ignore_errors=True)
+                    extra: Optional[Dict[str, Any]] = None, keep: int = 3,
+                    grad_scaler=None, retry: Optional[RetryPolicy] = None,
+                    _mode: str = "sync") -> str:
+    """Periodic job snapshot: <dir>/step_<N>/{model,optimizer.pdopt,extra.pkl}
+    committed atomically under a COMMIT manifest (reference auto_checkpoint).
+    Prunes committed snapshots beyond the newest `keep`. A ``grad_scaler``'s
+    state rides in ``extra["grad_scaler"]`` and is restored by
+    :func:`load_checkpoint`. Returns the committed snapshot path."""
+    model_state, opt_state, ex = _capture(model, optimizer, grad_scaler, extra)
+    final = _write_snapshot(directory, step, model_state, opt_state, ex,
+                            retry, _mode)
+    _prune_committed(directory, keep, final)
+    return final
+
+
+# -------------------------------------------------------------------- resume
+
+_OLD_RE = re.compile(r"^step_(\d+)\.old$")
+
+# Serializes the re-save set-aside window against the recovery scan: while a
+# writer in THIS process is mid-protocol (parked .old, payload in flight), a
+# concurrent latest_checkpoint() must not "heal" the live window — it would
+# rename the .old back and the writer's retry would then destroy it. Cross-
+# process writers are out of scope (one writer per checkpoint dir is the
+# contract: each rank owns its own directory).
+_aside_lock = threading.Lock()
+
+
+def _recover_aside(directory: str):
+    """Heal crashes inside a re-save's set-aside window: a COMMITTED
+    ``step_<N>.old`` whose replacement never committed is the real snapshot
+    — quarantine the torn replacement and rename the parked copy back. A
+    leftover ``.old`` beside a committed replacement is just cleanup."""
+    if not os.path.isdir(directory):
+        return
+    if not _aside_lock.acquire(blocking=False):
+        return  # a live writer owns the window; there is no crash to heal
+    try:
+        _recover_aside_locked(directory)
+    finally:
+        _aside_lock.release()
+
+
+def _recover_aside_locked(directory: str):
+    for d in os.listdir(directory):
+        m = _OLD_RE.match(d)
+        if not m:
+            continue
+        oldp = os.path.join(directory, d)
+        finalp = _snapshot_dir(directory, int(m.group(1)))
+        if read_manifest(finalp) is not None:
+            shutil.rmtree(oldp, ignore_errors=True)
+        elif read_manifest(oldp) is not None:
+            if os.path.isdir(finalp):
+                _quarantine(finalp, [f"{finalp}: torn re-save superseded by "
+                                     f"the parked committed copy"])
+            try:
+                _fs.rename(oldp, finalp)
+            except OSError:
+                pass
+        # both uncommitted: leave the evidence alone
+
+
+def committed_steps(directory: str) -> List[int]:
+    """Steps with a valid COMMIT manifest, ascending. Torn/partial dirs and
+    ``.tmp``/``.corrupt`` entries are invisible here by construction."""
+    if not os.path.isdir(directory):
+        return []
+    _recover_aside(directory)
+    steps = []
+    for d in os.listdir(directory):
+        m = _STEP_RE.match(d)
+        if m and read_manifest(os.path.join(directory, d)) is not None:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
 
 
 def latest_checkpoint(directory: str) -> Optional[int]:
-    if not os.path.isdir(directory):
-        return None
-    steps = [int(m.group(1)) for m in
-             (_STEP_RE.match(d) for d in os.listdir(directory)) if m]
-    return max(steps) if steps else None
+    """Newest COMMITTED step — a crash mid-save can never surface here."""
+    steps = committed_steps(directory)
+    return steps[-1] if steps else None
 
 
-def load_checkpoint(directory: str, model=None, optimizer=None,
-                    step: Optional[int] = None) -> Optional[Dict[str, Any]]:
-    """Resume from the newest (or given) snapshot; returns {'step': N, extra...}
-    or None when no snapshot exists."""
-    if step is None:
-        step = latest_checkpoint(directory)
-        if step is None:
-            return None
-    base = os.path.join(directory, f"step_{step}")
-    if model is not None:
-        load_state_dict(os.path.join(base, "model"),
-                        dict(model.state_dict()))
-    info: Dict[str, Any] = {"step": step}
+def _quarantine(base: str, problems: List[str]):
+    dst = base + ".corrupt"
+    i = 0
+    while os.path.exists(dst):
+        i += 1
+        dst = base + f".corrupt.{i}"
+    try:
+        _fs.rename(base, dst)
+    except OSError:
+        dst = None  # cannot move it; resume still skips it this run
+    mon = _monitor._active
+    if mon is not None:
+        mon.ckpt_corrupt(base, "; ".join(problems), quarantined=dst)
+    return dst
+
+
+def _restore(base: str, step: int, model, optimizer, grad_scaler
+             ) -> Dict[str, Any]:
     from .. import framework
+    if model is not None:
+        mpath = os.path.join(base, "model")
+        if not os.path.isdir(mpath):
+            raise CheckpointError(
+                f"snapshot {base} has no 'model/' payload (partial save or a "
+                f"model-less snapshot) — cannot restore model weights from it")
+        load_state_dict(mpath, dict(model.state_dict()))
+    info: Dict[str, Any] = {"step": step}
     opt_path = os.path.join(base, "optimizer.pdopt")
     if optimizer is not None and os.path.exists(opt_path):
         optimizer.set_state_dict(framework.io.load(opt_path))
     extra_path = os.path.join(base, "extra.pkl")
     if os.path.exists(extra_path):
         info.update(framework.io.load(extra_path, return_numpy=True))
+    if grad_scaler is not None and isinstance(info.get("grad_scaler"), dict):
+        grad_scaler.load_state_dict(info["grad_scaler"])
+    mon = _monitor._active
+    if mon is not None:
+        mon.ckpt_resumed(step, base)
     return info
+
+
+def load_checkpoint(directory: str, model=None, optimizer=None,
+                    step: Optional[int] = None, grad_scaler=None,
+                    verify: bool = True, quarantine: bool = True
+                    ) -> Optional[Dict[str, Any]]:
+    """Resume from the newest committed snapshot (or the given ``step``).
+
+    Auto-resume (``step=None``) verifies checksums, quarantines anything
+    torn or corrupt (renamed ``step_<N>.corrupt``) and falls back to the
+    previous committed snapshot; returns ``{'step': N, **extra}`` or None
+    when nothing committed is loadable. An EXPLICIT ``step`` that is
+    missing, uncommitted or fails verification raises :class:`CheckpointError`
+    with a diagnostic naming the snapshot — never an opaque backend error;
+    ``step=N, verify=False`` is the operator override that restores a
+    manifest-less snapshot anyway.
+
+    Directories written BEFORE the commit protocol hold manifest-less
+    snapshots, which auto-resume treats exactly like torn saves (skipped and
+    quarantined — renamed, never deleted). Upgrade by loading the newest one
+    explicitly with ``verify=False`` and re-saving it committed."""
+    if step is not None:
+        base = _snapshot_dir(directory, step)
+        if not os.path.isdir(base):
+            raise CheckpointError(
+                f"snapshot {base} does not exist "
+                f"(committed steps here: {committed_steps(directory)})")
+        manifest = read_manifest(base)
+        if manifest is None:
+            if not verify:
+                # operator escape hatch: an EXPLICIT step with verify=False
+                # restores a manifest-less snapshot best-effort (pre-manifest
+                # legacy dirs, or salvage from a quarantine copy)
+                return _restore(base, step, model, optimizer, grad_scaler)
+            missing = [] if os.path.isdir(os.path.join(base, "model")) \
+                else ["model/"]
+            raise CheckpointError(
+                f"snapshot {base} is not committed: no {MANIFEST_NAME} "
+                f"manifest" + (f" and {missing[0]} is missing" if missing
+                               else "") +
+                " — a save was interrupted here (or it predates the commit "
+                "protocol); pick a committed step "
+                f"({committed_steps(directory)}), let auto-resume "
+                "(step=None) fall back past it, or force this one with "
+                "verify=False if you trust it")
+        if verify:
+            problems = verify_snapshot(base, manifest)
+            if problems:
+                raise CheckpointError(
+                    "snapshot failed verification: " + "; ".join(problems))
+        return _restore(base, step, model, optimizer, grad_scaler)
+
+    all_steps = []
+    if os.path.isdir(directory):
+        _recover_aside(directory)
+        for d in os.listdir(directory):
+            m = _STEP_RE.match(d)
+            if m:
+                all_steps.append(int(m.group(1)))
+    for s in sorted(all_steps, reverse=True):
+        base = _snapshot_dir(directory, s)
+        manifest = read_manifest(base)
+        if manifest is None:
+            problems = [f"{base}: no {MANIFEST_NAME} manifest "
+                        f"(torn or in-progress save)"]
+        else:
+            problems = verify_snapshot(base, manifest) if verify else []
+            if not problems and model is not None and \
+                    not any(f.startswith("model/")
+                            for f in manifest["files"]):
+                # a HEALTHY snapshot that simply has no model payload
+                # (saved with model=None): incompatible with this restore,
+                # not corrupt — skip it but leave it alone
+                continue
+        if not problems:
+            try:
+                return _restore(base, s, model, optimizer, grad_scaler)
+            except CheckpointError:
+                # verified clean but incompatible with what the caller asked
+                # to restore — skip without destroying valid history
+                continue
+        if quarantine:
+            _quarantine(base, problems)
+        else:
+            mon = _monitor._active
+            if mon is not None:
+                mon.ckpt_corrupt(base, "; ".join(problems), quarantined=None)
+    return None
+
+
+# ------------------------------------------------------------------ async save
+
+class AsyncCheckpointer:
+    """Background checkpoint writer with at most ONE save in flight.
+
+    ``save()`` snapshots the model/optimizer/scaler state to host numpy
+    synchronously (so the training loop may mutate or donate device arrays
+    immediately) and hands the filesystem work — TensorStore writes, fsync,
+    manifest, prune — to a writer thread. A second ``save()`` while one is in
+    flight first waits for it (the "at most one" barrier). A write error is
+    raised on the NEXT ``save()``/``wait()``/``close()`` call, on the caller's
+    thread — training never dies inside the writer.
+
+    Usable as a context manager; ``close()`` (or ``__exit__``) is the
+    shutdown barrier that surfaces the last error.
+    """
+
+    def __init__(self, directory: str, keep: int = 3,
+                 retry: Optional[RetryPolicy] = None):
+        self.directory = directory
+        self.keep = keep
+        self._retry = retry
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._last_path: Optional[str] = None
+
+    # ------------------------------------------------------------------- api
+
+    def save(self, step: int, model=None, optimizer=None, grad_scaler=None,
+             extra: Optional[Dict[str, Any]] = None, block: bool = False,
+             _mode: Optional[str] = None) -> None:
+        """Queue one snapshot. ``block=True`` writes synchronously on this
+        thread (emergency saves want the barrier semantics of sync)."""
+        self.wait()  # barrier: one in flight; raises a previous write error
+        model_state, opt_state, ex = _capture(model, optimizer, grad_scaler,
+                                              extra)
+        model_state = _host_copy(model_state)
+        opt_state = _host_copy(opt_state)
+        ex = _host_copy(ex)
+        mode = _mode or ("sync" if block else "async")
+
+        def work():
+            try:
+                self._last_path = _write_snapshot(
+                    self.directory, step, model_state, opt_state, ex,
+                    self._retry, mode)
+                _prune_committed(self.directory, self.keep, self._last_path)
+            except BaseException as e:  # surfaced on the next call-in
+                self._error = e
+
+        if block:
+            work()
+            self._raise_pending()
+            return
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"ckpt-writer-step{step}")
+        self._thread = t
+        t.start()
+
+    def wait(self):
+        """Block until no save is in flight; re-raise any write error."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        self._raise_pending()
+
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def last_path(self) -> Optional[str]:
+        return self._last_path
+
+    def close(self):
+        self.wait()
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc):
+        # an exception is already unwinding: don't mask it with a stale
+        # writer error, but do drain the thread
+        if exc and exc[0] is not None:
+            t = self._thread
+            if t is not None:
+                t.join()
+                self._thread = None
+            return False
+        self.close()
+        return False
+
+    def _raise_pending(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e

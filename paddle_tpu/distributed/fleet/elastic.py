@@ -69,7 +69,13 @@ class ElasticManager:
         for t in self._threads:
             if t is not threading.current_thread():
                 t.join(timeout=self._interval * 4)
-        self._kv.put(self._prefix + self._me, "")  # tombstone
+        try:
+            self._kv.put(self._prefix + self._me, "")  # tombstone
+        except Exception:
+            # the KV master is often ALREADY GONE when a job winds down (it
+            # dies with node 0); shutdown must never throw over a courtesy
+            # write — peers fall back to the ttl expiry to notice us missing
+            pass
 
     # ----------------------------------------------------------------- loops
 

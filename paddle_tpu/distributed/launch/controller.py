@@ -13,10 +13,12 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ...utils.retry import backoff_delay
 from .master import Master
 
 ENV_PREFIX = "PADDLE_"
@@ -50,6 +52,13 @@ class LaunchContext:
     elastic_level: int = 0                 # 1: scale world on worker loss
     min_np: int = 1                        # elastic floor
     max_np: int = 0                        # elastic ceiling (0: nproc_per_node)
+    # preemption: on SIGTERM/SIGINT the controller forwards the signal to
+    # every rank (so they can emergency-checkpoint) and waits this many
+    # seconds before the hard kill
+    stop_grace: float = 15.0
+    # base delay of the exponential backoff between restarts (0 disables);
+    # a deterministically-failing pod must not hot-loop its restart budget
+    restart_backoff: float = 1.0
 
 
 class PodController:
@@ -61,6 +70,73 @@ class PodController:
         self.logs: List[Optional[object]] = []
         self._master: Optional[Master] = None
         self._token: str = ""
+        self._stop_signum: Optional[int] = None
+
+    # -------------------------------------------------------------- preempt
+
+    def _install_stop_handlers(self):
+        """Preemption contract: when the CONTROLLER gets SIGTERM/SIGINT, the
+        ranks get it immediately (their PreemptionWatcher / AutoCheckpoint
+        performs the emergency save), then `ctx.stop_grace` seconds pass
+        before the hard kill. Handler work is minimal — forward + flag; the
+        poll loop does the draining."""
+        if threading.current_thread() is not threading.main_thread():
+            return  # tests drive run() off-main; signals stay default there
+
+        self._prev_handlers = {}
+
+        def handler(signum, frame):
+            # forward + flag ONLY — no printing: a signal interrupting one
+            # of our own stderr writes would make print() a reentrant call
+            # into the buffered writer (RuntimeError out of the handler,
+            # skipping the very grace window this exists to provide). The
+            # drain path logs instead.
+            if self._stop_signum is not None:
+                return  # already stopping; grace clock keeps running
+            self._stop_signum = signum
+            # always forward SIGTERM: on an interactive Ctrl-C the terminal
+            # already delivered SIGINT to the whole foreground process group
+            # (ranks included), and a SECOND SIGINT would escalate the
+            # rank's PreemptionWatcher to KeyboardInterrupt mid-emergency-
+            # save; SIGTERM just re-records the preemption request
+            for p in self.procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+
+        try:
+            for s in (signal.SIGTERM, signal.SIGINT):
+                self._prev_handlers[s] = signal.signal(s, handler)
+        except (ValueError, OSError):
+            pass
+
+    def _restore_stop_handlers(self):
+        for s, h in getattr(self, "_prev_handlers", {}).items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers = {}
+
+    def _drain_after_stop(self) -> int:
+        """Wait out the grace period for ranks to finish their emergency
+        checkpoints, then terminate whatever is left. Exit code follows the
+        shell convention (128+signum) unless every rank exited cleanly."""
+        print(f"[launch] signal {self._stop_signum}: forwarded SIGTERM to "
+              f"{len(self.procs)} rank(s); grace "
+              f"{self.ctx.stop_grace:.0f}s before kill", file=sys.stderr)
+        deadline = time.time() + self.ctx.stop_grace
+        while time.time() < deadline:
+            if all(p.poll() is not None for p in self.procs):
+                break
+            time.sleep(0.2)
+        self._terminate()
+        codes = [p.poll() for p in self.procs]
+        if codes and all(c == 0 for c in codes):
+            return 0
+        return 128 + (self._stop_signum or signal.SIGTERM)
 
     # ------------------------------------------------------------- rendezvous
 
@@ -207,6 +283,24 @@ class PodController:
             if f:
                 f.close()
 
+    # -------------------------------------------------------------- restarts
+
+    def _backoff_sleep(self, fail_streak: int):
+        """Exponential backoff + jitter between restarts: an immediately-
+        failing pod burns seconds, not its whole restart budget, and a fleet
+        of preempted pods doesn't stampede the rendezvous master."""
+        base = self.ctx.restart_backoff
+        if base <= 0 or fail_streak < 1:
+            return
+        delay = backoff_delay(fail_streak, base, cap=60.0)
+        print(f"[launch] backing off {delay:.1f}s before restart "
+              f"(consecutive failures: {fail_streak})", file=sys.stderr)
+        deadline = time.time() + delay
+        while time.time() < deadline:
+            if self._stop_signum is not None:
+                return  # a stop signal cancels the pending restart
+            time.sleep(min(0.2, max(deadline - time.time(), 0.01)))
+
     # --------------------------------------------------------------- ps mode
 
     def _run_ps(self) -> int:
@@ -254,6 +348,8 @@ class PodController:
             # poll both roles: a dead pserver fails the job immediately
             # instead of letting trainers hang against a vanished endpoint
             while True:
+                if self._stop_signum is not None:
+                    return self._drain_after_stop()
                 for s in servers:
                     if s.poll() not in (None, 0):
                         return s.poll()
@@ -293,6 +389,7 @@ class PodController:
         # a deterministically-failing script must not restart forever: with
         # --max_restart unset, elastic still stops after a default budget
         budget = ctx.max_restart if ctx.max_restart > 0 else 10
+        fail_streak = 0
 
         def desired_np():
             if ctl:
@@ -306,14 +403,19 @@ class PodController:
 
         try:
             while True:
+                if self._stop_signum is not None:
+                    return self._drain_after_stop()
                 self._np_override = np_now
                 coordinator = f"127.0.0.1:{free_port()}"
                 self._token = self._bus_token(0)
                 os.environ["PADDLE_ELASTIC_RESTART"] = str(incarnation)
                 ctx.envs["PADDLE_ELASTIC_RESTART"] = str(incarnation)
                 self._spawn(0, coordinator)
+                t_up = time.time()
                 rc = None
                 while rc is None:
+                    if self._stop_signum is not None:
+                        return self._drain_after_stop()
                     time.sleep(0.3)
                     rc = self._poll()
                     want = desired_np()
@@ -323,6 +425,7 @@ class PodController:
                         self._terminate()
                         np_now = want
                         incarnation += 1
+                        fail_streak = 0  # operator-requested, not a failure
                         break
                 else:
                     self._terminate()
@@ -342,11 +445,26 @@ class PodController:
                               f"--min_np floor; restarting at np={np_now}",
                               file=sys.stderr)
                     incarnation += 1
+                    # an incarnation that ran a while earned a fresh backoff
+                    # ladder; a crash-on-startup climbs it
+                    fail_streak = 1 if time.time() - t_up >= 60.0 \
+                        else fail_streak + 1
+                    self._backoff_sleep(fail_streak)
                 continue
         finally:
             self._terminate()
 
     def run(self) -> int:
+        # the controller IS a preemption relay: hosted controllers run() on
+        # the main thread, so signal handlers install here and restore on
+        # exit (pytest-hosted controllers must not leak them)
+        self._install_stop_handlers()
+        try:
+            return self._run()
+        finally:
+            self._restore_stop_handlers()
+
+    def _run(self) -> int:
         if self.ctx.run_mode == "ps":
             return self._run_ps()
         if self.ctx.elastic_level > 0:
@@ -360,11 +478,15 @@ class PodController:
         node_rank, coordinator = self._rendezvous()
         self._token = self._bus_token(node_rank)
         restarts = 0
+        fail_streak = 0
         try:
             while True:
                 self._spawn(node_rank, coordinator)
+                t_up = time.time()
                 rc = None
                 while rc is None:
+                    if self._stop_signum is not None:
+                        return self._drain_after_stop()
                     time.sleep(0.5)
                     rc = self._poll()
                 self._terminate()
@@ -373,6 +495,11 @@ class PodController:
                 restarts += 1
                 print(f"[launch] pod failed (rc={rc}); restart "
                       f"{restarts}/{self.ctx.max_restart}", file=sys.stderr)
+                fail_streak = 1 if time.time() - t_up >= 60.0 \
+                    else fail_streak + 1
+                self._backoff_sleep(fail_streak)
+                if self._stop_signum is not None:
+                    return self._drain_after_stop()
         finally:
             self._terminate()
             if self._master is not None:

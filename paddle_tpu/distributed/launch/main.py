@@ -48,6 +48,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic floor: never scale below this worker count")
     p.add_argument("--max_np", type=int, default=0,
                    help="elastic ceiling for scale-out (0: nproc_per_node)")
+    p.add_argument("--stop_grace", type=float,
+                   default=float(os.environ.get("PADDLE_STOP_GRACE", "15")),
+                   help="seconds between forwarding SIGTERM/SIGINT to ranks "
+                        "(emergency-checkpoint window) and the hard kill")
+    p.add_argument("--restart_backoff", type=float,
+                   default=float(os.environ.get("PADDLE_RESTART_BACKOFF",
+                                                "1")),
+                   help="base seconds of the exponential backoff between "
+                        "pod restarts (0 disables)")
     p.add_argument("script", nargs=argparse.REMAINDER,
                    help="training script (or -m module) and its args")
     return p
@@ -69,7 +78,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
                         server_num=args.server_num,
                         trainer_num=args.trainer_num,
                         elastic_level=args.elastic_level, min_np=args.min_np,
-                        max_np=args.max_np)
+                        max_np=args.max_np, stop_grace=args.stop_grace,
+                        restart_backoff=args.restart_backoff)
     return PodController(ctx).run()
 
 

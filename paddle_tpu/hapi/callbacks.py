@@ -6,8 +6,8 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "VisualDL", "WandbCallback"]
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "AutoCheckpoint",
+           "EarlyStopping", "LRScheduler", "VisualDL", "WandbCallback"]
 
 
 class Callback:
@@ -24,6 +24,10 @@ class Callback:
     # train
     def on_train_begin(self, logs=None): pass
     def on_train_end(self, logs=None): pass
+    # fit is unwinding on an exception: on_train_end will NOT run; release
+    # process-global resources (signal handlers, writer threads) here and
+    # never raise — the real exception must win
+    def on_train_abort(self, exc=None): pass
     def on_epoch_begin(self, epoch, logs=None): pass
     def on_epoch_end(self, epoch, logs=None): pass
     def on_train_batch_begin(self, step, logs=None): pass
@@ -184,6 +188,166 @@ class ModelCheckpoint(Callback):
     def on_train_end(self, logs=None):
         if self.save_dir and self.model is not None:
             self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class AutoCheckpoint(Callback):
+    """Fault-tolerant auto-checkpointing for ``Model.fit``.
+
+    Reference analog: fluid/incubate/checkpoint/auto_checkpoint.py (periodic
+    job snapshots with automatic resume by job id), upgraded to the atomic
+    commit protocol of ``paddle_tpu.distributed.checkpoint``:
+
+    * saves model + optimizer (+ GradScaler) every ``save_steps`` optimizer
+      steps and/or ``save_secs`` seconds — asynchronously by default, so
+      training keeps stepping while TensorStore writes;
+    * auto-RESUMES at fit start from the newest committed snapshot in
+      ``directory`` (torn/corrupt snapshots are quarantined and skipped),
+      restoring the global step so the fit loop replays the data stream
+      position without re-training those batches;
+    * watches SIGTERM/SIGINT (preemption): at the next step boundary it
+      writes a synchronous emergency snapshot and stops fit cleanly — on a
+      preemptible TPU slice the relaunched job resumes exactly where the
+      eviction hit.
+    """
+
+    def __init__(self, directory: str, save_steps: Optional[int] = None,
+                 save_secs: Optional[float] = None, keep: int = 3,
+                 resume: bool = True, asynchronous: bool = True,
+                 grad_scaler=None, watch_signals: bool = True,
+                 verbose: int = 1):
+        super().__init__()
+        if not save_steps and save_secs is None:
+            save_steps = 100  # save SOMETHING periodically by default
+        self.directory = directory
+        self.save_steps = save_steps
+        self.save_secs = save_secs
+        self.keep = keep
+        self.resume = resume
+        self.asynchronous = asynchronous
+        self.grad_scaler = grad_scaler
+        self.watch_signals = watch_signals
+        self.verbose = verbose
+        self._ckptr = None
+        self._watcher = None
+        self._global_step = 0
+        self._last_saved = -1
+        self._t_last = 0.0
+        self._emergency_done = False
+
+    # ------------------------------------------------------------- plumbing
+
+    def _scaler(self):
+        return self.grad_scaler or getattr(self.model, "_grad_scaler", None)
+
+    def _save(self, block: bool, mode: Optional[str] = None):
+        if self._global_step == self._last_saved:
+            return  # this exact state is already snapshotted (e.g. a
+            # save_secs tick right after resume or a periodic save)
+        self._ckptr.save(self._global_step, model=self.model.network,
+                         optimizer=self.model._optimizer,
+                         grad_scaler=self._scaler(), block=block, _mode=mode)
+        self._last_saved = self._global_step
+        self._t_last = time.monotonic()
+
+    # ------------------------------------------------------------ callbacks
+
+    def on_train_begin(self, logs=None):
+        from ..distributed import checkpoint as _ckpt
+        from ..distributed.preemption import PreemptionWatcher
+        self._ckptr = _ckpt.AsyncCheckpointer(self.directory, keep=self.keep)
+        self._global_step = 0
+        self._last_saved = -1
+        self._emergency_done = False
+        if getattr(self.model, "_metric_lag", 0):
+            import warnings
+            warnings.warn(
+                "AutoCheckpoint under fit(metric_lag>0): step boundaries are "
+                "observed with up to metric_lag steps of lag, so a snapshot "
+                "can label weights that already contain a few more updates "
+                "than its recorded step — resume would re-train those "
+                "batches. Use metric_lag=0 for exact resume.", stacklevel=2)
+        if self.resume and self.model is not None:
+            info = _ckpt.load_checkpoint(self.directory,
+                                         model=self.model.network,
+                                         optimizer=self.model._optimizer,
+                                         grad_scaler=self._scaler())
+            if info is not None:
+                self._global_step = int(info["step"])
+                self._last_saved = self._global_step
+                self.model._resume_step = self._global_step
+                if self.verbose:
+                    print(f"AutoCheckpoint: resuming from step "
+                          f"{self._global_step} ({self.directory})",
+                          file=sys.stderr)
+        # install the process-global handlers only once the fallible resume
+        # is done: if it raises, fit unwinds before on_train_abort/-end
+        # would run, and a leaked watcher swallows every later SIGTERM
+        if self.watch_signals:
+            self._watcher = PreemptionWatcher().install()
+        self._t_last = time.monotonic()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._watcher is not None and self._watcher.requested():
+            if self._emergency_done:
+                # fit(metric_lag>0) drains lagged batch-end events after the
+                # stop: the snapshot is already on disk, don't burn the
+                # preemption grace window re-writing it per drained step
+                return
+            # preemption: emergency snapshot AT the step boundary, then stop
+            # fit — the relaunch resumes from exactly this step
+            try:
+                try:
+                    self._ckptr.wait()
+                except Exception as stale:
+                    # an earlier periodic save failed (transient fs error):
+                    # that stale error must not abort the one save that
+                    # matters most — report it and write the snapshot anyway
+                    import warnings
+                    warnings.warn(
+                        f"AutoCheckpoint: discarding stale async write "
+                        f"error before the emergency save: {stale!r}",
+                        stacklevel=2)
+                self._save(block=True, mode="emergency")
+                self._emergency_done = True
+            finally:
+                self.model.stop_training = True
+            if self.verbose:
+                print(f"AutoCheckpoint: emergency snapshot at step "
+                      f"{self._global_step} (signal "
+                      f"{self._watcher.signum}); stopping", file=sys.stderr)
+            return
+        due = bool(self.save_steps) and \
+            self._global_step % self.save_steps == 0
+        if not due and self.save_secs is not None:
+            due = time.monotonic() - self._t_last >= self.save_secs
+        if due:
+            self._save(block=not self.asynchronous)
+
+    def on_train_end(self, logs=None):
+        try:
+            if self._ckptr is not None:
+                self._ckptr.wait()  # surface any async write error here
+        finally:
+            if self._watcher is not None:
+                self._watcher.uninstall()
+                self._watcher = None
+
+    def on_train_abort(self, exc=None):
+        # fit is dying on its own exception: drain the writer WITHOUT
+        # raising (a stale write error must not mask the real failure) and
+        # give the signal handlers back
+        try:
+            if self._ckptr is not None:
+                t = self._ckptr._thread
+                if t is not None:
+                    t.join()
+        except Exception:
+            pass
+        finally:
+            if self._watcher is not None:
+                self._watcher.uninstall()
+                self._watcher = None
 
 
 class EarlyStopping(Callback):

@@ -84,17 +84,26 @@ class Model:
         self._train_step = None
         self._accumulate_steps = 1
         self._pending_microbatches = []
+        self._grad_scaler = None
+        # set by callbacks.AutoCheckpoint on resume: fit skips (replays the
+        # data position of) the first N global batches without training
+        self._resume_step = 0
 
     # -------------------------------------------------------------- prepare
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
-                jit_compile: bool = False, accumulate_steps: int = 1):
+                jit_compile: bool = False, accumulate_steps: int = 1,
+                grad_scaler=None):
         """``accumulate_steps=K`` (K>1) trains through the compiled
         accumulation path: one ``jit.TrainStep`` executable consumes K
         stacked microbatches, runs forward/backward K times and applies ONE
         optimizer update — effective batch ×K with flat parameter/optimizer
         HBM. Implies ``jit_compile=True`` (accumulation is compiled into the
-        step; see ``train_batch`` for the eager-API adapter)."""
+        step; see ``train_batch`` for the eager-API adapter).
+
+        ``grad_scaler``: an ``amp.GradScaler`` compiled into the TrainStep
+        (dynamic loss scaling on device; requires the jit path). Its state
+        is checkpointed/restored by ``callbacks.AutoCheckpoint``."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -115,6 +124,13 @@ class Model:
                  "jit_compile=True trains through jit.TrainStep, which "
                  "returns only the loss; hapi metrics need eager outputs — "
                  "drop the metrics or jit_compile"))
+        if grad_scaler is not None and not jit_compile:
+            raise ValueError(
+                "prepare(grad_scaler=...) compiles dynamic loss scaling into "
+                "the jit.TrainStep executable — it requires jit_compile=True "
+                "(the eager fit path never routes through the scaler, which "
+                "would silently train without loss scaling)")
+        self._grad_scaler = grad_scaler
         self._jit_compile = jit_compile
         self._train_step = None
         self._pending_microbatches = []
@@ -219,7 +235,8 @@ class Model:
                 net = _LossNet(self.network, self._loss, n_labels)
             self._train_step = TrainStep(
                 net, self._optimizer,
-                accumulate_steps=self._accumulate_steps)
+                accumulate_steps=self._accumulate_steps,
+                grad_scaler=self._grad_scaler)
         return self._train_step
 
     @no_grad()
@@ -283,6 +300,8 @@ class Model:
                        if eval_data is not None else None)
         self._save_dir = save_dir
         self.stop_training = False
+        self._resume_step = 0  # an AutoCheckpoint callback may set it next
+        self._metric_lag = metric_lag
         try:
             steps = len(train_loader) if hasattr(train_loader, "__len__") \
                 else None
@@ -292,16 +311,24 @@ class Model:
                                 verbose=verbose, save_dir=save_dir,
                                 log_freq=log_freq)
 
-        cbks.on_train_begin()
         history = []
         try:
+            # inside the try: a sibling callback raising in on_train_begin
+            # must still reach on_train_abort, or an already-installed
+            # AutoCheckpoint watcher leaks its process-global handlers
+            cbks.on_train_begin()
             history = self._fit_loop(train_loader, eval_loader, epochs,
                                      eval_freq, steps, verbose, cbks,
                                      metric_lag)
         except BaseException as e:
             # flight-recorder post-mortem of the crashed run (no-op when the
-            # monitor is disabled)
+            # monitor is disabled), then let callbacks release process-global
+            # resources (on_train_end will never run)
             _monitor.on_crash(e)
+            try:
+                cbks.on_train_abort(e)
+            except Exception:
+                pass
             raise
         cbks.on_train_end()
         return history
@@ -309,6 +336,10 @@ class Model:
     def _fit_loop(self, train_loader, eval_loader, epochs, eval_freq, steps,
                   verbose, cbks, metric_lag):
         history = []
+        # global (cross-epoch) batch counter; after an auto-resume the first
+        # `_resume_step` batches are consumed WITHOUT training so the data
+        # stream position matches the run being resumed
+        self._global_step = 0
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -335,6 +366,11 @@ class Model:
                 # every step in order
                 drain = MetricDrain(max_lag=metric_lag)
                 for step, batch in enumerate(train_loader):
+                    if self.stop_training:
+                        break  # emergency checkpoint / early stop mid-epoch
+                    self._global_step += 1
+                    if self._global_step <= self._resume_step:
+                        continue  # replaying data position after auto-resume
                     cbks.on_train_batch_begin(step)
                     ins, lbs = self._split_batch(batch)
                     res = self.train_batch(ins, lbs, sync=False)
@@ -347,11 +383,29 @@ class Model:
                     cbks.on_train_batch_end(s, logs)
             else:
                 for step, batch in enumerate(train_loader):
+                    if self.stop_training:
+                        break  # emergency checkpoint / early stop mid-epoch
+                    self._global_step += 1
+                    if self._global_step <= self._resume_step:
+                        continue  # replaying data position after auto-resume
                     cbks.on_train_batch_begin(step)
                     ins, lbs = self._split_batch(batch)
                     res = self.train_batch(ins, lbs)
                     logs = self._logs_from(res)
                     cbks.on_train_batch_end(step, logs)
+            if self.stop_training:
+                # stopped MID-epoch (emergency checkpoint / callback): no
+                # epoch-end callbacks, no eval over a truncated epoch — and
+                # a preempted rank must exit inside the launcher's grace
+                # window, not run a full evaluation pass first
+                break
+            if self._global_step <= self._resume_step:
+                # the WHOLE epoch was replayed data positioning after an
+                # auto-resume: no training happened, so no epoch-end
+                # callbacks (an EarlyStopping eval on identical restored
+                # weights would count it as "no improvement"), no eval, no
+                # history entry
+                continue
             cbks.on_epoch_end(epoch, logs)
             mon = _monitor._active
             if mon is not None:

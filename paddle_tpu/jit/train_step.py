@@ -536,6 +536,44 @@ class TrainStep:
         self._scaler._compiled_outcome(found)
         return loss_out["loss"]
 
+    # --------------------------------------------------------- checkpointing
+
+    def save_checkpoint(self, directory: str, step: int, extra=None,
+                        keep: int = 3, block: bool = False):
+        """Snapshot model + optimizer (+ compiled-in GradScaler) through the
+        fault-tolerant checkpoint subsystem — the raw-loop counterpart of
+        ``hapi.callbacks.AutoCheckpoint``. Async by default (``block=False``):
+        state is snapshotted to host now, written in the background, at most
+        one save in flight; a prior write error surfaces on the next call.
+        ``block=True`` is the emergency-save form (e.g. after
+        ``PreemptionWatcher.requested()``)."""
+        from ..distributed.checkpoint import AsyncCheckpointer
+        ckptr = getattr(self, "_ckptr", None)
+        if ckptr is None or ckptr.directory != directory:
+            if ckptr is not None:
+                ckptr.close()
+            ckptr = AsyncCheckpointer(directory, keep=keep)
+            self._ckptr = ckptr
+        ckptr.keep = keep
+        ckptr.save(step, model=self._model, optimizer=self._opt,
+                   grad_scaler=self._scaler, extra=extra, block=block)
+
+    def wait_checkpoint(self):
+        """Barrier for an in-flight async save (surfaces write errors)."""
+        ckptr = getattr(self, "_ckptr", None)
+        if ckptr is not None:
+            ckptr.wait()
+
+    def load_checkpoint(self, directory: str, step=None):
+        """Resume model/optimizer/scaler from the newest committed snapshot
+        (falling back past torn/corrupt ones); returns the checkpoint info
+        dict ({'step': N, ...}) or None when nothing is loadable. The fast
+        path re-adopts the restored arrays on the next call."""
+        from ..distributed.checkpoint import load_checkpoint
+        return load_checkpoint(directory, model=self._model,
+                               optimizer=self._opt, step=step,
+                               grad_scaler=self._scaler)
+
     # ------------------------------------------------------------- fast path
 
     def _input_sig(self, input_arrays):

@@ -244,6 +244,41 @@ class Monitor:
         self.emit("epoch", epoch=epoch, steps=steps, wall_s=wall_s,
                   logs={k: float(v) for k, v in (logs or {}).items()})
 
+    # ---------------------------------------------- integration: checkpointing
+
+    def ckpt_saved(self, step: int, nbytes: int, dur_s: float, mode: str,
+                   attempts: int = 1):
+        """A snapshot committed. mode: "sync" | "async" | "emergency"."""
+        self.registry.counter("ckpt/saves").inc()
+        if mode == "emergency":
+            self.registry.counter("ckpt/emergency_saves").inc()
+        self.registry.gauge("ckpt/last_step").set(step)
+        self.registry.gauge("ckpt/last_bytes").set(nbytes)
+        self.registry.histogram("ckpt/save_s").observe(dur_s)
+        self.emit("ckpt_save", step=step, bytes=nbytes, dur_s=dur_s,
+                  mode=mode, attempts=attempts)
+
+    def ckpt_retry(self, step: int, attempt: int):
+        """A snapshot write attempt failed transiently and is being retried."""
+        self.registry.counter("ckpt/retries").inc()
+        self.emit("ckpt_retry", step=step, attempt=attempt)
+
+    def ckpt_corrupt(self, path: str, why: str,
+                     quarantined: Optional[str] = None):
+        """Auto-resume skipped a torn/corrupt snapshot (quarantined when it
+        could be renamed out of the resume scan)."""
+        self.registry.counter("ckpt/corrupt_skipped").inc()
+        self.emit("ckpt_corrupt", path=path, why=why, quarantined=quarantined)
+
+    def ckpt_resumed(self, step: int, path: str):
+        self.registry.counter("ckpt/resumes").inc()
+        self.emit("ckpt_resume", step=step, path=path)
+
+    def preempted(self, signum: int):
+        """A watched preemption signal arrived (SIGTERM/SIGINT)."""
+        self.registry.counter("preempt/signals").inc()
+        self.emit("preemption", signum=int(signum))
+
     # -------------------------------------------------- integration: profiler
 
     def stage_event(self, name: str, start: float, end: float, kind: str):

@@ -246,11 +246,23 @@ class Optimizer:
 
     # ------------------------------------------------------------ checkpoint
 
+    def _param_keys(self):
+        """Checkpoint keys for _parameter_list. Layer-assigned names are NOT
+        unique across layers ('linear.weight' twice in a 2-Linear net), and a
+        colliding key silently cross-wires moment tensors between parameters
+        on restore — so duplicated names get an __<index> disambiguator.
+        Unique names keep their bare key (old snapshots stay loadable)."""
+        from collections import Counter
+        names = [p.name or f"param_{i}"
+                 for i, p in enumerate(self._parameter_list)]
+        counts = Counter(names)
+        return [f"{n}__{i}" if counts[n] > 1 else n
+                for i, n in enumerate(names)]
+
     def state_dict(self):
         out = {"master_weights": {}, "LR_Scheduler": {}}
-        for i, p in enumerate(self._parameter_list):
+        for p, key in zip(self._parameter_list, self._param_keys()):
             pid = id(p)
-            key = p.name or f"param_{i}"
             if pid in self._accumulators:
                 for name, arr in self._accumulators[pid].items():
                     out[f"{key}_{name}"] = Tensor(arr)
@@ -262,8 +274,7 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state):
-        for i, p in enumerate(self._parameter_list):
-            key = p.name or f"param_{i}"
+        for p, key in zip(self._parameter_list, self._param_keys()):
             acc = {}
             for name in self._state_names:
                 k = f"{key}_{name}"

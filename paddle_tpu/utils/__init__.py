@@ -1,2 +1,4 @@
 """paddle.utils (reference python/paddle/utils)."""
 from . import cpp_extension  # noqa: F401
+from . import retry  # noqa: F401
+from .retry import RetryPolicy, backoff_delay  # noqa: F401

@@ -1,0 +1,61 @@
+"""Deterministic raw-TrainStep training worker for the kill-and-resume e2e.
+
+Trains a tiny net for --steps steps (data is a pure function of the step
+index, so any two runs walk the same trajectory), snapshotting through
+``TrainStep.save_checkpoint`` every --save-every steps, auto-resuming from
+the newest committed snapshot at startup. Appends one JSONL loss record per
+trained step and writes the final weights — the parent test compares these
+against an uninterrupted reference run.
+
+Fault injection rides the checkpoint module's ``PADDLE_CKPT_FAULT`` env var
+(the parent sets e.g. ``die_before_commit:9`` to SIGKILL this process
+mid-save at step 9).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save-every", type=int, default=3)
+    args = ap.parse_args()
+
+    ckpt_dir = os.path.join(args.workdir, "ckpt")
+    paddle.seed(0)
+    net = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step_fn = paddle.jit.TrainStep(net, opt,
+                                   loss_fn=lambda out: (out ** 2).mean())
+
+    start = 0
+    info = step_fn.load_checkpoint(ckpt_dir)
+    if info is not None:
+        start = int(info["step"])
+        print(f"resumed from {start}", flush=True)
+
+    with open(os.path.join(args.workdir, "losses.jsonl"), "a") as f:
+        for step in range(start + 1, args.steps + 1):
+            rng = np.random.RandomState(step)  # data = f(step index)
+            x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+            loss = step_fn(x)
+            f.write(json.dumps({"step": step, "loss": float(loss)}) + "\n")
+            f.flush()
+            if step % args.save_every == 0:
+                step_fn.save_checkpoint(ckpt_dir, step, block=True)
+    np.save(os.path.join(args.workdir, "final.npy"), net.weight.numpy())
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

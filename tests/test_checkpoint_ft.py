@@ -1,0 +1,766 @@
+"""Fault-tolerant checkpointing: atomic commit protocol, corruption
+quarantine + fallback, async writer, retry policy, preemption watcher,
+AutoCheckpoint fit resume, controller backoff, ckpt_inspect CLI.
+
+Fault injection here is in-process (the ``ckpt._fs`` seam + file truncation);
+the subprocess kill -9 drill lives in test_kill_resume.py.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.preemption import PreemptionWatcher
+from paddle_tpu.utils.retry import RetryPolicy, backoff_delay
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def mon():
+    m = monitor.enable(None)  # flight-recorder-only session, no sink file
+    yield m
+    monitor.disable()
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    return paddle.nn.Linear(4, 4)
+
+
+def _train_and_save(directory, steps, keep=3, seed=0):
+    """Train a tiny net, snapshotting at each step in `steps`; returns the
+    net and {step: weights} observed at each save."""
+    net = _net(seed)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    seen = {}
+    for step in steps:
+        (net(x) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+        ckpt.save_checkpoint(str(directory), step, model=net, optimizer=opt,
+                             extra={"lr": 0.01}, keep=keep)
+        seen[step] = net.weight.numpy().copy()
+    return net, opt, seen
+
+
+# ----------------------------------------------------------- commit protocol
+
+
+def test_commit_manifest_and_roundtrip(tmp_path):
+    net, opt, seen = _train_and_save(tmp_path, [7], keep=3)
+    base = tmp_path / "step_7"
+    assert base.is_dir() and not (tmp_path / "step_7.tmp").exists()
+    m = ckpt.read_manifest(str(base))
+    assert m["schema"] == ckpt.SCHEMA_VERSION and m["step"] == 7
+    assert m["world_size"] >= 1 and m["files"]
+    for meta in m["files"].values():
+        assert set(meta) == {"sha256", "bytes"}
+    assert ckpt.verify_snapshot(str(base)) == []
+
+    net2, opt2 = _net(1), None
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2)
+    assert info["step"] == 7 and info["lr"] == 0.01
+    np.testing.assert_array_equal(net2.weight.numpy(), seen[7])
+
+
+def test_latest_and_resume_only_see_committed(tmp_path, mon):
+    _train_and_save(tmp_path, [10])
+    # a torn snapshot (no COMMIT) with a HIGHER step, plus an in-flight tmp
+    torn = tmp_path / "step_99"
+    torn.mkdir()
+    (torn / "garbage.bin").write_bytes(b"\x00" * 64)
+    (tmp_path / "step_50.tmp").mkdir()
+
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 10
+    assert ckpt.committed_steps(str(tmp_path)) == [10]
+
+    net2 = _net(1)
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2)
+    assert info["step"] == 10
+    # the torn dir was quarantined out of the resume scan; tmp left alone
+    assert not torn.exists()
+    assert (tmp_path / "step_99.corrupt").is_dir()
+    assert (tmp_path / "step_50.tmp").is_dir()
+    assert mon.registry.counter("ckpt/corrupt_skipped").value >= 1
+
+
+def test_corrupt_checksum_quarantined_falls_back(tmp_path, mon):
+    _, _, seen = _train_and_save(tmp_path, [1, 2])
+    # flip bytes in one of step_2's payload files
+    m = ckpt.read_manifest(str(tmp_path / "step_2"))
+    rel = sorted(m["files"])[0]
+    victim = tmp_path / "step_2" / rel
+    victim.write_bytes(b"\xff" + victim.read_bytes()[1:])
+
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 2  # committed, but rotten
+    net2 = _net(1)
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2)
+    assert info["step"] == 1  # fell back past the corrupt snapshot
+    np.testing.assert_array_equal(net2.weight.numpy(), seen[1])
+    assert (tmp_path / "step_2.corrupt").is_dir()
+    assert mon.registry.counter("ckpt/corrupt_skipped").value >= 1
+    assert mon.registry.counter("ckpt/resumes").value == 1
+
+
+def test_truncated_file_detected(tmp_path):
+    _train_and_save(tmp_path, [3])
+    base = tmp_path / "step_3"
+    m = ckpt.read_manifest(str(base))
+    rel = max(m["files"], key=lambda r: m["files"][r]["bytes"])
+    p = base / rel
+    p.write_bytes(p.read_bytes()[:-1])  # truncate by one byte
+    problems = ckpt.verify_snapshot(str(base))
+    assert problems and "truncated" in problems[0]
+
+
+def test_explicit_step_diagnostics(tmp_path):
+    _train_and_save(tmp_path, [5])
+    # missing step
+    with pytest.raises(ckpt.CheckpointError, match=r"step_8 does not exist"):
+        ckpt.load_checkpoint(str(tmp_path), step=8)
+    # partial snapshot: dir exists, nothing inside (classic torn save)
+    (tmp_path / "step_7").mkdir()
+    with pytest.raises(ckpt.CheckpointError) as ei:
+        ckpt.load_checkpoint(str(tmp_path), model=_net(1), step=7)
+    msg = str(ei.value)
+    assert "step_7" in msg and ckpt.MANIFEST_NAME in msg and "model/" in msg
+    # committed but failing verification
+    m = ckpt.read_manifest(str(tmp_path / "step_5"))
+    rel = sorted(m["files"])[0]
+    victim = tmp_path / "step_5" / rel
+    victim.write_bytes(victim.read_bytes() + b"x")
+    with pytest.raises(ckpt.CheckpointError, match="verification"):
+        ckpt.load_checkpoint(str(tmp_path), step=5)
+
+
+def test_rotted_manifest_fields_treated_as_torn(tmp_path):
+    """A COMMIT file that still parses as JSON but has rotted field types
+    must read as uncommitted — not crash the resume scan or the CLI."""
+    _, _, seen = _train_and_save(tmp_path, [1, 2])
+    (tmp_path / "step_2" / ckpt.MANIFEST_NAME).write_text(
+        json.dumps({"schema": "x", "step": "abc", "files": {}}))
+    assert ckpt.read_manifest(str(tmp_path / "step_2")) is None
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 1
+    net2 = _net(1)
+    assert ckpt.load_checkpoint(str(tmp_path), model=net2)["step"] == 1
+    tool = os.path.join(REPO, "tools", "ckpt_inspect.py")
+    r = subprocess.run([sys.executable, tool, str(tmp_path), "--json"],
+                       capture_output=True, text=True, timeout=60)
+    rows = {x["name"]: x["status"]
+            for x in json.loads(r.stdout)["snapshots"]}
+    assert rows.get("step_2.corrupt", rows.get("step_2")) in ("TORN",
+                                                              "CORRUPT")
+
+
+def test_explicit_verify_false_restores_legacy_snapshot(tmp_path):
+    """Operator escape hatch: a manifest-less (pre-commit-protocol) snapshot
+    restores via an explicit step with verify=False."""
+    net, _, seen = _train_and_save(tmp_path, [5])
+    os.remove(tmp_path / "step_5" / ckpt.MANIFEST_NAME)  # now "legacy"
+    assert ckpt.latest_checkpoint(str(tmp_path)) is None
+    with pytest.raises(ckpt.CheckpointError, match="verify=False"):
+        ckpt.load_checkpoint(str(tmp_path), step=5)
+    net2 = _net(1)
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2, step=5,
+                                verify=False)
+    assert info["step"] == 5
+    np.testing.assert_array_equal(net2.weight.numpy(), seen[5])
+
+
+def test_resave_existing_step_replaces_and_cleans_aside(tmp_path):
+    """Re-saving an existing step (post-rollback) publishes the new payload
+    and leaves no .old/.tmp residue once committed."""
+    net, opt, _ = _train_and_save(tmp_path, [5])
+    w_new = np.full_like(net.weight.numpy(), 7.0)
+    net.weight.set_value(paddle.to_tensor(w_new))
+    ckpt.save_checkpoint(str(tmp_path), 5, model=net)
+    assert sorted(os.listdir(tmp_path)) == ["step_5"]
+    assert ckpt.verify_snapshot(str(tmp_path / "step_5")) == []
+    net2 = _net(1)
+    ckpt.load_checkpoint(str(tmp_path), model=net2, step=5)
+    np.testing.assert_array_equal(net2.weight.numpy(), w_new)
+
+
+def test_resave_retry_never_destroys_committed_original(tmp_path, monkeypatch):
+    """Re-saving an existing committed step with a flaky COMMIT write: the
+    retry loop must never eat the parked original, and a PERSISTENT failure
+    must leave the ORIGINAL committed content in place."""
+    net, _, seen = _train_and_save(tmp_path, [5])
+    w_new = np.full_like(seen[5], 7.0)
+    net.weight.set_value(paddle.to_tensor(w_new))
+    real = ckpt._fs.replace
+
+    def flaky_commit(src, dst, fails={"n": 1}):
+        if dst.endswith(ckpt.MANIFEST_NAME) and fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient COMMIT write failure")
+        return real(src, dst)
+
+    monkeypatch.setattr(ckpt._fs, "replace", flaky_commit)
+    policy = RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0)
+    ckpt.save_checkpoint(str(tmp_path), 5, model=net, retry=policy)
+    monkeypatch.undo()
+    assert sorted(os.listdir(tmp_path)) == ["step_5"]  # no .old/.tmp residue
+    net2 = _net(1)
+    ckpt.load_checkpoint(str(tmp_path), model=net2, step=5)
+    np.testing.assert_array_equal(net2.weight.numpy(), w_new)
+
+    # persistent failure: the re-save raises, but the snapshot that was
+    # committed BEFORE the re-save is back in place and loadable
+    def always_fail_commit(src, dst):
+        if dst.endswith(ckpt.MANIFEST_NAME):
+            raise OSError("disk on fire")
+        return real(src, dst)
+
+    net.weight.set_value(paddle.to_tensor(np.full_like(w_new, 9.0)))
+    monkeypatch.setattr(ckpt._fs, "replace", always_fail_commit)
+    with pytest.raises(OSError, match="disk on fire"):
+        ckpt.save_checkpoint(str(tmp_path), 5, model=net,
+                             retry=RetryPolicy(max_attempts=2,
+                                               base_delay=0.001))
+    monkeypatch.undo()
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 5
+    net3 = _net(1)
+    ckpt.load_checkpoint(str(tmp_path), model=net3, step=5)
+    np.testing.assert_array_equal(net3.weight.numpy(), w_new)  # pre-re-save
+
+
+def test_crash_in_set_aside_window_recovers(tmp_path):
+    """A committed step_N parked at step_N.old (re-save crashed before the
+    replacement committed) is restored by the resume scan; the torn
+    replacement is quarantined."""
+    _, _, seen = _train_and_save(tmp_path, [5])
+    os.rename(tmp_path / "step_5", tmp_path / "step_5.old")
+    torn = tmp_path / "step_5"
+    torn.mkdir()
+    (torn / "half").write_bytes(b"x")
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 5  # recovered
+    assert not (tmp_path / "step_5.old").exists()
+    assert any(d.startswith("step_5.corrupt") for d in os.listdir(tmp_path))
+    net2 = _net(1)
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2)
+    assert info["step"] == 5
+    np.testing.assert_array_equal(net2.weight.numpy(), seen[5])
+
+
+def test_emergency_manifest_is_size_only(tmp_path):
+    """Emergency saves skip the full-payload re-hash (the grace window is
+    for writing): manifests record sizes only and still verify/load."""
+    net = _net(0)
+    ac = ckpt.AsyncCheckpointer(str(tmp_path))
+    ac.save(4, model=net, block=True, _mode="emergency")
+    m = ckpt.read_manifest(str(tmp_path / "step_4"))
+    assert m["files"] and all(f["sha256"] is None for f in m["files"].values())
+    assert ckpt.verify_snapshot(str(tmp_path / "step_4")) == []
+    assert ckpt.load_checkpoint(str(tmp_path), model=_net(1))["step"] == 4
+
+
+def test_failed_resume_does_not_leak_signal_handlers(tmp_path):
+    """If auto-resume raises inside on_train_begin (snapshot incompatible
+    with the network), the preemption handlers must not be left installed."""
+    from paddle_tpu.hapi.callbacks import AutoCheckpoint
+    paddle.seed(0)
+    big = paddle.nn.Linear(8, 8)
+    ckpt.save_checkpoint(str(tmp_path), 1, model=big)
+    prev = signal.getsignal(signal.SIGTERM)
+    m = _fit_setup(0)  # Linear(4, 2): restore cannot fit this snapshot
+    with pytest.raises(Exception):
+        m.fit(_fit_data(2), epochs=1, verbose=0, shuffle=False,
+              callbacks=[AutoCheckpoint(str(tmp_path), save_steps=100,
+                                        verbose=0)])
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_model_missing_payload_diagnostic(tmp_path):
+    """A committed snapshot saved WITHOUT a model must fail a model restore
+    with a named diagnostic, not an Orbax/TensorStore traceback."""
+    net = _net(0)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    ckpt.save_checkpoint(str(tmp_path), 4, optimizer=opt)  # model-less
+    with pytest.raises(ckpt.CheckpointError, match=r"no 'model/' payload"):
+        ckpt.load_checkpoint(str(tmp_path), model=_net(1), step=4)
+
+
+# ------------------------------------------------------------------- pruning
+
+
+def test_prune_only_committed_snapshots(tmp_path):
+    # non-committed entries that must SURVIVE pruning
+    torn = tmp_path / "step_2"
+    torn.mkdir()
+    (torn / "half-written").write_bytes(b"x")
+    (tmp_path / "step_1.tmp").mkdir()
+    quarantined = tmp_path / "step_0.corrupt"
+    quarantined.mkdir()
+
+    _train_and_save(tmp_path, [10, 20, 30, 40], keep=2)
+    assert ckpt.committed_steps(str(tmp_path)) == [30, 40]
+    assert torn.is_dir() and (tmp_path / "step_1.tmp").is_dir() \
+        and quarantined.is_dir()
+    # and the snapshot just written never prunes itself, even at keep=1
+    _train_and_save(tmp_path / "k1", [1], keep=1)
+    assert ckpt.committed_steps(str(tmp_path / "k1")) == [1]
+
+
+# --------------------------------------------------------------------- retry
+
+
+def test_backoff_delay_math():
+    rng = __import__("random").Random(0)
+    d = [backoff_delay(a, 0.1, cap=1.0, jitter=0.0) for a in (1, 2, 3, 4, 5)]
+    assert d == [0.1, 0.2, 0.4, 0.8, 1.0]  # doubles, then the cap
+    dj = backoff_delay(1, 0.1, jitter=0.5, rng=rng)
+    assert 0.1 <= dj <= 0.15001
+    assert backoff_delay(3, 0.0) == 0.0
+
+
+def test_retry_transient_fs_error_then_success(tmp_path, mon, monkeypatch):
+    real = ckpt._fs.replace
+    fails = {"n": 2}
+
+    def flaky(src, dst):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("injected transient fs error")
+        return real(src, dst)
+
+    monkeypatch.setattr(ckpt._fs, "replace", flaky)
+    policy = RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0.0)
+    net = _net(0)
+    ckpt.save_checkpoint(str(tmp_path), 1, model=net, retry=policy)
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 1
+    assert ckpt.verify_snapshot(str(tmp_path / "step_1")) == []
+    assert mon.registry.counter("ckpt/retries").value == 2
+    assert mon.registry.counter("ckpt/saves").value == 1
+
+
+def test_retry_exhausted_raises_then_recovers(tmp_path, monkeypatch):
+    def always_fail(src, dst):
+        raise OSError("disk on fire")
+
+    net = _net(0)
+    policy = RetryPolicy(max_attempts=2, base_delay=0.001, jitter=0.0)
+    monkeypatch.setattr(ckpt._fs, "replace", always_fail)
+    with pytest.raises(OSError, match="disk on fire"):
+        ckpt.save_checkpoint(str(tmp_path), 1, model=net, retry=policy)
+    assert ckpt.latest_checkpoint(str(tmp_path)) is None
+    monkeypatch.undo()
+    ckpt.save_checkpoint(str(tmp_path), 1, model=net)  # leftovers overwritten
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 1
+
+
+# --------------------------------------------------------------- async writes
+
+
+def test_async_checkpointer_snapshot_semantics(tmp_path):
+    net = _net(0)
+    w_at_save = net.weight.numpy().copy()
+    ac = ckpt.AsyncCheckpointer(str(tmp_path), keep=3)
+    ac.save(1, model=net)
+    # training mutates the params while the write is (possibly) in flight
+    net.weight.set_value(paddle.to_tensor(
+        np.zeros_like(w_at_save)))
+    ac.wait()
+    assert ckpt.verify_snapshot(str(tmp_path / "step_1")) == []
+    net2 = _net(1)
+    info = ckpt.load_checkpoint(str(tmp_path), model=net2)
+    assert info["step"] == 1
+    np.testing.assert_array_equal(net2.weight.numpy(), w_at_save)
+
+
+def test_async_one_in_flight_and_error_surfacing(tmp_path, monkeypatch):
+    net = _net(0)
+    policy = RetryPolicy(max_attempts=1, base_delay=0.001)
+    ac = ckpt.AsyncCheckpointer(str(tmp_path), retry=policy)
+
+    def always_fail(src, dst):
+        raise OSError("injected async write failure")
+
+    monkeypatch.setattr(ckpt._fs, "replace", always_fail)
+    ac.save(1, model=net)  # returns immediately; the WRITE will fail
+    with pytest.raises(OSError, match="injected async write failure"):
+        ac.save(2, model=net)  # the barrier surfaces the step-1 error
+    monkeypatch.undo()
+    ac.save(3, model=net)
+    ac.close()  # shutdown barrier: no pending error
+    assert ckpt.latest_checkpoint(str(tmp_path)) == 3
+
+
+def test_async_grad_scaler_state_rides_extra(tmp_path):
+    net = _net(0)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=512.0)
+    scaler._good_steps = 7
+    with ckpt.AsyncCheckpointer(str(tmp_path)) as ac:
+        ac.save(5, model=net, grad_scaler=scaler, extra={"note": "hi"})
+    scaler2 = paddle.amp.GradScaler()
+    info = ckpt.load_checkpoint(str(tmp_path), grad_scaler=scaler2)
+    assert info["step"] == 5 and info["note"] == "hi"
+    assert scaler2._scale == 512.0 and scaler2._good_steps == 7
+
+
+def test_optimizer_state_roundtrip_multilayer_no_crosswire(tmp_path):
+    """Layer-assigned param names repeat across layers ('linear.weight' twice
+    in a 2-Linear net); the optimizer checkpoint keys must disambiguate or
+    restore silently cross-wires moment tensors between parameters."""
+    def build(seed):
+        paddle.seed(seed)
+        net = paddle.nn.Sequential(paddle.nn.Linear(3, 5), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(5, 2))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        return net, opt
+
+    net, opt = build(0)
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    for _ in range(3):
+        (net(x) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+    ckpt.save_checkpoint(str(tmp_path), 3, model=net, optimizer=opt)
+
+    net2, opt2 = build(1)
+    ckpt.load_checkpoint(str(tmp_path), model=net2, optimizer=opt2)
+    for p, p2 in zip(net.parameters(), net2.parameters()):
+        s = opt._accumulators[id(p)]
+        s2 = opt2._accumulators[id(p2)]
+        for name in opt._state_names:
+            np.testing.assert_array_equal(np.asarray(s[name]),
+                                          np.asarray(s2[name]))
+    # and the restored state actually trains: one more identical step on each
+    (net(x) ** 2).mean().backward()
+    opt.step()
+    (net2(x) ** 2).mean().backward()
+    opt2.step()
+    for p, p2 in zip(net.parameters(), net2.parameters()):
+        np.testing.assert_array_equal(p.numpy(), p2.numpy())
+
+
+# ---------------------------------------------------------------- preemption
+
+
+def test_preemption_watcher_records_sigterm():
+    prev = signal.getsignal(signal.SIGTERM)
+    w = PreemptionWatcher().install()
+    try:
+        assert w.installed and not w.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5
+        while not w.requested() and time.time() < deadline:
+            time.sleep(0.01)
+        assert w.requested() and w.signum == signal.SIGTERM
+        w.clear()
+        assert not w.requested()
+    finally:
+        w.uninstall()
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_preemption_watcher_off_main_thread_degrades():
+    out = {}
+
+    def run():
+        out["w"] = PreemptionWatcher().install()
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert out["w"].installed is False and not out["w"].requested()
+
+
+# ------------------------------------------------------- hapi AutoCheckpoint
+
+
+def _fit_setup(seed, jit=False, scaler=None):
+    paddle.seed(seed)
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    mse = lambda out, y: ((out - y) ** 2).mean()  # noqa: E731
+    model.prepare(optimizer=opt, loss=mse, jit_compile=jit,
+                  grad_scaler=scaler)
+    return model
+
+
+def _fit_data(n_batches=8, bs=2):
+    rng = np.random.RandomState(42)
+    return [(rng.randn(bs, 4).astype("float32"),
+             rng.randn(bs, 2).astype("float32")) for _ in range(n_batches)]
+
+
+def test_fit_autocheckpoint_resume_matches_uninterrupted(tmp_path):
+    from paddle_tpu.hapi.callbacks import AutoCheckpoint
+    data = _fit_data(8)  # 8 batches/epoch
+
+    # reference: 2 uninterrupted epochs
+    ref = _fit_setup(0)
+    ref.fit(data, epochs=2, verbose=0, shuffle=False,
+            callbacks=[AutoCheckpoint(str(tmp_path / "ref"), save_steps=4,
+                                      asynchronous=False,
+                                      watch_signals=False)])
+    w_ref = ref.network.weight.numpy().copy()
+
+    # interrupted: epoch 1 only, snapshots at global steps 4 and 8
+    m1 = _fit_setup(0)
+    m1.fit(data, epochs=1, verbose=0, shuffle=False,
+           callbacks=[AutoCheckpoint(str(tmp_path / "b"), save_steps=4,
+                                     asynchronous=False,
+                                     watch_signals=False)])
+    assert ckpt.latest_checkpoint(str(tmp_path / "b")) == 8
+
+    # resume: a DIFFERENTLY-seeded model is overwritten by the restore, the
+    # first 8 batches replay without training, epoch 2 trains 9..16
+    m2 = _fit_setup(123)
+    m2.fit(data, epochs=2, verbose=0, shuffle=False,
+           callbacks=[AutoCheckpoint(str(tmp_path / "b"), save_steps=4,
+                                     asynchronous=False,
+                                     watch_signals=False)])
+    assert m2._resume_step == 8
+    np.testing.assert_array_equal(m2.network.weight.numpy(), w_ref)
+
+
+def test_auto_resume_skips_modelless_snapshot_without_quarantine(tmp_path):
+    """A healthy optimizer-only snapshot is incompatible with a model
+    restore — auto-resume must skip PAST it (to an older snapshot with a
+    model payload) without quarantining valid history."""
+    net = _net(0)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    ckpt.save_checkpoint(str(tmp_path), 1, model=net, optimizer=opt)
+    ckpt.save_checkpoint(str(tmp_path), 2, optimizer=opt)  # model-less
+    info = ckpt.load_checkpoint(str(tmp_path), model=_net(1))
+    assert info["step"] == 1
+    assert (tmp_path / "step_2").is_dir()  # intact, not .corrupt
+    # without a model requested, the newest snapshot is perfectly loadable
+    assert ckpt.load_checkpoint(str(tmp_path))["step"] == 2
+
+
+def test_resume_skipped_epochs_run_no_callbacks(tmp_path):
+    """Fully-replayed epochs after resume must not fire epoch-end callbacks
+    or eval — an EarlyStopping judging identical restored weights would stop
+    the resumed run before it trains a single new batch."""
+    from paddle_tpu.hapi.callbacks import AutoCheckpoint, Callback
+    data = _fit_data(4)
+
+    class Counts(Callback):
+        def __init__(self):
+            super().__init__()
+            self.epoch_ends = 0
+            self.evals = 0
+
+        def on_epoch_end(self, epoch, logs=None):
+            self.epoch_ends += 1
+
+        def on_eval_end(self, logs=None):
+            self.evals += 1
+
+    m1 = _fit_setup(0)
+    m1.fit(data, epochs=2, verbose=0, shuffle=False,
+           callbacks=[AutoCheckpoint(str(tmp_path), save_steps=4,
+                                     asynchronous=False,
+                                     watch_signals=False, verbose=0)])
+    c = Counts()
+    m2 = _fit_setup(1)
+    hist = m2.fit(data, eval_data=data, epochs=3, verbose=0, shuffle=False,
+                  callbacks=[c, AutoCheckpoint(str(tmp_path), save_steps=4,
+                                               asynchronous=False,
+                                               watch_signals=False,
+                                               verbose=0)])
+    # resumed at step 8 = 2 whole epochs replayed; only epoch 3 is real
+    assert m2._resume_step == 8
+    assert c.epoch_ends == 1 and c.evals == 1 and len(hist) == 1
+
+
+def test_fit_exception_releases_watcher_and_writer(tmp_path):
+    """fit() dying on its own exception must still uninstall the signal
+    handlers and drain the async writer (on_train_end never runs)."""
+    from paddle_tpu.hapi.callbacks import AutoCheckpoint
+
+    class Boom(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            raise RuntimeError("boom")
+
+    prev = signal.getsignal(signal.SIGTERM)
+    m = _fit_setup(0)
+    with pytest.raises(RuntimeError, match="boom"):
+        m.fit(_fit_data(4), epochs=1, verbose=0, shuffle=False,
+              callbacks=[AutoCheckpoint(str(tmp_path), save_steps=100,
+                                        verbose=0), Boom()])
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+class _KillAt(paddle.hapi.callbacks.Callback):
+    """Deliver SIGTERM to ourselves at the Nth step boundary — must run
+    BEFORE AutoCheckpoint in the callback list so the same boundary
+    performs the emergency save."""
+
+    def __init__(self, at):
+        super().__init__()
+        self.at = at
+        self.n = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.n += 1
+        if self.n == self.at:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def test_fit_sigterm_emergency_save_and_exact_resume(tmp_path, mon):
+    """Acceptance drill: SIGTERM during Model.fit produces an emergency
+    snapshot from which resume restores step count, model, optimizer and
+    GradScaler state exactly (jit path, scaler compiled in)."""
+    from paddle_tpu.hapi.callbacks import AutoCheckpoint
+    data = _fit_data(12)
+    d = str(tmp_path / "ckpt")
+    prev_handler = signal.getsignal(signal.SIGTERM)
+
+    def scaler():
+        return paddle.amp.GradScaler(init_loss_scaling=256.0,
+                                     incr_every_n_steps=4)
+
+    # run killed at step 6 of 12
+    s1 = scaler()
+    m1 = _fit_setup(0, jit=True, scaler=s1)
+    m1.fit(data, epochs=1, verbose=0, shuffle=False,
+           callbacks=[_KillAt(6),
+                      AutoCheckpoint(d, save_steps=100, asynchronous=False,
+                                     verbose=0)])
+    assert m1.stop_training
+    assert ckpt.latest_checkpoint(d) == 6
+    assert mon.registry.counter("ckpt/emergency_saves").value == 1
+    assert mon.registry.counter("preempt/signals").value == 1
+    # fit uninstalled the emergency handler on the way out
+    assert signal.getsignal(signal.SIGTERM) == prev_handler
+
+    # resume completes 7..12
+    s2 = scaler()
+    m2 = _fit_setup(123, jit=True, scaler=s2)
+    m2.fit(data, epochs=1, verbose=0, shuffle=False,
+           callbacks=[AutoCheckpoint(d, save_steps=100, asynchronous=False,
+                                     watch_signals=False, verbose=0)])
+    assert m2._resume_step == 6
+
+    # reference: 12 uninterrupted steps
+    s3 = scaler()
+    m3 = _fit_setup(0, jit=True, scaler=s3)
+    m3.fit(data, epochs=1, verbose=0, shuffle=False)
+
+    np.testing.assert_array_equal(m2.network.weight.numpy(),
+                                  m3.network.weight.numpy())
+    assert m2._optimizer._step_count == m3._optimizer._step_count
+    assert (s2._scale, s2._good_steps, s2._bad_steps) == \
+        (s3._scale, s3._good_steps, s3._bad_steps)
+
+
+# ----------------------------------------------- controller + elastic + tools
+
+
+def test_elastic_exit_never_raises_without_master():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager, \
+        ElasticStatus
+    # endpoint nobody serves: the tombstone put hits a dead master
+    em = ElasticManager("127.0.0.1:9", job_id="j", my_endpoint="n1:1",
+                        np_target=1)
+    em.exit(completed=True)  # dead endpoint: put returns False, no raise
+
+    class _GoneKV:
+        def put(self, key, value):
+            raise RuntimeError("master went away mid-request")
+
+    em2 = ElasticManager("127.0.0.1:9", job_id="j", my_endpoint="n1:1",
+                         np_target=1)
+    em2._kv = _GoneKV()
+    em2.exit(completed=False)  # raising put must not escape shutdown
+    assert em2.status == ElasticStatus.EXIT
+
+
+def test_controller_restart_backoff(tmp_path, capfd):
+    from paddle_tpu.distributed.launch.controller import (LaunchContext,
+                                                          PodController)
+    ctx = LaunchContext(script=["-c", "import sys; sys.exit(5)"],
+                        max_restart=2, restart_backoff=0.2, stop_grace=2.0)
+    t0 = time.monotonic()
+    rc = PodController(ctx).run()
+    elapsed = time.monotonic() - t0
+    assert rc == 5
+    err = capfd.readouterr().err
+    assert err.count("backing off") == 2
+    assert elapsed >= 0.2 + 0.4  # exp backoff floor (jitter only adds)
+
+
+def test_controller_forwards_sigterm_with_grace(tmp_path):
+    """Preemption relay: SIGTERM to the controller reaches the rank, which
+    gets its grace window to checkpoint and exit cleanly."""
+    from paddle_tpu.distributed.launch.controller import (LaunchContext,
+                                                          PodController)
+    out = tmp_path / "rank_saw_term"
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import signal, sys, time\n"
+        f"out = {str(out)!r}\n"
+        "def h(s, f):\n"
+        "    time.sleep(0.5)  # 'emergency checkpoint' inside the grace\n"
+        "    open(out, 'w').write(str(s))\n"
+        "    sys.exit(0)\n"
+        "signal.signal(signal.SIGTERM, h)\n"
+        f"open({str(out) + '.ready'!r}, 'w').write('r')\n"
+        "time.sleep(60)\n")
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    ctx = LaunchContext(script=[str(worker)], stop_grace=10.0)
+    ctl = PodController(ctx)
+
+    def kill_when_ready():
+        deadline = time.time() + 30
+        while not os.path.exists(str(out) + ".ready") \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=kill_when_ready, daemon=True)
+    t.start()
+    rc = ctl.run()
+    t.join()
+    assert rc == 0  # rank exited cleanly inside the grace window
+    assert out.read_text() == str(int(signal.SIGTERM))
+    assert signal.getsignal(signal.SIGTERM) == prev_handler  # restored
+
+
+def test_ckpt_inspect_cli(tmp_path):
+    _train_and_save(tmp_path, [1, 2])
+    # one torn + one checksum-corrupt snapshot
+    (tmp_path / "step_9").mkdir()
+    m = ckpt.read_manifest(str(tmp_path / "step_2"))
+    rel = sorted(m["files"])[0]
+    victim = tmp_path / "step_2" / rel
+    victim.write_bytes(b"\xff" + victim.read_bytes()[1:])
+
+    tool = os.path.join(REPO, "tools", "ckpt_inspect.py")
+    r = subprocess.run([sys.executable, tool, str(tmp_path), "--verify",
+                       "--json"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stderr
+    report = json.loads(r.stdout)
+    status = {row["name"]: row["status"] for row in report["snapshots"]}
+    assert status == {"step_1": "COMMITTED", "step_2": "BAD",
+                      "step_9": "TORN"}
+    assert not report["healthy"]
+
+    # healthy dir: exit 0 and human-readable listing names the resume target
+    healthy = tmp_path / "ok"
+    _train_and_save(healthy, [3])
+    r2 = subprocess.run([sys.executable, tool, str(healthy), "--verify"],
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "resume target: step_3" in r2.stdout

@@ -1,0 +1,81 @@
+"""Kill-and-resume e2e: a subprocess training run SIGKILLed mid-save resumes
+from the last COMMITTED snapshot and matches the uninterrupted run's
+trajectory from that step — the torn-write acceptance drill for the
+fault-tolerant checkpoint subsystem.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+
+from _subproc import retry_run
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "ckpt_train_worker.py")
+
+
+def _run_worker(workdir, fault=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_CKPT_FAULT", None)
+    if fault:
+        env["PADDLE_CKPT_FAULT"] = fault
+    os.makedirs(workdir, exist_ok=True)
+    return subprocess.run(
+        [sys.executable, WORKER, workdir, "--steps", "12",
+         "--save-every", "3"],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def _losses(workdir):
+    """step -> loss, LAST occurrence winning (a resumed run re-appends the
+    steps it replays after the crash point)."""
+    out = {}
+    with open(os.path.join(workdir, "losses.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            out[rec["step"]] = rec["loss"]
+    return out
+
+
+def test_kill9_mid_save_resumes_from_committed(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    # reference: uninterrupted 12 steps (load-tolerant retry: cold jax
+    # imports under a full suite can starve any fixed timeout once)
+    ref_dir = str(tmp_path / "ref")
+    r = retry_run(lambda: _run_worker(ref_dir))
+    assert r.returncode == 0, r.stdout + r.stderr
+    ref_losses = _losses(ref_dir)
+    assert sorted(ref_losses) == list(range(1, 13))
+    ref_final = np.load(os.path.join(ref_dir, "final.npy"))
+
+    # killed run: SIGKILL lands mid-save at step 9, AFTER the payload rename
+    # but BEFORE the COMMIT manifest — the nastiest torn-write window
+    kill_dir = str(tmp_path / "kill")
+    rk = _run_worker(kill_dir, fault="die_before_commit:9")
+    assert rk.returncode == -signal.SIGKILL, rk.stdout + rk.stderr
+    ck = os.path.join(kill_dir, "ckpt")
+    torn = os.path.join(ck, "step_9")
+    assert os.path.isdir(torn)
+    assert not os.path.exists(os.path.join(torn, ckpt.MANIFEST_NAME))
+    # the torn snapshot is INVISIBLE: last committed is step 6
+    assert ckpt.latest_checkpoint(ck) == 6
+
+    # resume: auto-falls back to step 6 (quarantining the torn step 9) and
+    # completes 7..12
+    rr = retry_run(lambda: _run_worker(kill_dir))
+    assert rr.returncode == 0, rr.stdout + rr.stderr
+    assert "resumed from 6" in rr.stdout
+    assert any(d.startswith("step_9.corrupt") for d in os.listdir(ck))
+
+    # trajectory from the resume point matches the uninterrupted run exactly
+    res_losses = _losses(kill_dir)
+    for step in range(7, 13):
+        assert res_losses[step] == ref_losses[step], \
+            f"step {step}: {res_losses[step]} != {ref_losses[step]}"
+    np.testing.assert_array_equal(
+        np.load(os.path.join(kill_dir, "final.npy")), ref_final)

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Inspect a paddle_tpu checkpoint directory: list snapshots, verify manifests.
+
+Usage:
+    python tools/ckpt_inspect.py <ckpt_dir> [--verify] [--json]
+
+Lists every ``step_<N>`` snapshot with its commit status:
+
+    COMMITTED  — has a valid COMMIT manifest (a resume candidate)
+    TORN       — dir exists but no/invalid manifest (interrupted save;
+                 auto-resume skips and quarantines these)
+    IN-FLIGHT  — a ``step_<N>.tmp`` dir (save in progress, or died mid-write)
+    CORRUPT    — a quarantined ``step_<N>.corrupt*`` dir
+    SET-ASIDE  — a ``step_<N>.old`` dir parked by an interrupted re-save
+                 (the library's resume scan restores a committed one)
+    BAD        — (--verify) manifest present but checksum/size re-hash failed
+
+``--verify`` re-hashes every manifest-listed file (SHA-256) — the same check
+auto-resume performs. Exit code: 0 when every ``step_*`` entry is a healthy
+committed snapshot, 1 otherwise (monitoring-friendly).
+
+Deliberately standalone (stdlib only — no jax/paddle import): the manifest
+format is the schema-versioned contract of
+``paddle_tpu/distributed/checkpoint.py``, and an ops box inspecting a shared
+filesystem should not need the training image to do it.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+MANIFEST_NAME = "COMMIT"
+SCHEMA_VERSION = 1
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_RE = re.compile(r"^step_(\d+)\.tmp$")
+_CORRUPT_RE = re.compile(r"^step_(\d+)\.corrupt(\.\d+)?$")
+_OLD_RE = re.compile(r"^step_(\d+)\.old$")
+_HASH_CHUNK = 1 << 20
+
+
+def read_manifest(base: str):
+    try:
+        with open(os.path.join(base, MANIFEST_NAME)) as f:
+            m = json.load(f)
+        if not isinstance(m, dict) or not isinstance(m.get("files"), dict):
+            return None
+        if int(m.get("schema", -1)) > SCHEMA_VERSION:
+            return None
+        mm = _STEP_RE.match(os.path.basename(os.path.normpath(base)))
+        if mm and m.get("step") is not None \
+                and int(m["step"]) != int(mm.group(1)):
+            return None
+    except (OSError, ValueError, TypeError):
+        return None  # rotted manifests are TORN, not a tool crash
+    return m
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(_HASH_CHUNK), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify(base: str, manifest: dict):
+    problems = []
+    for rel, meta in sorted(manifest["files"].items()):
+        p = os.path.join(base, rel.replace("/", os.sep))
+        if not os.path.isfile(p):
+            problems.append(f"missing file {rel}")
+            continue
+        size = os.path.getsize(p)
+        if size != meta.get("bytes"):
+            problems.append(f"{rel}: {size} bytes, manifest says "
+                            f"{meta.get('bytes')} (truncated?)")
+            continue
+        # emergency manifests record sizes only (sha256 null)
+        if meta.get("sha256") and _sha256(p) != meta["sha256"]:
+            problems.append(f"{rel}: checksum mismatch")
+    return problems
+
+
+def scan(directory: str, do_verify: bool):
+    rows = []
+    for name in sorted(os.listdir(directory)):
+        path = os.path.join(directory, name)
+        if not os.path.isdir(path):
+            continue
+        m_step = _STEP_RE.match(name)
+        if m_step:
+            manifest = read_manifest(path)
+            if manifest is None:
+                rows.append({"name": name, "step": int(m_step.group(1)),
+                             "status": "TORN", "problems":
+                             [f"no valid {MANIFEST_NAME} manifest"]})
+                continue
+            row = {"name": name, "step": int(m_step.group(1)),
+                   "status": "COMMITTED",
+                   "bytes": sum(f.get("bytes", 0)
+                                for f in manifest["files"].values()),
+                   "files": len(manifest["files"]),
+                   "world_size": manifest.get("world_size"),
+                   "wall": manifest.get("wall"), "problems": []}
+            if do_verify:
+                problems = verify(path, manifest)
+                if problems:
+                    row["status"] = "BAD"
+                    row["problems"] = problems
+            rows.append(row)
+        elif _TMP_RE.match(name):
+            rows.append({"name": name,
+                         "step": int(_TMP_RE.match(name).group(1)),
+                         "status": "IN-FLIGHT", "problems": []})
+        elif _CORRUPT_RE.match(name):
+            rows.append({"name": name,
+                         "step": int(_CORRUPT_RE.match(name).group(1)),
+                         "status": "CORRUPT", "problems": []})
+        elif _OLD_RE.match(name):
+            # a re-save parked this committed copy and crashed before its
+            # replacement committed; the library's resume scan restores it
+            rows.append({"name": name,
+                         "step": int(_OLD_RE.match(name).group(1)),
+                         "status": "SET-ASIDE", "problems": []})
+    return rows
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="List and verify paddle_tpu checkpoint snapshots")
+    ap.add_argument("directory", help="checkpoint directory (holds step_<N>/)")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-hash every manifest-listed file (SHA-256)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        print(f"error: {args.directory} is not a directory", file=sys.stderr)
+        return 2
+    rows = scan(args.directory, args.verify)
+    healthy = all(r["status"] == "COMMITTED" for r in rows)
+
+    if args.as_json:
+        print(json.dumps({"directory": args.directory, "snapshots": rows,
+                          "healthy": healthy}, indent=1))
+        return 0 if healthy else 1
+
+    if not rows:
+        print(f"{args.directory}: no snapshots")
+        return 0
+    latest = max((r["step"] for r in rows if r["status"] == "COMMITTED"),
+                 default=None)
+    print(f"{args.directory}: {len(rows)} entries"
+          + (f", resume target: step_{latest}" if latest is not None
+             else ", NO committed snapshot"))
+    for r in rows:
+        age = ""
+        if r.get("wall"):
+            age = f"  {time.time() - r['wall']:7.0f}s ago"
+        size = f"  {_fmt_bytes(r.get('bytes')):>9}" \
+            if r.get("bytes") is not None else ""
+        files = f"  {r['files']:3d} files" if r.get("files") else ""
+        print(f"  {r['name']:<24} {r['status']:<10}{size}{files}{age}")
+        for p in r["problems"]:
+            print(f"      ! {p}")
+    return 0 if healthy else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
