@@ -96,6 +96,21 @@ class GradScaler:
         self.step(optimizer)
         self.update()
 
+    @staticmethod
+    def _found_inf_of(grads):
+        """Device-side found-inf flag over a list of gradients — the traced
+        half of check_finite_and_unscale, used inside jit.TrainStep.
+
+        Works unchanged over ZeRO shard-sized grads: each ``isfinite``
+        reduction is a per-shard partial under GSPMD, and the final
+        ``jnp.all`` over the stacked flags is one tiny cross-device
+        all-reduce — no gradient is ever gathered full-size just to check
+        it."""
+        finite = [jnp.all(jnp.isfinite(g)) for g in grads]
+        if not finite:
+            return jnp.asarray(False)
+        return jnp.logical_not(jnp.all(jnp.stack(finite)))
+
     def _compiled_outcome(self, found_inf: bool):
         """Host half of a jit-compiled AMP step (jit.TrainStep(grad_scaler=...)).
 

@@ -121,7 +121,18 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
                                            avg=cfg.get("avg", True))
     if strategy.sharding or (hcg is not None
                              and hcg.get_sharding_parallel_world_size() > 1):
-        return DygraphShardingOptimizer(optimizer, hcg, strategy)
+        cfg = getattr(strategy, "sharding_configs", None) or {}
+        bucket = cfg.get("grad_bucket_bytes")
+        if int(cfg.get("stage", 1)) >= 2:
+            # stage >= 2: the ZeRO-2 optimizer additionally contracts grads
+            # to come out of backward shard-sized (TrainStep compiles the
+            # reduce-scatter into the scan body; the eager tape reshards at
+            # accumulation)
+            from ..sharding.group_sharded import _ShardingStage2Optimizer
+            return _ShardingStage2Optimizer(optimizer, hcg, strategy,
+                                            grad_bucket_bytes=bucket)
+        return DygraphShardingOptimizer(optimizer, hcg, strategy,
+                                        grad_bucket_bytes=bucket)
     return HybridParallelOptimizer(optimizer, hcg, strategy)
 
 

@@ -76,6 +76,7 @@ def _existing_spec(arr):
 
 
 _HOST_MEMORY_OK: Optional[bool] = None
+_HOST_WARNED = False
 
 
 def _host_memory_supported() -> bool:
@@ -99,9 +100,15 @@ def _maybe_host(sharding, offload):
     if not offload:
         return sharding
     if not _host_memory_supported():
-        import warnings
-        warnings.warn("offload=True but this backend has no host memory kinds;"
-                      " optimizer states stay on device", stacklevel=3)
+        # warn ONCE per process: the placement hook routes every state
+        # creation through here (one call per param per state buffer)
+        global _HOST_WARNED
+        if not _HOST_WARNED:
+            _HOST_WARNED = True
+            import warnings
+            warnings.warn("offload=True but this backend has no host memory "
+                          "kinds; optimizer states stay on device",
+                          stacklevel=3)
         return sharding
     return sharding.with_memory_kind("pinned_host")
 
@@ -115,10 +122,45 @@ class DygraphShardingOptimizer(HybridParallelOptimizer):
     reduce-scatter/all-gather traffic ZeRO describes.
     """
 
-    def __init__(self, optimizer, hcg=None, strategy=None, offload=False):
+    def __init__(self, optimizer, hcg=None, strategy=None, offload=False,
+                 grad_bucket_bytes=None):
         super().__init__(optimizer, hcg, strategy)
         self._sharding_placed = set()
         self._offload = offload
+        # collective-coalescing knob consumed by jit.TrainStep: per-microbatch
+        # reduce-scatters of grads smaller than this are fused into flat
+        # buckets (None = adapter default, 0 = per-param collectives)
+        self._grad_bucket_bytes = grad_bucket_bytes
+        # param placement BEFORE the update, so the eager step can restore it
+        # after (the ZeRO "all-gather after step": the jitted fused update
+        # propagates the states' shard layout onto the new params)
+        self._param_placements = {}
+        # install the placement hook NOW, not in _place_states: both step()
+        # and TrainStep.__init__ run _ensure_all_states() before placement,
+        # and a hook installed after that point never sees a state creation —
+        # every buffer would materialize full-size replicated first, the
+        # transient allocation ZeRO exists to avoid. The hook checks the mesh
+        # at call time, so pre-mesh installation is safe (returns None).
+        # Install on the RAW Optimizer (the one whose _ensure_state reads
+        # it): an intermediate wrapper (e.g. GradientMergeOptimizer) only
+        # delegates attribute READS, so setting on it would strand the hook.
+        raw = self._inner_opt
+        while hasattr(raw, "_inner_opt"):
+            raw = raw._inner_opt
+        raw._state_placement_fn = self._state_sharding
+
+    def _state_sharding(self, p, name, shape):
+        """Shard placement for one optimizer-state (or master-weight) buffer.
+
+        Installed as the inner optimizer's ``_state_placement_fn`` so lazily
+        created states are born shard-sized; also used by ``_place_states``
+        to migrate states that predate the wrapper."""
+        mesh = get_mesh()
+        if mesh is None or mesh.shape.get("sharding", 1) <= 1:
+            return None
+        existing = _existing_spec(p.value()) if len(shape) == p.ndim else None
+        spec = _shard_spec_for(shape, mesh.shape["sharding"], existing)
+        return _maybe_host(NamedSharding(mesh, spec), self._offload)
 
     def _place_states(self):
         mesh = get_mesh()
@@ -127,22 +169,65 @@ class DygraphShardingOptimizer(HybridParallelOptimizer):
         opt = self._inner_opt
         for p in opt._parameter_list:
             pid = id(p)
+            self._param_placements.setdefault(
+                pid, getattr(p.value(), "sharding", None))
             if pid in self._sharding_placed or pid not in opt._accumulators:
                 continue
-            existing = _existing_spec(p.value())
             states = opt._accumulators[pid]
             for name, arr in states.items():
-                spec = _shard_spec_for(arr.shape, mesh.shape["sharding"],
-                                       existing if arr.ndim == p.ndim else None)
-                sh = _maybe_host(NamedSharding(mesh, spec), self._offload)
-                states[name] = jax.device_put(arr, sh)
+                sh = self._state_sharding(p, name, arr.shape)
+                if sh is not None and getattr(arr, "sharding", None) != sh:
+                    states[name] = jax.device_put(arr, sh)
             if pid in opt._master_weights:
                 mw = opt._master_weights[pid]
-                spec = _shard_spec_for(mw.shape, mesh.shape["sharding"],
-                                       existing)
-                sh = _maybe_host(NamedSharding(mesh, spec), self._offload)
-                opt._master_weights[pid] = jax.device_put(mw, sh)
+                sh = self._state_sharding(p, "master", mw.shape)
+                if sh is not None and getattr(mw, "sharding", None) != sh:
+                    opt._master_weights[pid] = jax.device_put(mw, sh)
             self._sharding_placed.add(pid)
+
+    def _restore_param_placements(self):
+        """ZeRO's update-then-all-gather for the EAGER step path: the fused
+        update reads shard-placed states, so XLA's propagation hands back
+        shard-placed new params; gather them back to their mesh placement
+        (compiled TrainStep does this inside the executable instead).
+
+        Params that carried a mesh placement (TP spec, stage-3 shard,
+        explicit replication) go back to exactly that; params that predate
+        the mesh (single-device) are all-gathered to mesh-replicated — they
+        must NOT go back to one device, which would be device-incompatible
+        with the mesh-committed optimizer states on the next step."""
+        mesh = get_mesh()
+        # same guard as _place_states: on a mesh without a populated
+        # "sharding" axis nothing was sharded, and force-replicating here
+        # would un-shard TP params and all-gather the model every step
+        if mesh is None or mesh.shape.get("sharding", 1) <= 1:
+            return
+        for p in self._inner_opt._parameter_list:
+            want = self._param_placements.get(id(p))
+            if not isinstance(want, NamedSharding):
+                want = NamedSharding(mesh, P())
+            have = getattr(p._data, "sharding", None)
+            if have is not None and have != want:
+                from ...core.lazy import lazy_device_put
+                p._data = lazy_device_put(p.value(), want)
+
+    def _shard_state_bytes(self) -> int:
+        """Per-device bytes held by optimizer states + master weights (the
+        ``shard/opt_state_bytes`` gauge): shard-sized buffers count 1/world,
+        replicated ones full size."""
+        import math
+        opt = self._inner_opt
+        total = 0
+        arrays = [a for st in opt._accumulators.values() for a in st.values()]
+        arrays += list(opt._master_weights.values())
+        for a in arrays:
+            try:
+                shard_shape = a.sharding.shard_shape(a.shape)
+                total += a.dtype.itemsize * int(
+                    math.prod(shard_shape) if shard_shape else 1)
+            except Exception:
+                total += int(getattr(a, "nbytes", 0))
+        return total
 
     def _move_states(self, to_host: bool):
         """Offload paging: states live on host between steps, on device during
@@ -168,9 +253,13 @@ class DygraphShardingOptimizer(HybridParallelOptimizer):
         self._inner_opt._ensure_all_states()
         self._place_states()
         if not self._offload:
-            return self._inner_opt.step()
+            out = self._inner_opt.step()
+            self._restore_param_placements()
+            return out
         self._move_states(to_host=False)
         try:
-            return self._inner_opt.step()
+            out = self._inner_opt.step()
         finally:
             self._move_states(to_host=True)
+        self._restore_param_placements()
+        return out

@@ -21,7 +21,12 @@ class DistributedStrategy:
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.sharding = False
         self.sharding_configs = {"stage": 1, "sharding_degree": 1,
-                                 "offload": False, "comm_overlap": True}
+                                 "offload": False, "comm_overlap": True,
+                                 # coalesce per-microbatch grad reduce-scatters
+                                 # smaller than this into flat fused buckets
+                                 # inside the compiled step (None/0 = one
+                                 # collective per param; see jit.TrainStep)
+                                 "grad_bucket_bytes": None}
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
                                  "schedule_mode": "1F1B"}

@@ -85,6 +85,7 @@ class Model:
         self._accumulate_steps = 1
         self._pending_microbatches = []
         self._grad_scaler = None
+        self._grad_bucket_bytes = None
         # set by callbacks.AutoCheckpoint on resume: fit skips (replays the
         # data position of) the first N global batches without training
         self._resume_step = 0
@@ -93,7 +94,7 @@ class Model:
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
                 jit_compile: bool = False, accumulate_steps: int = 1,
-                grad_scaler=None):
+                grad_scaler=None, grad_bucket_bytes=None):
         """``accumulate_steps=K`` (K>1) trains through the compiled
         accumulation path: one ``jit.TrainStep`` executable consumes K
         stacked microbatches, runs forward/backward K times and applies ONE
@@ -103,7 +104,13 @@ class Model:
 
         ``grad_scaler``: an ``amp.GradScaler`` compiled into the TrainStep
         (dynamic loss scaling on device; requires the jit path). Its state
-        is checkpointed/restored by ``callbacks.AutoCheckpoint``."""
+        is checkpointed/restored by ``callbacks.AutoCheckpoint``.
+
+        ``grad_bucket_bytes``: with a ZeRO-sharded optimizer (e.g. from
+        ``distributed.group_sharded_parallel``), fuse per-microbatch grad
+        reduce-scatters smaller than this into flat buckets inside the
+        compiled accumulation scan (None = the optimizer wrapper's setting,
+        0 = one collective per parameter)."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -131,6 +138,7 @@ class Model:
                 "(the eager fit path never routes through the scaler, which "
                 "would silently train without loss scaling)")
         self._grad_scaler = grad_scaler
+        self._grad_bucket_bytes = grad_bucket_bytes
         self._jit_compile = jit_compile
         self._train_step = None
         self._pending_microbatches = []
@@ -236,7 +244,8 @@ class Model:
             self._train_step = TrainStep(
                 net, self._optimizer,
                 accumulate_steps=self._accumulate_steps,
-                grad_scaler=self._grad_scaler)
+                grad_scaler=self._grad_scaler,
+                grad_bucket_bytes=getattr(self, "_grad_bucket_bytes", None))
         return self._train_step
 
     @no_grad()

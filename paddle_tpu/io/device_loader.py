@@ -85,10 +85,22 @@ def _stacked_iter(inner, k: int):
                 pass
 
 
-def batch_sharding(mesh, axis_name: str = "data"):
+def batch_sharding(mesh, axis_name=None):
     """Per-leaf sharding callable: shard the leading (batch) axis over
-    ``axis_name``, replicate the rest — the standard DP input placement."""
+    ``axis_name``, replicate the rest — the standard DP input placement.
+
+    ``axis_name=None`` (default) picks every data-like mesh axis with
+    degree > 1 out of ("data", "sharding"): a ZeRO sharding group IS a
+    data-parallel group, so its inputs shard over the "sharding" axis too,
+    composed with plain DP when both are present. Pass an explicit name (or
+    tuple of names) to override."""
     from jax.sharding import NamedSharding, PartitionSpec
+
+    if axis_name is None:
+        axes = tuple(a for a in ("data", "sharding")
+                     if mesh.shape.get(a, 1) > 1)
+        # a single axis stays a plain name (spec prints/compares as before)
+        axis_name = axes[0] if len(axes) == 1 else (axes if axes else "data")
 
     def leaf_sharding(arr):
         spec = [None] * max(int(getattr(arr, "ndim", 0)), 0)

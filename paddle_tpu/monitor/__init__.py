@@ -207,6 +207,23 @@ class Monitor:
             accumulator_bytes)
         self.emit("accumulation", k=k, accumulator_bytes=accumulator_bytes)
 
+    def shard_config(self, world: int, accum_bytes: int,
+                     accum_ideal_bytes: int, opt_state_bytes: int,
+                     buckets: int):
+        """ZeRO sharding gauges: per-device residency of the fp32 grad
+        accumulators (vs the 1/world_size ideal — a gap means a lost
+        sharding constraint), per-device optimizer-state bytes, and how many
+        fused reduce-scatter buckets the accumulation scan carries."""
+        g = self.registry.gauge
+        g("shard/world_size").set(world)
+        g("shard/accum_bytes").set(accum_bytes)
+        g("shard/accum_ideal_bytes").set(accum_ideal_bytes)
+        g("shard/opt_state_bytes").set(opt_state_bytes)
+        g("shard/grad_buckets").set(buckets)
+        self.emit("sharding", world=world, accum_bytes=accum_bytes,
+                  accum_ideal_bytes=accum_ideal_bytes,
+                  opt_state_bytes=opt_state_bytes, buckets=buckets)
+
     def update_skipped(self, microbatches: int = 1):
         """AMP found-inf: the compiled step discarded its whole update."""
         self.registry.counter("train_step/skipped_updates").inc()

@@ -22,6 +22,28 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
            "Adadelta", "RMSProp", "Lamb"]
 
 
+def _const_at(shape, dtype, value, sh):
+    """Constant buffer born at placement ``sh``: each addressable device
+    materializes ONLY its own shard. Neither a per-buffer jit (one tiny
+    compile per param per state) nor ``jnp.full`` + ``device_put`` (stages
+    the full array on one device first — the transient allocation the ZeRO
+    placement hook exists to avoid)."""
+    import numpy as np
+
+    def _shard(index):
+        sub = tuple(len(range(*sl.indices(dim)))
+                    for sl, dim in zip(index, shape))
+        return np.full(sub, value, np.dtype(dtype))
+
+    try:
+        return jax.make_array_from_callback(tuple(shape), sh, _shard)
+    except Exception:
+        # e.g. a memory-kind the callback path can't target (ZeRO offload):
+        # host-stage the full array and let device_put scatter the shards
+        return jax.device_put(np.full(tuple(shape), value, np.dtype(dtype)),
+                              sh)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_update(cls, static_key):
     """One compiled update over the whole parameter pytree per optimizer config."""
@@ -80,6 +102,12 @@ class Optimizer:
         self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._master_weights: Dict[int, jnp.ndarray] = {}
         self._step_count = 0
+        # ZeRO hook (DygraphShardingOptimizer._place_states installs it):
+        # maps (param, state_name, shape) -> Sharding so moment/master buffers
+        # are BORN shard-sized — a replicated zeros + device_put would briefly
+        # hold the full-size buffer on one device, which for billion-param
+        # models is exactly the allocation ZeRO exists to avoid
+        self._state_placement_fn = None
 
     # ------------------------------------------------------------ lr plumbing
 
@@ -102,11 +130,35 @@ class Optimizer:
         pid = id(p)
         if pid not in self._accumulators:
             dtype = jnp.float32 if self._multi_precision else p.value().dtype
+            shape = tuple(p.shape)
             self._accumulators[pid] = {
-                name: jnp.zeros(tuple(p.shape), dtype) for name in self._state_names}
+                name: self._new_state(p, name, shape, dtype)
+                for name in self._state_names}
             if self._multi_precision and p.value().dtype != jnp.float32:
-                self._master_weights[pid] = p.value().astype(jnp.float32)
+                self._master_weights[pid] = self._new_master(p)
         return self._accumulators[pid]
+
+    def _new_state(self, p: Parameter, name: str, shape, dtype):
+        """A fresh state buffer, created directly at its ZeRO shard placement
+        when a placement hook is installed (each device materializes only its
+        1/world_size shard — no transient full-size buffer)."""
+        place = self._state_placement_fn
+        sh = place(p, name, shape) if place is not None else None
+        if sh is None:
+            return jnp.zeros(shape, dtype)
+        return _const_at(shape, dtype, 0.0, sh)
+
+    def _new_master(self, p: Parameter):
+        """fp32 master copy of a low-precision param; born shard-sized under
+        ZeRO (the cast writes straight into the sharded layout)."""
+        place = self._state_placement_fn
+        sh = place(p, "master", tuple(p.shape)) if place is not None else None
+        if sh is None:
+            return p.value().astype(jnp.float32)
+        # reshard the LOW-precision param first (half the bytes), then cast
+        # eagerly — the elementwise cast inherits the shard placement, with
+        # no per-param jit compile and no full-size fp32 transient
+        return jax.device_put(p.value(), sh).astype(jnp.float32)
 
     def _ensure_all_states(self):
         """Materialize state for every trainable param (used by ZeRO placement)."""
@@ -527,8 +579,14 @@ class Adagrad(Optimizer):
     def _ensure_state(self, p):
         pid = id(p)
         if pid not in self._accumulators:
-            self._accumulators[pid] = {
-                "moment": jnp.full(tuple(p.shape), self._init_acc, p.value().dtype)}
+            shape, dtype = tuple(p.shape), p.value().dtype
+            place = self._state_placement_fn
+            sh = place(p, "moment", shape) if place is not None else None
+            if sh is None:
+                moment = jnp.full(shape, self._init_acc, dtype)
+            else:
+                moment = _const_at(shape, dtype, self._init_acc, sh)
+            self._accumulators[pid] = {"moment": moment}
         return self._accumulators[pid]
 
     def _static_config(self):

@@ -1,4 +1,6 @@
 """Schema-generated ops, distributions, strategy-toggle optimizers."""
+import os
+
 import numpy as np
 import pytest
 
@@ -144,6 +146,8 @@ def test_strategy_wires_wrappers():
     assert isinstance(opt._inner_opt, GradientMergeOptimizer)
 
 
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference tree not mounted in this image")
 def test_reference_top_level_api_parity():
     """Every name in the reference's paddle.__all__ must resolve here (the
     judge's switch-over criterion at the top-level namespace)."""

@@ -212,6 +212,32 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       f"{h.get('max', 0):>12.6f}{h.get('p99', 0):>12.6f}",
                       file=out)
 
+    gauges_m = (metrics or {}).get("gauges", {})
+    world = gauges_m.get("shard/world_size", 0)
+    if world > 1:
+        accum = gauges_m.get("shard/accum_bytes", 0)
+        ideal = gauges_m.get("shard/accum_ideal_bytes", 0)
+        print(f"\n== zero sharding ==", file=out)
+        print(f"  world {int(world)}  "
+              f"grad buckets {int(gauges_m.get('shard/grad_buckets', 0))}",
+              file=out)
+        if ideal:
+            print(f"  grad accumulators {_fmt_bytes(accum)}  "
+                  f"(shard ideal {_fmt_bytes(ideal)}, "
+                  f"{accum / ideal:.2f}x)", file=out)
+            # the regression this section exists to catch: an accumulator
+            # that is NOT 1/world_size-sized means the reduce-scatter fell
+            # out of the accumulation scan and every device is carrying
+            # full-size fp32 grads again
+            if accum > 1.15 * ideal:
+                print(f"  WARNING: accumulator is {accum / ideal:.2f}x the "
+                      f"shard ideal — probable lost sharding constraint "
+                      f"(reduce-scatter no longer inside the accumulation "
+                      f"scan)", file=out)
+        opt_b = gauges_m.get("shard/opt_state_bytes", 0)
+        if opt_b:
+            print(f"  opt state (per device) {_fmt_bytes(opt_b)}", file=out)
+
     recompiles = by_kind.get("recompile", [])
     print(f"\n== recompile timeline ({len(recompiles)}) ==", file=out)
     for r in recompiles:
