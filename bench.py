@@ -1,4 +1,7 @@
-"""Driver benchmark: GPT causal-LM training throughput on one chip.
+"""Driver benchmark: GPT causal-LM throughput on one chip.
+
+Two workloads: training (default) and serving decode (``bench.py decode`` —
+DecodeEngine continuous batching, tokens/s/chip).
 
 Prints a JSON line {"metric", "value", "unit", "vs_baseline", ...} after EVERY
 measurement window (best-so-far value, flushed immediately) — a run killed by
@@ -22,6 +25,10 @@ import numpy as np
 
 # first self-measured value (round 1) on one v4 chip; later rounds compare to this
 REF_TOKENS_PER_SEC = 33064.0
+
+# decode baseline: None until the first `bench.py decode` round lands a
+# value on real hardware — that first line defines the reference
+REF_DECODE_TOKENS_PER_SEC = None
 
 
 def main():
@@ -109,5 +116,74 @@ def main():
         report(best, w)
 
 
+def main_decode():
+    """Serving decode throughput: a DecodeEngine (paged KV cache +
+    continuous batching, see paddle_tpu/serving/) over the same GPT-medium
+    config, every slot kept hot with staggered requests so admissions and
+    evictions run continuously — the steady state being measured. Same
+    output contract as training: best-so-far JSON line after every window,
+    flushed (rc=124-safe). ``steady_state_recompiles`` must stay 0; a
+    nonzero value means the zero-recompile contract broke and the tokens/s
+    number is compile-bound garbage."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_bench")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import DecodeEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                    num_heads=8, max_position_embeddings=1024,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    for _, p in model.named_parameters():
+        p._data = p.value().astype("bfloat16")
+
+    engine = DecodeEngine(model, max_slots=16, max_len=256,
+                          prefill_buckets=[64])
+    rng = np.random.RandomState(0)
+
+    def refill():
+        # staggered prompt lengths and decode budgets: requests finish at
+        # different steps, freeing slots the next refill re-admits into
+        while engine.queue_depth + engine.live_count < engine.max_slots:
+            n = int(rng.randint(16, 65))
+            engine.submit(rng.randint(0, cfg.vocab_size, n),
+                          max_new_tokens=int(rng.randint(64, 129)))
+
+    # warmup: fills all slots and mints both executables (one prefill
+    # bucket + the decode step)
+    refill()
+    engine.step()
+    warm_compiles = engine.compile_count
+    kind = jax.devices()[0].device_kind
+
+    iters, windows = 20, 6
+    best = 0.0
+    for w in range(windows):
+        tok0 = engine.tokens_generated
+        t0 = time.time()
+        for _ in range(iters):
+            refill()
+            engine.step()   # host readback of the step's tokens syncs
+        dt = time.time() - t0
+        best = max(best, (engine.tokens_generated - tok0) / dt)
+        print(json.dumps({
+            "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
+            "value": round(best, 1),
+            "unit": "tokens/s (decode)",
+            "vs_baseline": (round(best / REF_DECODE_TOKENS_PER_SEC, 3)
+                            if REF_DECODE_TOKENS_PER_SEC else None),
+            "live_slots": engine.live_count,
+            "compiles": engine.compile_count,
+            "steady_state_recompiles": engine.compile_count - warm_compiles,
+            "device_kind": kind,
+            "window": w,
+        }))
+        sys.stdout.flush()
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_decode() if "decode" in sys.argv[1:] else main())

@@ -237,21 +237,32 @@ class GPTAttention(nn.Layer):
         every cached position <= their own (reference: the cache tensors
         fused_multi_transformer threads through generation). Inference-only
         math on raw arrays — no tape, runs inside the jitted generate loop
-        with static shapes throughout."""
-        k_buf, v_buf, pos = kv_cache          # jnp arrays + scalar int32
+        with static shapes throughout.
+
+        `pos` is a scalar (one shared cursor: generate()'s lockstep batch)
+        or a [B] vector (per-row cursors: the serving engine's slots, each
+        batch row a request at its own depth)."""
+        k_buf, v_buf, pos = kv_cache          # jnp arrays + int32 scalar/[B]
         b, s, h = x.shape
         nh, hd = self.num_heads, self.head_dim
         qkv = self.qkv_proj(x).reshape([b, s, 3, nh, hd]).value()
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_buf = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype),
-                                             (0, pos, 0, 0))
-        v_buf = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype),
-                                             (0, pos, 0, 0))
+        if jnp.ndim(pos) == 1:
+            upd = lambda buf, kv, p: jax.lax.dynamic_update_slice(
+                buf, kv, (p, 0, 0))
+            k_buf = jax.vmap(upd)(k_buf, k.astype(k_buf.dtype), pos)
+            v_buf = jax.vmap(upd)(v_buf, v.astype(v_buf.dtype), pos)
+            q_pos = (pos[:, None] + jnp.arange(s))[:, None, :, None]
+        else:
+            k_buf = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype),
+                                                 (0, pos, 0, 0))
+            v_buf = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype),
+                                                 (0, pos, 0, 0))
+            q_pos = (pos + jnp.arange(s))[None, None, :, None]
         m = k_buf.shape[1]
         scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
                             k_buf.astype(jnp.float32)) / math.sqrt(hd)
         key_pos = jnp.arange(m)[None, None, None, :]
-        q_pos = (pos + jnp.arange(s))[None, None, :, None]
         scores = jnp.where(key_pos <= q_pos, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bnqk,bknd->bqnd", probs,
@@ -390,7 +401,12 @@ class GPTModel(nn.Layer):
                 raise NotImplementedError(
                     "KV-cache generation requires scan_layers=False")
             p0 = start_pos if start_pos is not None else jnp.int32(0)
-            pos_ids = Tensor((p0 + jnp.arange(s, dtype=jnp.int32))[None, :])
+            if jnp.ndim(p0) == 1:
+                # per-slot cursors: each batch row reads its own positions
+                pos_ids = Tensor(p0[:, None]
+                                 + jnp.arange(s, dtype=jnp.int32)[None, :])
+            else:
+                pos_ids = Tensor((p0 + jnp.arange(s, dtype=jnp.int32))[None, :])
             x = self.wte(input_ids) + self.wpe(pos_ids)
             new_caches = []
             for block, cache in zip(self.h, kv_caches):
@@ -442,19 +458,27 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, do_sample: bool = False,
-                 top_k: int = 0, eos_token_id=None, seed: int = 0,
-                 max_length=None):
+                 top_k: int = 0, eos_token_id=None, seed=None,
+                 max_length=None, use_engine: bool = False):
         """KV-cache incremental decoding, the WHOLE loop in one executable.
 
         Reference analog: generation over fused_multi_transformer's CacheKV
         tensors (incubate/nn/layer/fused_transformer.py:1021). TPU-native:
         prefill writes the prompt's K/V into static [B, M, nh, hd] buffers,
-        then a lax.scan of single-token steps decodes max_new_tokens — one
-        compiled program per (prompt_shape, max_new_tokens, sampling config),
-        no per-token Python or recompiles. Greedy by default;
-        do_sample=True draws from softmax(logits/temperature) with optional
-        top-k. After an EOS a row keeps emitting EOS. Requires
-        scan_layers=False (the cache threads through discrete blocks)."""
+        then a lax.while_loop of single-token steps decodes up to
+        max_new_tokens (stopping the loop early once EVERY row has emitted
+        EOS) — one compiled program per (prompt_shape, max_new_tokens,
+        sampling config), no per-token Python or recompiles. Greedy by
+        default; do_sample=True draws from softmax(logits/temperature) with
+        optional top-k; ``seed=None`` draws the sampling seed from
+        ``core.random.host_generator()`` so ``paddle.seed`` makes generation
+        reproducible. After an EOS a row keeps emitting EOS. Requires
+        scan_layers=False (the cache threads through discrete blocks).
+
+        ``use_engine=True`` routes through ``paddle_tpu.serving.DecodeEngine``
+        (paged KV cache + slot scheduler) — same greedy tokens, and the
+        engine's executables are shared with any concurrent serving traffic.
+        """
         cfg = self.config
         if cfg.scan_layers:
             raise NotImplementedError(
@@ -465,6 +489,12 @@ class GPTForCausalLM(nn.Layer):
                 f"max_length {max_length} exceeds the learned position "
                 f"table ({cfg.max_position_embeddings}); positions past it "
                 f"would silently clamp")
+        if use_engine:
+            from ..serving import generate_via_engine
+            return generate_via_engine(
+                self, input_ids, max_new_tokens=max_new_tokens,
+                temperature=temperature, do_sample=do_sample, top_k=top_k,
+                eos_token_id=eos_token_id, seed=seed, max_length=max_length)
         return _generate_with_cache(
             self, self.gpt, cfg.num_layers, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, cfg.max_position_embeddings,
@@ -477,12 +507,58 @@ class GPTForCausalLM(nn.Layer):
 
 
 
+def _lm_head_logits(hidden_last, head_weight, transpose: bool):
+    """fp32 LM-head matmul over last hidden states. Shared by the eager
+    compiled loop AND serving.DecodeEngine — one definition so the two
+    decode paths cannot numerically drift apart (parity tests depend on
+    greedy tokens matching exactly)."""
+    w = head_weight.value().astype(jnp.float32)
+    return hidden_last.astype(jnp.float32) @ (w.T if transpose else w)
+
+
+def _pick_token(logits, key, do_sample: bool, temperature, top_k: int):
+    """Greedy argmax or temperature + top-k categorical draw over [B, V]
+    logits. Shared by the eager loop and the serving engine (see
+    _lm_head_logits)."""
+    if do_sample:
+        lg = logits / jnp.maximum(temperature, 1e-6)
+        if top_k and top_k > 0:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -1e30, lg)
+        return jax.random.categorical(key, lg, axis=-1)
+    return jnp.argmax(logits, axis=-1)
+
+
+def _resolve_decode_horizon(s0: int, max_new_tokens: int, max_length,
+                            max_pos: int, seed, do_sample: bool):
+    """Shared generate() front door (eager loop AND serving's
+    generate_via_engine — one definition so the two entry points cannot
+    drift): validate the token budget, size the KV horizon to the DECODE
+    (not the model's position table — tight M more than doubles tok/s, see
+    _generate_with_cache), and derive the sampling seed. Un-seeded sampling
+    draws from host_generator() so paddle.seed reproduces it; greedy never
+    reads the key and must not consume the shared stream."""
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    m = int(max_length or min(s0 + max_new_tokens, max_pos))
+    if s0 + max_new_tokens > m:
+        raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
+                         f"exceeds max_length {m}")
+    if seed is None:
+        if do_sample:
+            from ..core.random import host_generator
+            seed = int(host_generator().integers(0, 2**31 - 1))
+        else:
+            seed = 0
+    return m, int(seed)
+
+
 def _generate_with_cache(lm, backbone, num_layers: int, n_kv_heads: int,
                          head_dim: int, max_pos: int, head_weight,
                          head_transpose: bool, input_ids, max_new_tokens,
                          temperature, do_sample, top_k, eos_token_id, seed,
                          max_length):
-    """Shared compiled prefill+scan decode loop (GPT and LLaMA): see
+    """Shared compiled prefill+decode loop (GPT and LLaMA): see
     GPTForCausalLM.generate for the contract. `backbone(ids, kv_caches=...,
     start_pos=...)` must return (hidden, new_caches)."""
     from ..core import dispatch
@@ -490,35 +566,27 @@ def _generate_with_cache(lm, backbone, num_layers: int, n_kv_heads: int,
     ids_arr = input_ids.value() if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     b, s0 = ids_arr.shape
-    if max_new_tokens < 0:
-        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    if max_new_tokens == 0:
-        return Tensor(ids_arr.astype(jnp.int32))   # same dtype as n>0 paths
     # cache buffers sized to the DECODE, not the model's position table:
     # every step streams the whole [B, M, nh, hd] K/V pair per layer, and at
     # GPT-medium M=1024 that 0.54 GB/step read was 2.6 of the 4.9 ms step
     # (BASELINE.md round-4 decode table) — tight M more than doubled tok/s
-    m = int(max_length or min(s0 + max_new_tokens, max_pos))
-    if s0 + max_new_tokens > m:
-        raise ValueError(f"prompt {s0} + max_new_tokens {max_new_tokens} "
-                         f"exceeds max_length {m}")
-    params = [p for _, p in lm.named_parameters()]
+    m, seed = _resolve_decode_horizon(s0, max_new_tokens, max_length,
+                                      max_pos, seed, do_sample)
+    if max_new_tokens == 0:
+        return Tensor(ids_arr.astype(jnp.int32))   # same dtype as n>0 paths
+    # params AND buffers: an int8-quantized model (quantize_for_serving)
+    # carries its weights as Int8Linear BUFFERS — rebinding them keeps the
+    # executable weight-update-safe instead of baking them in as constants
+    params = [p for _, p in lm.named_parameters()] \
+        + [bf for _, bf in lm.named_buffers()]
     dtype = params[0].value().dtype
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
     def head(hidden_last):
-        w = head_weight.value().astype(jnp.float32)
-        hl = hidden_last.astype(jnp.float32)
-        return hl @ (w.T if head_transpose else w)
+        return _lm_head_logits(hidden_last, head_weight, head_transpose)
 
     def pick(logits, key):
-        if do_sample:
-            lg = logits / jnp.maximum(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                lg = jnp.where(lg < kth, -1e30, lg)
-            return jax.random.categorical(key, lg, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return _pick_token(logits, key, do_sample, temperature, top_k)
 
     def gen_fn(param_arrays, ids, key0):
         ctx = dispatch.TraceContext()
@@ -532,25 +600,36 @@ def _generate_with_cache(lm, backbone, num_layers: int, n_kv_heads: int,
                       for _ in range(num_layers)]
             hidden, caches = backbone(Tensor(ids), kv_caches=caches,
                                       start_pos=jnp.int32(0))
-            tok0 = pick(head(hidden.value()[:, -1]), key0)
+            tok0 = pick(head(hidden.value()[:, -1]), key0).astype(jnp.int32)
             done0 = tok0 == eos
 
-            def step(carry, i):
-                caches, tok, done, key = carry
+            # while_loop (not scan): once EVERY row has emitted EOS the loop
+            # exits — a batch that finishes in 3 tokens pays 3 steps, not
+            # max_new_tokens. Unvisited columns keep the EOS fill, which is
+            # exactly what finished rows would have emitted.
+            out0 = jnp.full((b, max_new_tokens), max(eos, 0), jnp.int32)
+            out0 = jax.lax.dynamic_update_slice(out0, tok0[:, None], (0, 0))
+
+            def cond(carry):
+                _, _, done, _, i, _ = carry
+                return (i < max_new_tokens) & ~jnp.all(done)
+
+            def step(carry):
+                caches, tok, done, key, i, out = carry
                 key, sub = jax.random.split(key)
                 hidden, caches = backbone(
-                    Tensor(tok[:, None].astype(jnp.int32)),
-                    kv_caches=caches, start_pos=(s0 + i).astype(jnp.int32))
-                nxt = pick(head(hidden.value()[:, -1]), sub)
+                    Tensor(tok[:, None]), kv_caches=caches,
+                    start_pos=jnp.int32(s0 - 1) + i)
+                nxt = pick(head(hidden.value()[:, -1]), sub).astype(jnp.int32)
                 nxt = jnp.where(done, eos, nxt)      # finished rows: EOS
                 done = done | (nxt == eos)
-                return (caches, nxt, done, key), tok
+                out = jax.lax.dynamic_update_slice(out, nxt[:, None],
+                                                   (jnp.int32(0), i))
+                return (caches, nxt, done, key, i + jnp.int32(1), out)
 
-            (_, last, _, _), toks = jax.lax.scan(
-                step, (caches, tok0, done0, key0),
-                jnp.arange(max_new_tokens - 1))
-            return jnp.concatenate(
-                [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+            carry = jax.lax.while_loop(
+                cond, step, (caches, tok0, done0, key0, jnp.int32(1), out0))
+            return carry[5]
         finally:
             dispatch.pop_trace()
             ctx.restore()
@@ -561,8 +640,13 @@ def _generate_with_cache(lm, backbone, num_layers: int, n_kv_heads: int,
     # churn cannot grow it without limit)
     if not hasattr(lm, "_gen_cache"):
         lm._gen_cache = {}
+    # the leaf fingerprint invalidates stale closures when the model's
+    # parameter/buffer STRUCTURE changes underneath us (e.g. an in-place
+    # int8 swap after a generate() call): the cached gen_fn closes over the
+    # old leaf list and would rebind the new arrays to the wrong tensors
+    leaf_sig = tuple((tuple(p.shape), str(p.value().dtype)) for p in params)
     cache_key = (b, s0, max_new_tokens, m, do_sample, top_k,
-                 float(temperature), eos)
+                 float(temperature), eos, leaf_sig)
     jitted = lm._gen_cache.get(cache_key)
     if jitted is None:
         if len(lm._gen_cache) >= 8:

@@ -63,15 +63,20 @@ def llama_tiny(**overrides) -> LlamaConfig:
 
 def _rope_fwd(q, k, *rest, theta=10000.0, has_pos=False):
     """Rotary embedding applied to q,k [B,S,H,D] (interleaved-pair form).
-    Optional trailing scalar position offset (KV-cache decoding: the chunk
-    starts at an absolute position, not 0)."""
+    Optional trailing position offset (KV-cache decoding: the chunk starts
+    at an absolute position, not 0) — a scalar (lockstep batch) or a [B]
+    vector (serving slots, each row at its own depth)."""
     B, S, H, D = q.shape
-    p0 = rest[0].astype(jnp.float32) if has_pos else 0.0
-    pos = p0 + jnp.arange(S, dtype=jnp.float32)
+    p0 = rest[0].astype(jnp.float32) if has_pos else jnp.float32(0.0)
+    # [S] for a scalar offset, [B, S] for per-row offsets
+    pos = jnp.asarray(p0)[..., None] + jnp.arange(S, dtype=jnp.float32)
     inv = theta ** (-jnp.arange(0, D, 2, dtype=jnp.float32) / D)
-    ang = pos[:, None] * inv[None, :]                      # [S, D/2]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos[..., None] * inv                 # [S, D/2] or [B, S, D/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if ang.ndim == 2:
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
 
     def rot(x):
         x1, x2 = x[..., ::2], x[..., 1::2]
@@ -139,17 +144,25 @@ class LlamaAttention(nn.Layer):
         q, k = _op("rope", q, k, Tensor(jnp.asarray(pos)), theta=self.theta,
                    has_pos=True)
         qv, kv_, vv = q.value(), k.value(), v.value()
-        k_buf = jax.lax.dynamic_update_slice(
-            k_buf, kv_.astype(k_buf.dtype), (0, pos, 0, 0))
-        v_buf = jax.lax.dynamic_update_slice(
-            v_buf, vv.astype(v_buf.dtype), (0, pos, 0, 0))
+        if jnp.ndim(pos) == 1:
+            # per-slot cursors (serving engine): vmapped per-row writes
+            upd = lambda buf, kv, p: jax.lax.dynamic_update_slice(
+                buf, kv, (p, 0, 0))
+            k_buf = jax.vmap(upd)(k_buf, kv_.astype(k_buf.dtype), pos)
+            v_buf = jax.vmap(upd)(v_buf, vv.astype(v_buf.dtype), pos)
+            q_pos = (pos[:, None] + jnp.arange(s))[:, None, None, :, None]
+        else:
+            k_buf = jax.lax.dynamic_update_slice(
+                k_buf, kv_.astype(k_buf.dtype), (0, pos, 0, 0))
+            v_buf = jax.lax.dynamic_update_slice(
+                v_buf, vv.astype(v_buf.dtype), (0, pos, 0, 0))
+            q_pos = (pos + jnp.arange(s))[None, None, None, :, None]
         m = k_buf.shape[1]
         group = nh // nkv
         qg = qv.reshape(b, s, nkv, group, hd)
         scores = jnp.einsum("bqkgd,bmkd->bkgqm", qg.astype(jnp.float32),
                             k_buf.astype(jnp.float32)) / math.sqrt(hd)
         key_pos = jnp.arange(m)[None, None, None, None, :]
-        q_pos = (pos + jnp.arange(s))[None, None, None, :, None]
         scores = jnp.where(key_pos <= q_pos, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bkgqm,bmkd->bqkgd", probs,
@@ -261,13 +274,22 @@ class LlamaForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, do_sample: bool = False,
-                 top_k: int = 0, eos_token_id=None, seed: int = 0,
-                 max_length=None):
-        """KV-cache incremental decoding — same compiled prefill+scan
+                 top_k: int = 0, eos_token_id=None, seed=None,
+                 max_length=None, use_engine: bool = False):
+        """KV-cache incremental decoding — same compiled prefill+decode
         machinery as GPTForCausalLM.generate (RoPE positions offset by the
-        cache cursor, GQA K/V buffers sized [B, M, n_kv, hd])."""
+        cache cursor, GQA K/V buffers sized [B, M, n_kv, hd]); ``seed=None``
+        derives sampling randomness from ``paddle.seed`` via
+        ``core.random.host_generator()``. ``use_engine=True`` routes through
+        the serving DecodeEngine (paged cache + slot scheduler)."""
         from .gpt import _generate_with_cache
         cfg = self.config
+        if use_engine:
+            from ..serving import generate_via_engine
+            return generate_via_engine(
+                self, input_ids, max_new_tokens=max_new_tokens,
+                temperature=temperature, do_sample=do_sample, top_k=top_k,
+                eos_token_id=eos_token_id, seed=seed, max_length=max_length)
         return _generate_with_cache(
             self, self.model, cfg.num_layers, cfg.num_kv_heads,
             cfg.hidden_size // cfg.num_heads,

@@ -296,6 +296,67 @@ class Monitor:
         self.registry.counter("preempt/signals").inc()
         self.emit("preemption", signum=int(signum))
 
+    # ----------------------------------------------------- integration: serving
+
+    def serve_engine(self, max_slots: int, max_len: int, buckets, quantize,
+                     engine_id=None):
+        """A DecodeEngine came up: record its static geometry."""
+        g = self.registry.gauge
+        g("serve/max_slots").set(max_slots)
+        g("serve/max_len").set(max_len)
+        self.emit("serve_engine", max_slots=max_slots, max_len=max_len,
+                  prefill_buckets=list(buckets), quantize=quantize,
+                  engine=engine_id)
+
+    def serve_compiled(self, kind: str, bucket, compile_s: float, count: int,
+                       engine_id=None):
+        """Serving recompile sentinel: the engine minted an executable.
+        kind: "prefill" (one per prompt-length bucket) | "decode" (exactly
+        one per ENGINE, ever — a second decode mint from the same engine in
+        steady state is a bug; `engine_id` lets a sink with several engines
+        tell re-mints from a sibling engine's first mint)."""
+        self.registry.counter("serve/compiles").inc()
+        self.registry.counter(f"serve/compiles_{kind}").inc()
+        self.registry.gauge("serve/executables").set(count)
+        self.registry.histogram("serve/compile_s").observe(compile_s)
+        self.emit("serve_compile", path=kind, bucket=bucket,
+                  compile_s=compile_s, count=count, engine=engine_id)
+
+    def serve_request(self, queued: bool, error: Optional[str] = None):
+        """submit() outcome: admitted to the queue, or rejected at the door
+        (malformed requests never reach a slot)."""
+        if queued:
+            self.registry.counter("serve/requests").inc()
+        else:
+            self.registry.counter("serve/rejected").inc()
+            self.emit("serve_reject", error=error)
+
+    def serve_admitted(self, ttft_s: float, bucket: int, prefill_s: float):
+        """A request's prefill folded into a free slot; its first token is
+        out. ttft_s spans submit -> first token (queue wait included)."""
+        self.registry.counter("serve/admissions").inc()
+        self.registry.histogram("serve/ttft_s").observe(ttft_s)
+        self.registry.histogram("serve/prefill_s").observe(prefill_s)
+        self.emit("serve_admit", ttft_s=ttft_s, bucket=bucket,
+                  prefill_s=prefill_s)
+
+    def serve_step(self, dur_s: float, live: int, queue_depth: int):
+        """One decode step over all live slots: per-token latency is
+        dur_s (the whole batch advances one token per step)."""
+        self.registry.counter("serve/decode_steps").inc()
+        self.registry.counter("serve/tokens").inc(live)
+        self.registry.gauge("serve/live_slots").set(live)
+        self.registry.gauge("serve/queue_depth").set(queue_depth)
+        self.registry.histogram("serve/step_s").observe(dur_s)
+
+    def serve_done(self, n_tokens: int, total_s: float, status: str):
+        """A request left its slot (stop condition hit)."""
+        self.registry.counter("serve/completions").inc()
+        self.registry.histogram("serve/request_s").observe(total_s)
+        self.registry.histogram("serve/request_tokens").observe(n_tokens)
+        self.emit("serve_done", tokens=n_tokens, total_s=total_s,
+                  status=status)
+
     # -------------------------------------------------- integration: profiler
 
     def stage_event(self, name: str, start: float, end: float, kind: str):

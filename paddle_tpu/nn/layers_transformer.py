@@ -31,6 +31,12 @@ def _convert_attention_mask(attn_mask, dtype):
 class MultiHeadAttention(Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    # Preallocated decode cache: fixed [B, max_length, H, Dh] buffers written
+    # at `pos` via dynamic_update_slice — shapes never grow, so a compiled
+    # decode loop over it never recompiles (the concat Cache grows its length
+    # axis every token, minting a new executable per step under jit)
+    StaticDecodeCache = collections.namedtuple("StaticDecodeCache",
+                                               ["k", "v", "pos"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
@@ -53,13 +59,24 @@ class MultiHeadAttention(Layer):
         b, l = x.shape[0], x.shape[1]
         return reshape(x, [b, l, self.num_heads, self.head_dim])
 
-    def gen_cache(self, key, value=None, type=None):
+    def gen_cache(self, key, value=None, type=None, max_length=None):
         if type == MultiHeadAttention.StaticCache:
             k = self._shape(self.k_proj(key))
             v = self._shape(self.v_proj(value if value is not None else key))
             return self.StaticCache(k, v)
         b = key.shape[0]
         from ..ops import zeros
+        if type == MultiHeadAttention.StaticDecodeCache:
+            if max_length is None:
+                raise ValueError(
+                    "gen_cache(type=StaticDecodeCache) needs max_length= "
+                    "(the preallocated buffer's fixed decode horizon)")
+            k = zeros([b, int(max_length), self.num_heads, self.head_dim],
+                      key.dtype)
+            v = zeros([b, int(max_length), self.num_heads, self.head_dim],
+                      key.dtype)
+            import jax.numpy as jnp
+            return self.StaticDecodeCache(k, v, jnp.int32(0))
         k = zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
         v = zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
         return self.Cache(k, v)
@@ -67,6 +84,9 @@ class MultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
+        if isinstance(cache, self.StaticDecodeCache):
+            return self._forward_static_decode(query, key, value, attn_mask,
+                                               cache)
         q = self._shape(self.q_proj(query))
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
@@ -88,6 +108,50 @@ class MultiHeadAttention(Layer):
         if self.need_weights:
             return out, None
         return out
+
+    def _forward_static_decode(self, query, key, value, attn_mask, cache):
+        """Write this chunk's K/V at ``cache.pos`` into the fixed-length
+        buffers and attend causally over every cached position <= the
+        query's own absolute position. Raw-array math (inference-only): runs
+        inside jit with static shapes, so decoding N tokens through it is N
+        executions of ONE executable. The cache comes back with pos advanced
+        — the namedtuple is the carry, exactly like the concat Cache."""
+        if attn_mask is not None:
+            raise ValueError(
+                "StaticDecodeCache implies causal masking over the cache "
+                "cursor; an explicit attn_mask is not supported")
+        import math as _math
+
+        import jax
+        import jax.numpy as jnp
+
+        q = self._shape(self.q_proj(query))
+        k = self._shape(self.k_proj(key))
+        v = self._shape(self.v_proj(value))
+        k_buf, v_buf = cache.k.value(), cache.v.value()
+        pos = cache.pos
+        qv, kv, vv = q.value(), k.value(), v.value()
+        k_buf = jax.lax.dynamic_update_slice(
+            k_buf, kv.astype(k_buf.dtype), (0, pos, 0, 0))
+        v_buf = jax.lax.dynamic_update_slice(
+            v_buf, vv.astype(v_buf.dtype), (0, pos, 0, 0))
+        b, s = qv.shape[0], qv.shape[1]
+        m = k_buf.shape[1]
+        scores = jnp.einsum("bqnd,bknd->bnqk", qv.astype(jnp.float32),
+                            k_buf.astype(jnp.float32)) \
+            / _math.sqrt(self.head_dim)
+        key_pos = jnp.arange(m)[None, None, None, :]
+        q_pos = (pos + jnp.arange(s))[None, None, :, None]
+        scores = jnp.where(key_pos <= q_pos, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bnqk,bknd->bqnd", probs,
+                         v_buf.astype(jnp.float32)).astype(qv.dtype)
+        out = self.out_proj(Tensor(ctx.reshape(b, s, self.embed_dim)))
+        new_cache = self.StaticDecodeCache(Tensor(k_buf), Tensor(v_buf),
+                                           pos + jnp.int32(s))
+        if self.need_weights:
+            return out, None, new_cache
+        return out, new_cache
 
 
 class TransformerEncoderLayer(Layer):
@@ -130,8 +194,8 @@ class TransformerEncoderLayer(Layer):
             src = self.norm2(src)
         return src if cache is None else (src, cache)
 
-    def gen_cache(self, src):
-        return self.self_attn.gen_cache(src)
+    def gen_cache(self, src, type=None, max_length=None):
+        return self.self_attn.gen_cache(src, type=type, max_length=max_length)
 
 
 class TransformerEncoder(Layer):
@@ -156,8 +220,9 @@ class TransformerEncoder(Layer):
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
 
-    def gen_cache(self, src):
-        return [layer.gen_cache(src) for layer in self.layers]
+    def gen_cache(self, src, type=None, max_length=None):
+        return [layer.gen_cache(src, type=type, max_length=max_length)
+                for layer in self.layers]
 
 
 class TransformerDecoderLayer(Layer):
@@ -202,9 +267,9 @@ class TransformerDecoderLayer(Layer):
             tgt = self.cross_attn(tgt, memory, memory, memory_mask)
             static_cache = None
         else:
-            tgt = self.cross_attn(tgt, cache[1].k, cache[1].v, memory_mask)
-            # static cache: k/v precomputed, passed via StaticCache
-            tgt = tgt
+            # static cache: k/v precomputed over memory, passed via StaticCache
+            # (NOT as key/value — those would be re-projected)
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask, cache[1])
             static_cache = cache[1]
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
@@ -220,8 +285,12 @@ class TransformerDecoderLayer(Layer):
             return tgt
         return tgt, (incremental_cache, static_cache)
 
-    def gen_cache(self, memory):
-        incremental = self.self_attn.gen_cache(memory)
+    def gen_cache(self, memory, type=None, max_length=None):
+        # `type`/`max_length` choose the SELF-attention cache form (concat
+        # Cache vs preallocated StaticDecodeCache); the cross-attention cache
+        # is always the precomputed StaticCache over `memory`
+        incremental = self.self_attn.gen_cache(memory, type=type,
+                                               max_length=max_length)
         static = self.cross_attn.gen_cache(memory, memory,
                                            MultiHeadAttention.StaticCache)
         return incremental, static
@@ -250,8 +319,9 @@ class TransformerDecoder(Layer):
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
 
-    def gen_cache(self, memory, do_zip=False):
-        return [layer.gen_cache(memory) for layer in self.layers]
+    def gen_cache(self, memory, do_zip=False, type=None, max_length=None):
+        return [layer.gen_cache(memory, type=type, max_length=max_length)
+                for layer in self.layers]
 
 
 class Transformer(Layer):

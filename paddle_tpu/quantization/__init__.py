@@ -206,6 +206,20 @@ class Int8Linear(Layer):
         self.bias = bias
 
     @classmethod
+    def from_linear(cls, linear) -> "Int8Linear":
+        """Weight-only conversion straight from an ``nn.Linear`` — no
+        calibration pass. Per-output-channel weight scales; activations use
+        the dynamic per-token path (live row max, fused by XLA), so no
+        observer state is needed. This is the serving engine's one-call
+        quantization entry point."""
+        w = linear.weight.numpy()
+        qmax = 127.0
+        scale = np.maximum(np.abs(w).max(axis=0, keepdims=True), 1e-8)
+        qw = np.clip(np.round(w / scale * qmax), -qmax, qmax).astype(np.int8)
+        return cls(qw, (scale / qmax).astype(np.float32), 1.0, linear.bias,
+                   dynamic=True)
+
+    @classmethod
     def from_quanted(cls, quanted: "QuantedLinear") -> "Int8Linear":
         cfg = quanted._cfg
         w = quanted._inner.weight.numpy()

@@ -1,0 +1,26 @@
+"""paddle_tpu.serving — compiled decode engine with paged KV cache and
+continuous batching.
+
+The "millions of users" half of the north star: where ``jit.TrainStep``
+compiles the whole training step into one executable per shape bucket,
+``serving.DecodeEngine`` does the same for generation — a fixed-shape
+decode step over a preallocated slotted KV cache (zero recompiles under
+any admission/eviction pattern) plus bucketed prefill, scheduled at
+iteration granularity (Orca) so short and long requests share the batch
+without padding each other out (vLLM-style slot paging on the batch axis).
+
+    from paddle_tpu.serving import DecodeEngine
+    eng = DecodeEngine(model, max_slots=16, max_len=1024)
+    req = eng.submit(prompt_ids, max_new_tokens=128, eos_token_id=eos)
+    eng.run()                      # or eng.step() inside a serving loop
+    print(req.output_tokens)
+
+Telemetry: ``serve/*`` counters/gauges/histograms in ``paddle_tpu.monitor``
+(QPS, TTFT, per-token latency, slot occupancy, executable mints).
+"""
+from .engine import (DecodeEngine, Request, generate_via_engine,
+                     quantize_for_serving)
+from .scheduler import AdmissionQueue, SlotAllocator
+
+__all__ = ["DecodeEngine", "Request", "generate_via_engine",
+           "quantize_for_serving", "AdmissionQueue", "SlotAllocator"]

@@ -1,0 +1,571 @@
+"""Compiled decode engine: paged KV cache + continuous batching.
+
+The serving analog of ``jit.TrainStep``: every hot-path computation is an
+AOT executable (``jax.jit(...).lower().compile()``) minted ONCE per shape
+bucket, and the steady state runs zero recompiles no matter which requests
+come and go. Two executable families:
+
+* **decode step** — fixed shape ``[max_slots, 1]``: one token for every
+  slot of the preallocated KV cache, each slot reading/writing at its OWN
+  cursor (``pos`` is a ``[max_slots]`` vector; the models' cached-attention
+  path vmaps a per-row ``dynamic_update_slice``). Slot membership is data,
+  not shape: admissions and evictions change ``pos``/``tok`` values, never
+  the executable. One compile, ever.
+* **prefill** — one executable per prompt-length bucket ``[1, S_b]``: runs
+  the prompt through the backbone with a small bucket-sized cache, writes
+  the resulting K/V block into the big cache at the assigned slot row
+  (``dynamic_update_slice`` at ``(slot, 0, 0, 0)``), and emits the first
+  generated token from the TRUE last prompt position (padding is masked by
+  causality). While one slot prefills, every other slot's state just waits
+  — the next decode step picks them all up together (vLLM/Orca-style
+  iteration-level scheduling, PAPERS.md).
+
+The paged cache is per-layer ``[max_slots, max_len, n_kv, hd]`` K/V pairs,
+donated through every executable call so XLA updates them in place —
+steady-state decode allocates nothing. Stale K/V from a slot's previous
+tenant is harmless by construction: causal masking only exposes positions
+``<= cursor``, and every position below the cursor was freshly written by
+this tenant's prefill or decode steps.
+
+Int8 weight-only quantization (``quantize="int8"``) swaps the model's
+Linear layers for ``quantization.Int8Linear`` (dynamic per-token activation
+scales) IN PLACE before tracing — the engine then serves int8 GEMMs with
+fp accumulation, same executables, same zero-recompile contract.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import namedtuple
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import monitor as _monitor
+from ..core.tensor import Tensor
+from ..models.gpt import (_lm_head_logits, _pick_token,
+                          _resolve_decode_horizon)
+from .scheduler import AdmissionQueue, Request, SlotAllocator
+
+__all__ = ["DecodeEngine", "Request", "generate_via_engine",
+           "quantize_for_serving"]
+
+
+ModelSpec = namedtuple("ModelSpec", [
+    "backbone", "num_layers", "n_kv_heads", "head_dim", "max_pos",
+    "head_weight", "head_transpose"])
+
+
+def _model_spec(model) -> ModelSpec:
+    """Resolve the causal-LM surface the engine drives: the cached-forward
+    backbone, KV-cache geometry, and the LM head weight. Duck-typed over
+    GPTForCausalLM / LlamaForCausalLM (both expose ``backbone(ids,
+    kv_caches=..., start_pos=...) -> (hidden, new_caches)``)."""
+    cfg = getattr(model, "config", None)
+    if cfg is None:
+        raise TypeError(f"{type(model).__name__} has no .config — the "
+                        f"engine serves GPT/LLaMA-style causal LMs")
+    if hasattr(model, "gpt"):                       # GPTForCausalLM
+        if getattr(cfg, "scan_layers", False):
+            raise NotImplementedError(
+                "DecodeEngine requires scan_layers=False (the KV cache "
+                "threads through discrete blocks)")
+        return ModelSpec(
+            model.gpt, cfg.num_layers, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_position_embeddings,
+            model.gpt.wte.weight if model.lm_head is None
+            else model.lm_head.weight,
+            model.lm_head is None)
+    if hasattr(model, "model"):                     # LlamaForCausalLM
+        return ModelSpec(
+            model.model, cfg.num_layers, cfg.num_kv_heads,
+            cfg.hidden_size // cfg.num_heads, cfg.max_position_embeddings,
+            model.model.embed_tokens.weight if model.lm_head is None
+            else model.lm_head.weight,
+            model.lm_head is None)
+    raise TypeError(f"cannot resolve a decode backbone on "
+                    f"{type(model).__name__}")
+
+
+def quantize_for_serving(model, skip: Sequence = ()):
+    """Weight-only int8 conversion of every ``nn.Linear`` IN PLACE (the
+    ``QAT.quantize`` idiom): per-output-channel int8 weights + dynamic
+    per-token activation scales, int8 MXU dot with fp32 accumulation.
+
+    The LM head is always skipped — the engine's head matmul reads the raw
+    weight array (tied-embedding compatible), and head logits are the most
+    quantization-sensitive tensor in the model anyway. ``skip`` adds
+    further layer objects (by identity) to leave untouched."""
+    from ..nn import Linear
+    from ..nn.layer import swap_sublayers
+    from ..quantization import Int8Linear
+
+    keep = {id(s) for s in skip if s is not None}
+    head = getattr(model, "lm_head", None)
+    if head is not None:
+        keep.add(id(head))
+
+    def swap(layer):
+        if isinstance(layer, Linear) and id(layer) not in keep:
+            return Int8Linear.from_linear(layer)
+        return None
+
+    return swap_sublayers(model, swap)
+
+
+class DecodeEngine:
+    """AOT-compiled serving engine over one causal LM.
+
+    Knobs:
+      max_slots        batch rows of the paged KV cache (concurrent requests)
+      max_len          per-slot KV horizon; prompt + new tokens must fit
+      prefill_buckets  padded prompt lengths (one executable each);
+                       default: powers of two up to max_len
+      quantize         None | "int8" (weight-only, converts model in place)
+      do_sample/temperature/top_k/seed
+                       sampling config — STATIC per engine (baked into the
+                       executables); greedy by default
+
+    ``submit()`` validates and queues; ``step()`` runs ONE scheduler
+    iteration (admit into free slots via prefill, then one decode step over
+    all live slots); ``run()`` drains. Telemetry lands under ``serve/*``
+    when the monitor is enabled, and every minted executable bumps
+    ``compile_count`` (the serving recompile sentinel — flat in steady
+    state).
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, model, *, max_slots: int = 8, max_len: int = 256,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 quantize: Optional[str] = None, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, seed: int = 0):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {quantize!r}")
+        spec = _model_spec(model)
+        if max_len > spec.max_pos:
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's position horizon "
+                f"({spec.max_pos})")
+        if quantize == "int8":
+            quantize_for_serving(model)
+        self.model = model
+        self.spec = spec
+        self.quantize = quantize
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self._do_sample = bool(do_sample)
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        # the executables rebind EVERY param and buffer as an input, so
+        # weight updates (or an int8 swap) between calls flow through
+        # without retracing
+        self._leaves = [p for _, p in model.named_parameters()] \
+            + [b for _, b in model.named_buffers()]
+        self._cache_dtype = spec.head_weight.value().dtype
+        self._caches = [
+            (jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
+                        spec.head_dim), self._cache_dtype),
+             jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
+                        spec.head_dim), self._cache_dtype))
+            for _ in range(spec.num_layers)]
+        if prefill_buckets is None:
+            buckets, b = [], 8
+            while b < self.max_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_len)
+        else:
+            buckets = [int(b) for b in prefill_buckets]
+            if any(b < 1 or b > self.max_len for b in buckets):
+                raise ValueError(f"prefill_buckets must lie in "
+                                 f"[1, max_len={self.max_len}]: {buckets}")
+        self.prefill_buckets = sorted(set(buckets))
+        # host-side slot state: cursors/last-token per row; dead rows sit at
+        # pos 0 (their decode writes land on a row the next prefill rewrites)
+        self._pos = np.zeros(self.max_slots, np.int32)
+        self._tok = np.zeros(self.max_slots, np.int32)
+        self._live = np.zeros(self.max_slots, bool)
+        self._slot_req: List[Optional[Request]] = [None] * self.max_slots
+        self._slots = SlotAllocator(self.max_slots)
+        self._queue = AdmissionQueue()
+        self._decode_exe = None
+        self._prefill_exes = {}
+        self._key = jax.random.PRNGKey(int(seed))
+        self._greedy_key = jax.random.PRNGKey(0)   # unused by greedy pick
+        # serving recompile sentinel (monitor-independent; tests gate on it)
+        self.compile_count = 0
+        self.decode_steps = 0
+        self.tokens_generated = 0
+        self.engine_id = next(DecodeEngine._ids)
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_engine(self.max_slots, self.max_len,
+                             self.prefill_buckets, quantize,
+                             engine_id=self.engine_id)
+
+    # ------------------------------------------------------------- tracing
+
+    def _traced(self, leaf_arrays, body):
+        """Run ``body`` with every model param/buffer rebound to the traced
+        input arrays (the _generate_with_cache idiom): the executables own
+        their weights as ARGUMENTS, never as baked-in constants."""
+        from ..core import dispatch
+        ctx = dispatch.TraceContext()
+        saved = [t._data for t in self._leaves]
+        dispatch.push_trace(ctx)
+        try:
+            for t, a in zip(self._leaves, leaf_arrays):
+                t._data = a
+            return body()
+        finally:
+            dispatch.pop_trace()
+            ctx.restore()
+            for t, d in zip(self._leaves, saved):
+                t._data = d
+
+    def _head(self, hidden):
+        # shared with the eager compiled loop — the parity contract
+        return _lm_head_logits(hidden, self.spec.head_weight,
+                               self.spec.head_transpose)
+
+    def _pick(self, logits, key):
+        return _pick_token(logits, key, self._do_sample, self._temperature,
+                           self._top_k)
+
+    def _leaf_values(self):
+        return tuple(t.value() for t in self._leaves)
+
+    def _next_key(self):
+        if not self._do_sample:
+            return self._greedy_key
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _compile_in_eval(self, fn, args):
+        """Trace + AOT-compile with every layer in eval mode (serving
+        semantics: dropout off), then restore each layer's OWN flag — an
+        engine must not flip a training model's mode as a side effect."""
+        layers = self.model.sublayers(include_self=True)
+        saved = [(l, l.training) for l in layers]
+        for l in layers:
+            l.training = False
+        try:
+            return jax.jit(fn, donate_argnums=(1,)).lower(*args).compile()
+        finally:
+            for l, f in saved:
+                l.training = f
+
+    def _minted(self, kind: str, bucket, compile_s: float):
+        self.compile_count += 1
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_compiled(kind, bucket, compile_s, self.compile_count,
+                               engine_id=self.engine_id)
+
+    # --------------------------------------------------------- executables
+
+    def _build_decode(self):
+        spec = self.spec
+
+        def fn(leaves, caches, tok, pos, key):
+            def body():
+                hidden, new_caches = spec.backbone(
+                    Tensor(tok[:, None]), kv_caches=caches, start_pos=pos)
+                logits = self._head(hidden.value()[:, -1])
+                nxt = self._pick(logits, key).astype(jnp.int32)
+                return new_caches, nxt
+            return self._traced(leaves, body)
+
+        args = (self._leaf_values(), self._caches,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                self._greedy_key)
+        t0 = time.time()
+        exe = self._compile_in_eval(fn, args)
+        self._decode_exe = exe
+        self._minted("decode", None, time.time() - t0)
+        return exe
+
+    def _build_prefill(self, sb: int):
+        spec = self.spec
+
+        def fn(leaves, caches, ids, slot, true_len, key):
+            def body():
+                small = [
+                    (jnp.zeros((1, sb, spec.n_kv_heads, spec.head_dim),
+                               self._cache_dtype),
+                     jnp.zeros((1, sb, spec.n_kv_heads, spec.head_dim),
+                               self._cache_dtype))
+                    for _ in range(spec.num_layers)]
+                hidden, small_new = spec.backbone(
+                    Tensor(ids), kv_caches=small, start_pos=jnp.int32(0))
+                # logits from the TRUE last prompt token; the bucket's
+                # padding tail is causally invisible to it
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    hidden.value(), true_len - 1, 1, axis=1)[:, 0]
+                tok0 = self._pick(self._head(h_last), key).astype(jnp.int32)
+                new_caches = [
+                    (jax.lax.dynamic_update_slice(
+                        big_k, sk.astype(big_k.dtype), (slot, 0, 0, 0)),
+                     jax.lax.dynamic_update_slice(
+                        big_v, sv.astype(big_v.dtype), (slot, 0, 0, 0)))
+                    for (big_k, big_v), (sk, sv) in zip(caches, small_new)]
+                return new_caches, tok0[0]
+            return self._traced(leaves, body)
+
+        args = (self._leaf_values(), self._caches,
+                jnp.zeros((1, sb), jnp.int32), jnp.int32(0), jnp.int32(1),
+                self._greedy_key)
+        t0 = time.time()
+        exe = self._compile_in_eval(fn, args)
+        self._prefill_exes[sb] = exe
+        self._minted("prefill", sb, time.time() - t0)
+        return exe
+
+    # ----------------------------------------------------------- requests
+
+    def _bucket_for(self, n: int) -> Optional[int]:
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        return None
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None, request_id=None
+               ) -> Request:
+        """Validate + enqueue one request. A malformed request comes back
+        ``failed`` with ``error`` set and is never admitted — the live
+        batch cannot be poisoned by one bad input."""
+        try:
+            req = Request(prompt, max_new_tokens=max_new_tokens,
+                          eos_token_id=eos_token_id, request_id=request_id)
+        except (TypeError, ValueError, OverflowError) as e:
+            # the fallback Request must not re-raise: pin every field to a
+            # known-safe value (the original bad ones live in the message)
+            req = Request([], max_new_tokens=1, request_id=request_id)
+            self._reject(req, f"invalid request: {e}")
+            return req
+        n = len(req.prompt)
+        if n == 0:
+            self._reject(req, "empty prompt")
+        elif req.max_new_tokens < 1:
+            self._reject(req, f"max_new_tokens must be >= 1, "
+                              f"got {req.max_new_tokens}")
+        elif n >= self.max_len:
+            self._reject(req, f"prompt length {n} >= engine max_len "
+                              f"{self.max_len} (no room to decode)")
+        elif n + req.max_new_tokens > self.max_len:
+            self._reject(req, f"prompt {n} + max_new_tokens "
+                              f"{req.max_new_tokens} exceeds engine "
+                              f"max_len {self.max_len}")
+        elif self._bucket_for(n) is None:
+            self._reject(req, f"prompt length {n} exceeds the largest "
+                              f"prefill bucket "
+                              f"({self.prefill_buckets[-1]})")
+        else:
+            self._queue.push(req)
+            mon = _monitor._active
+            if mon is not None:
+                mon.serve_request(queued=True)
+        return req
+
+    def _reject(self, req: Request, why: str):
+        req.status, req.error, req.t_done = "failed", why, time.time()
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_request(queued=False, error=why)
+
+    # ---------------------------------------------------------- scheduling
+
+    @property
+    def live_count(self) -> int:
+        return int(self._live.sum())
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def step(self) -> List[Request]:
+        """ONE iteration of continuous batching: fold queued prompts into
+        free slots (prefill), then decode every live slot one token.
+        Returns the requests that finished during this step."""
+        finished: List[Request] = []
+        while self._queue and self._slots.n_free:
+            self._admit(self._queue.pop(), self._slots.alloc(), finished)
+        if self._live.any():
+            self._decode(finished)
+        return finished
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drain: step until queue and slots are empty. ``max_steps`` is a
+        hard budget — exactly that many scheduler iterations run before the
+        undrained engine raises."""
+        out: List[Request] = []
+        steps = 0
+        while self._queue or self._live.any():
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"run() exceeded max_steps={max_steps} with "
+                    f"{len(self._queue)} queued / {self.live_count} live")
+            out.extend(self.step())
+            steps += 1
+        return out
+
+    def _admit(self, req: Request, slot: int, finished: List[Request]):
+        n = len(req.prompt)
+        sb = self._bucket_for(n)           # validated at submit
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, :n] = req.prompt
+        exe = self._prefill_exes.get(sb)
+        if exe is None:
+            exe = self._build_prefill(sb)
+        t0 = time.time()
+        self._caches, tok0 = exe(
+            self._leaf_values(), self._caches, jnp.asarray(ids),
+            jnp.int32(slot), jnp.int32(n), self._next_key())
+        t = int(tok0)
+        dt = time.time() - t0
+        req.slot, req.status = slot, "running"
+        req.t_first_token = time.time()
+        req.tokens.append(t)
+        self.tokens_generated += 1
+        self._pos[slot] = n
+        self._tok[slot] = t
+        self._live[slot] = True
+        self._slot_req[slot] = req
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_admitted(req.t_first_token - req.t_submit, sb, dt)
+        if req._stop_hit():
+            self._finish(req, finished)
+
+    def _decode(self, finished: List[Request]):
+        exe = self._decode_exe
+        if exe is None:
+            exe = self._build_decode()
+        t0 = time.time()
+        self._caches, nxt = exe(
+            self._leaf_values(), self._caches, jnp.asarray(self._tok),
+            jnp.asarray(self._pos), self._next_key())
+        nxt = np.asarray(nxt)
+        dt = time.time() - t0
+        live = 0
+        for slot in range(self.max_slots):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            live += 1
+            t = int(nxt[slot])
+            req.tokens.append(t)
+            self.tokens_generated += 1
+            self._pos[slot] += 1
+            self._tok[slot] = t
+            if req._stop_hit():
+                self._finish(req, finished)
+        self.decode_steps += 1
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_step(dt, live, len(self._queue))
+
+    def _finish(self, req: Request, finished: List[Request]):
+        slot = req.slot
+        self._live[slot] = False
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._slot_req[slot] = None
+        self._slots.release(slot)
+        req.status, req.t_done = "done", time.time()
+        finished.append(req)
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_done(len(req.tokens), req.t_done - req.t_submit,
+                           "done")
+
+    # ------------------------------------------------------------- insight
+
+    def stats(self) -> dict:
+        return {
+            "compile_count": self.compile_count,
+            "executables": 1 + len(self._prefill_exes)
+            if self._decode_exe is not None else len(self._prefill_exes),
+            "decode_steps": self.decode_steps,
+            "tokens_generated": self.tokens_generated,
+            "live_slots": self.live_count,
+            "queue_depth": self.queue_depth,
+        }
+
+
+def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
+                        temperature: float = 1.0, do_sample: bool = False,
+                        top_k: int = 0, eos_token_id=None, seed=None,
+                        max_length=None):
+    """`model.generate(use_engine=True)` backend: run the batch through a
+    DecodeEngine and reassemble the eager ``generate()`` output contract
+    (``[B, s0 + max_new_tokens]``, finished rows padded with eos). Engines
+    are cached on the model per (horizon, slots, sampling config) — repeat
+    calls reuse the compiled prefill/decode executables; a reused sampling
+    engine just restarts its host key stream from ``seed`` (the PRNG key is
+    an executable ARGUMENT, not baked in). A cached engine whose leaf list
+    no longer matches the model (an in-place int8 swap happened since) is
+    dropped rather than served with detached weights."""
+    ids_arr = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int32)
+    b, s0 = ids_arr.shape
+    spec = _model_spec(lm)
+    # validation + horizon + seed shared with the eager loop (drift = a
+    # silent parity break between the two generate() doors)
+    m, seed = _resolve_decode_horizon(s0, max_new_tokens, max_length,
+                                      spec.max_pos, seed, do_sample)
+    if max_new_tokens == 0:
+        return Tensor(jnp.asarray(ids_arr))
+    slots = min(b, 8)
+    engines = lm.__dict__.setdefault("_serving_engines", {})
+    key = (m, slots, do_sample,
+           (float(temperature), int(top_k)) if do_sample else None)
+    engine = engines.get(key)
+    if engine is not None:
+        cur = [p for _, p in lm.named_parameters()] \
+            + [bf for _, bf in lm.named_buffers()]
+        if len(cur) != len(engine._leaves) or any(
+                a is not b for a, b in zip(cur, engine._leaves)):
+            # the model's layer structure changed under the cached engine
+            # (e.g. quantize_for_serving swapped Linear -> Int8Linear): its
+            # executables rebind the OLD leaf objects — rebuild, don't
+            # silently serve pre-swap weights
+            engines.pop(key)
+            engine = None
+    if engine is None:
+        if len(engines) >= 4:
+            engines.pop(next(iter(engines)))
+        engine = DecodeEngine(lm, max_slots=slots, max_len=m,
+                              do_sample=do_sample, temperature=temperature,
+                              top_k=top_k, seed=seed)
+        engines[key] = engine
+    elif do_sample:
+        # restart the key stream AND the slot-assignment order: the
+        # categorical draw is per batch ROW, so reproducibility needs the
+        # same request in the same slot call-over-call (the free list's
+        # post-drain order is history-dependent; the engine is idle here)
+        engine._key = jax.random.PRNGKey(int(seed))
+        if engine.live_count == 0 and not engine._queue:
+            engine._slots = SlotAllocator(engine.max_slots)
+    reqs = [engine.submit(row, max_new_tokens=max_new_tokens,
+                          eos_token_id=eos_token_id) for row in ids_arr]
+    engine.run()
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    fill = max(eos, 0)
+    out = np.full((b, s0 + max_new_tokens), fill, np.int32)
+    out[:, :s0] = ids_arr
+    for i, req in enumerate(reqs):
+        if req.status != "done":        # engine-validated batch: can't fail
+            raise RuntimeError(f"engine request failed: {req.error}")
+        toks = req.output_tokens
+        out[i, s0:s0 + len(toks)] = toks   # eos-stopped tails keep the fill
+    return Tensor(jnp.asarray(out))

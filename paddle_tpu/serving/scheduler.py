@@ -1,0 +1,103 @@
+"""Continuous-batching scheduler state: requests, slots, admission queue.
+
+Iteration-level scheduling (Orca, Yu et al., OSDI 2022): scheduling
+decisions happen between decode STEPS, not between requests. A request
+occupies one slot (one row of the engine's preallocated KV-cache batch
+axis) from admission to its stop condition; the moment it stops, the slot
+returns to the allocator and the next queued request's prefill folds into
+it while every other slot keeps decoding. Nothing here touches jax — this
+file is pure host bookkeeping; the compiled side lives in engine.py.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import List, Optional
+
+__all__ = ["Request", "SlotAllocator", "AdmissionQueue"]
+
+
+class Request:
+    """One generation request: prompt in, tokens out, per-request stop.
+
+    Lifecycle: ``queued`` -> ``running`` (slot assigned, first token
+    emitted by the prefill) -> ``done`` | ``failed``. A malformed request
+    (empty prompt, prompt that cannot fit the engine's ``max_len``) goes
+    straight to ``failed`` with ``error`` set — it never reaches a slot, so
+    it cannot poison the live batch.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None, request_id=None):
+        self.id = request_id if request_id is not None else next(Request._ids)
+        self.prompt: List[int] = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
+        self.tokens: List[int] = []      # generated tokens (eos inclusive)
+        self.status = "queued"           # queued|running|done|failed
+        self.error: Optional[str] = None
+        self.slot: Optional[int] = None
+        self.t_submit = time.time()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    @property
+    def output_tokens(self) -> List[int]:
+        return list(self.tokens)
+
+    @property
+    def finished(self) -> bool:
+        return self.status in ("done", "failed")
+
+    def _stop_hit(self) -> bool:
+        """Per-request stop: eos emitted, or the token budget spent."""
+        if self.tokens and self.eos_token_id is not None \
+                and self.tokens[-1] == self.eos_token_id:
+            return True
+        return len(self.tokens) >= self.max_new_tokens
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, status={self.status}, "
+                f"prompt={len(self.prompt)}, tokens={len(self.tokens)}"
+                + (f", error={self.error!r}" if self.error else "") + ")")
+
+
+class SlotAllocator:
+    """Free-list over the engine's fixed slot (batch-row) indices."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self._free = list(range(n - 1, -1, -1))   # pop() hands out slot 0 first
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int):
+        assert 0 <= slot < self.n and slot not in self._free
+        self._free.append(slot)
+
+
+class AdmissionQueue:
+    """FIFO of validated requests waiting for a free slot."""
+
+    def __init__(self):
+        self._q = deque()
+
+    def push(self, req: Request):
+        self._q.append(req)
+
+    def pop(self) -> Request:
+        return self._q.popleft()
+
+    def __len__(self):
+        return len(self._q)
+
+    def __bool__(self):
+        return bool(self._q)

@@ -238,6 +238,82 @@ def summarize(paths, show_events=False, out=sys.stdout):
         if opt_b:
             print(f"  opt state (per device) {_fmt_bytes(opt_b)}", file=out)
 
+    counters_m = (metrics or {}).get("counters", {})
+    hists_m = (metrics or {}).get("histograms", {})
+    serves = by_kind.get("serve_engine", [])
+    if serves or any(k.startswith("serve/") for k in counters_m):
+        print(f"\n== serving ==", file=out)
+        eng = serves[-1] if serves else {}
+        if eng:
+            q = f"  quantize={eng['quantize']}" if eng.get("quantize") else ""
+            print(f"  engine: {int(eng.get('max_slots', 0))} slots x "
+                  f"{int(eng.get('max_len', 0))} positions  prefill buckets "
+                  f"{eng.get('prefill_buckets')}{q}", file=out)
+        reqs = counters_m.get("serve/requests", 0)
+        comps = counters_m.get("serve/completions", 0)
+        rej = counters_m.get("serve/rejected", 0)
+        # serve/tokens sums live slots per decode step; admissions add the
+        # per-request first token the prefill emits
+        toks = counters_m.get("serve/tokens", 0) \
+            + counters_m.get("serve/admissions", 0)
+        serve_ts = [r["ts"] for r in all_records
+                    if r.get("kind") in ("serve_admit", "serve_done")]
+        span_s = (max(serve_ts) - min(serve_ts)) if len(serve_ts) > 1 else 0.0
+        line = f"  requests {int(reqs)}  completed {int(comps)}  " \
+               f"rejected {int(rej)}  tokens {int(toks)}"
+        if span_s > 0:
+            line += f"  ({comps / span_s:.1f} req/s, " \
+                    f"{toks / span_s:.1f} tok/s)"
+        print(line, file=out)
+        for label, h in (("ttft", hists_m.get("serve/ttft_s")),
+                         ("prefill", hists_m.get("serve/prefill_s")),
+                         ("per-token", hists_m.get("serve/step_s"))):
+            if h and h.get("count"):
+                print(f"  {label:<9} avg {h['avg'] * 1e3:8.2f}ms  "
+                      f"min {h['min'] * 1e3:8.2f}ms  "
+                      f"max {h['max'] * 1e3:8.2f}ms  "
+                      f"p99 {h['p99'] * 1e3:8.2f}ms  (n={h['count']})",
+                      file=out)
+        steps_n = counters_m.get("serve/decode_steps", 0)
+        slots_max = max((int(e.get("max_slots", 0)) for e in serves),
+                        default=int(eng.get("max_slots", 0) or 0))
+        if steps_n and slots_max:
+            # several engines can share one sink; dividing by the LARGEST
+            # slot count keeps this a lower bound instead of a >100% figure
+            occ = counters_m.get("serve/tokens", 0) / (steps_n * slots_max)
+            multi = (f" across {len(serves)} engines"
+                     if len(serves) > 1 else "")
+            print(f"  slot occupancy {occ:.0%} over {int(steps_n)} "
+                  f"decode steps{multi}", file=out)
+        mints = by_kind.get("serve_compile", [])
+        if mints:
+            # the serving analog of the train-side recompile sentinel: a
+            # decode step's shape is fixed by construction, so a SECOND
+            # decode mint FROM THE SAME ENGINE means slot churn leaked into
+            # shapes somewhere. Sinks can hold several engines (int8 next
+            # to fp32, one per model) — each gets its own first mint free.
+            decode_by_eng = {}
+            for r in mints:
+                if r.get("path") == "decode":
+                    decode_by_eng.setdefault(
+                        (r.get("_proc"), r.get("engine")), []).append(r)
+            remints = [r for rs in decode_by_eng.values()
+                       for r in sorted(rs, key=lambda x: x.get("ts", 0))[1:]]
+            remint_ids = {id(r) for r in remints}
+            print(f"  executables ({len(mints)}):", file=out)
+            for r in mints:
+                b = f"[{r.get('bucket')}]" if r.get("bucket") else ""
+                e = f" eng{r['engine']}" if r.get("engine") is not None else ""
+                late = "  REMINT" if id(r) in remint_ids else ""
+                print(f"  +{r.get('ts', t0) - t0:9.3f}s  "
+                      f"{tag(r)}{r.get('path', '?')}{b}{e} "
+                      f"compile {r.get('compile_s', 0):.3f}s{late}", file=out)
+            if remints:
+                print(f"  WARNING: decode executable re-minted "
+                      f"{len(remints)}x — the zero-recompile steady-state "
+                      f"contract is broken (a shape depends on the "
+                      f"live-slot set)", file=out)
+
     recompiles = by_kind.get("recompile", [])
     print(f"\n== recompile timeline ({len(recompiles)}) ==", file=out)
     for r in recompiles:
