@@ -14,10 +14,17 @@ semantics (params fp32, matmuls bf16 — TPU-native mixed precision), full train
 compiled to a single XLA executable (paddle_tpu.jit.TrainStep). vs_baseline is
 relative to REF_TOKENS_PER_SEC below — the first measured value on this hardware —
 so the driver's BENCH_r{N}.json series tracks perf across rounds.
+
+``--recompute[=selective|full|dots]`` (default selective) turns on activation
+recompute in the blocks (fleet/recompute.py policy layer) and SPENDS the freed
+residual memory on a larger per-chip microbatch (``--batch=N`` to override).
+``BENCH_TINY=1`` shrinks the model/iterations to a seconds-scale smoke config
+(CI exercises the CLI contract without a TPU).
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -31,24 +38,49 @@ REF_TOKENS_PER_SEC = 33064.0
 REF_DECODE_TOKENS_PER_SEC = None
 
 
-def main():
+def _cli_flag(argv, name):
+    """--name -> "", --name=value -> "value", absent -> None."""
+    for a in argv:
+        if a == f"--{name}":
+            return ""
+        if a.startswith(f"--{name}="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def main(argv=()):
     import jax
     # persistent compile cache: XLA compiles through the tunnel are slow (~2min);
-    # cache hits across bench runs/rounds cut warmup to seconds
-    jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_bench")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    # cache hits across bench runs/rounds cut warmup to seconds. NOT under
+    # BENCH_TINY: the CPU smoke path must never touch the persistent cache
+    # (cache-restored CPU executables are corrupt on this jaxlib — see
+    # tests/conftest.py)
+    if not os.environ.get("BENCH_TINY"):
+        jax.config.update("jax_compilation_cache_dir",
+                          "/root/.cache/jax_bench")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    recompute = _cli_flag(argv, "recompute")
+    if recompute == "":
+        recompute = "selective"   # bare --recompute: the Megatron-style default
+    elif recompute == "none":
+        recompute = None          # explicit off: the true B=16 control run
+    tiny = bool(os.environ.get("BENCH_TINY"))
 
     paddle.seed(0)
     # GPT-medium-ish: fits one chip with Adam states; representative MXU shapes.
     # head_dim 128 (8 heads), the TPU-native choice: the MXU contracts 128-wide,
     # so d=64 heads run the attention dots at half rate and pad every kernel
     # operand to 128 lanes (device-profiled: d=128 is ~1.2x whole-step).
-    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
-                    num_heads=8, max_position_embeddings=1024,
-                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    size = (dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                 max_position_embeddings=128) if tiny else
+            dict(vocab_size=50304, hidden_size=1024, num_layers=16,
+                 num_heads=8, max_position_embeddings=1024))
+    cfg = GPTConfig(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    recompute_granularity=recompute or "none", **size)
     model = GPTForCausalLM(cfg)
 
     # AMP-O2 analog: bf16 activations/matmuls (params stay fp32 in the optimizer)
@@ -58,7 +90,15 @@ def main():
                                  parameters=model.parameters(),
                                  multi_precision=True)
 
-    batch, seq = 16, 1024   # B=16 profiled fastest (B=24 hits logits-remat pressure)
+    # B=16 profiled fastest at no-remat (B=24 hits logits-remat pressure);
+    # with recompute on, the freed block residuals are spent on a larger
+    # microbatch — that is the whole point of the knob
+    batch, seq = (24 if recompute else 16), 1024
+    if tiny:
+        batch, seq = 2, 128
+    b_over = _cli_flag(argv, "batch")
+    if b_over:
+        batch = int(b_over)
     ids_np = np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq))
     ids = paddle.to_tensor(ids_np.astype("int32"))
 
@@ -96,6 +136,8 @@ def main():
             "vs_baseline": round(tokens_per_sec / REF_TOKENS_PER_SEC, 3),
             "model_tflops": round(model_tflops, 1),
             "mfu": mfu,
+            "recompute": recompute or None,
+            "batch": batch,
             "device_kind": kind,
             "window": window,
         }))
@@ -103,7 +145,7 @@ def main():
 
     # measure in short windows, print the best-so-far after each one: the
     # driver's timeout can land anywhere and the tail line still parses
-    iters, windows = 5, 6
+    iters, windows = (1, 2) if tiny else (5, 6)
     best = 0.0
     for w in range(windows):
         t0 = time.time()
@@ -126,8 +168,12 @@ def main_decode():
     nonzero value means the zero-recompile contract broke and the tokens/s
     number is compile-bound garbage."""
     import jax
-    jax.config.update("jax_compilation_cache_dir", "/root/.cache/jax_bench")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    # same BENCH_TINY guard as main(): the persistent cache corrupts
+    # restored CPU executables on this jaxlib (tests/conftest.py)
+    if not os.environ.get("BENCH_TINY"):
+        jax.config.update("jax_compilation_cache_dir",
+                          "/root/.cache/jax_bench")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
@@ -186,4 +232,5 @@ def main_decode():
 
 
 if __name__ == "__main__":
-    sys.exit(main_decode() if "decode" in sys.argv[1:] else main())
+    sys.exit(main_decode() if "decode" in sys.argv[1:]
+             else main(sys.argv[1:]))

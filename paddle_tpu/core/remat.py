@@ -1,0 +1,150 @@
+"""Named-activation checkpoint plumbing — the substrate of the recompute
+policy layer (paddle_tpu.distributed.fleet.recompute).
+
+Two jobs live here (and only here, so models/kernels never import fleet):
+
+* **checkpoint names** — ``tag_activation(x, name)`` marks a tensor with
+  ``jax.ad_checkpoint.checkpoint_name`` so names-based rematerialization
+  policies can address it. The canonical name set below is what the
+  ``"selective"`` policy saves: the cheap linear residuals of a transformer
+  block (qkv projection, attention context, attention output, first MLP
+  matmul). Everything UNNAMED inside a checkpointed block — in particular
+  every [B, H, S, S] tensor of the attention score/softmax region — is
+  dropped and recomputed in backward. That is Megatron-style selective
+  recomputation: most of full checkpointing's memory back for a few percent
+  recompute FLOPs (one qk^T matmul + softmax per block).
+
+* **trace stats** — tagging sites and checkpoint regions record what they
+  did during a trace (region count, policy, named-activation bytes), so
+  TrainStep can emit ``remat/*`` gauges per compiled executable and
+  ``tools/metrics_summary.py`` can flag the lost-checkpoint signature
+  (recompute requested but zero regions / zero named bytes). Recording is
+  trace-time only — zero cost per executed step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.ad_checkpoint import checkpoint_name
+
+__all__ = ["ATTN_QKV", "ATTN_CONTEXT", "ATTN_OUT", "MLP_HIDDEN",
+           "SELECTIVE_SAVE_NAMES", "POLICY_NAMES", "resolve_policy",
+           "normalize_granularity", "tag_activation", "tag_array",
+           "reset_trace_stats", "trace_stats", "note_region"]
+
+# ---------------------------------------------------------- canonical names
+
+ATTN_QKV = "attn_qkv"           # fused qkv (or per-tensor q/k/v) projection out
+ATTN_CONTEXT = "attn_context"   # softmax(qk^T)·V context, pre out-projection
+ATTN_OUT = "attn_out"           # attention output projection
+MLP_HIDDEN = "mlp_hidden"       # first MLP matmul output (pre-activation)
+
+# what "selective" keeps: the linear residuals. The attention score/softmax
+# region (every S^2-sized intermediate) stays unnamed on purpose — it is the
+# memory being spent back.
+SELECTIVE_SAVE_NAMES = (ATTN_QKV, ATTN_CONTEXT, ATTN_OUT, MLP_HIDDEN)
+
+POLICY_NAMES = ("none", "full", "dots", "selective")
+
+
+def resolve_policy(policy):
+    """Map a policy spec to a ``jax.checkpoint`` rematerialization policy.
+
+    * ``"full"``/``True``/``None`` -> None (plain ``jax.checkpoint``: save
+      nothing but the region inputs — today's ``remat="full"`` behavior);
+    * ``"dots"`` -> ``dots_with_no_batch_dims_saveable`` (keep matmul
+      outputs, recompute elementwise chains);
+    * ``"selective"`` -> ``save_only_these_names(*SELECTIVE_SAVE_NAMES)``
+      (keep the named cheap linear residuals, recompute the attention
+      score/softmax region);
+    * a callable passes through (any jax.checkpoint_policies member or a
+      custom ``(prim, *args, **params) -> bool``).
+    """
+    if policy is None or policy is True or policy == "full":
+        return None
+    if callable(policy):
+        return policy
+    if policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if policy == "selective":
+        return jax.checkpoint_policies.save_only_these_names(
+            *SELECTIVE_SAVE_NAMES)
+    raise ValueError(
+        f"unknown recompute policy {policy!r}; expected one of "
+        f"{POLICY_NAMES[1:]} or a jax.checkpoint_policies callable")
+
+
+def normalize_granularity(granularity, interval=1):
+    """ONE definition of the user-facing granularity surface (model configs
+    and every enable_recompute share it): maps True -> "full",
+    None/False -> "none", validates against POLICY_NAMES, clamps interval.
+    Returns ``(granularity, interval)``."""
+    if granularity in (None, False):
+        granularity = "none"
+    elif granularity is True:
+        granularity = "full"
+    if granularity not in POLICY_NAMES:
+        raise ValueError(f"recompute granularity {granularity!r} not in "
+                         f"{POLICY_NAMES}")
+    return granularity, max(int(interval), 1)
+
+
+# ------------------------------------------------------------- trace stats
+# Reset by TrainStep before tracing/lowering, read after: what did the trace
+# checkpoint, and how many bytes of named activations did it see? Purely
+# trace-time bookkeeping (tags fire once per trace, not per step).
+
+_stats = {"regions": 0, "policy": None, "named_bytes": {}}
+
+
+def reset_trace_stats():
+    _stats["regions"] = 0
+    _stats["policy"] = None
+    _stats["named_bytes"] = {}
+
+
+def trace_stats() -> dict:
+    """Snapshot: {"regions", "policy", "named_bytes": {name: bytes},
+    "total_named_bytes"}."""
+    nb = dict(_stats["named_bytes"])
+    return {"regions": _stats["regions"], "policy": _stats["policy"],
+            "named_bytes": nb, "total_named_bytes": sum(nb.values())}
+
+
+def note_region(policy) -> None:
+    """A checkpoint region was applied during the current trace."""
+    _stats["regions"] += 1
+    if policy is not None or _stats["policy"] is None:
+        _stats["policy"] = policy if isinstance(policy, str) else \
+            ("full" if policy is None else getattr(policy, "__name__",
+                                                  str(policy)))
+
+
+def tag_array(x, name: str):
+    """checkpoint_name on a raw jax array (identity outside jax.checkpoint).
+
+    Bytes are recorded into the trace stats only under an active to_static/
+    TrainStep trace — eager per-op executions between a reset and a gauge
+    emit must not inflate ``remat/saved_name_bytes``. The figure is a
+    per-trace estimate: the scan path records one layer's names (the body
+    traces once), the discrete-block path records every layer's."""
+    from . import dispatch
+    if dispatch.in_trace():
+        try:
+            nb = int(x.size) * int(x.dtype.itemsize)
+            _stats["named_bytes"][name] = \
+                _stats["named_bytes"].get(name, 0) + nb
+        except Exception:
+            pass
+    return checkpoint_name(x, name)
+
+
+def tag_activation(t, name: str):
+    """Tag a framework Tensor's value under an active trace (no-op in plain
+    eager execution, where there is no jaxpr for the name to live in)."""
+    from . import dispatch
+    if not dispatch.in_trace():
+        return t
+    t._data = tag_array(t._data, name)
+    return t

@@ -16,7 +16,7 @@ from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from . import auto  # noqa: F401  (fleet.auto: planner + auto-parallel Engine)
 from .meta_optimizers import HybridParallelOptimizer, DygraphShardingOptimizer
-from .recompute import recompute  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
 
 _fleet_state = {"strategy": None, "initialized": False}
 
@@ -80,6 +80,22 @@ def distributed_model(model):
             strategy.amp_configs.get("use_pure_fp16", False):
         from ...amp import decorate
         model = decorate(models=model, level="O2")
+    if strategy is not None and getattr(strategy, "recompute", False):
+        # recompute strategy -> model config (reference recompute pass over
+        # checkpoints; here the model wraps its own blocks through
+        # fleet.recompute with the configured policy)
+        cfg = strategy.recompute_configs or {}
+        fn = getattr(model, "enable_recompute", None)
+        if fn is not None:
+            fn(cfg.get("granularity", "full"),
+               interval=int(cfg.get("interval", 1)))
+        else:
+            import warnings
+            warnings.warn(
+                "DistributedStrategy.recompute is on but the model exposes "
+                "no enable_recompute(granularity, interval); wrap block "
+                "forwards in fleet.recompute(...) manually or the memory "
+                "saving will silently not happen", RuntimeWarning)
     hcg = get_hcg()
     if hcg is None:
         init()

@@ -9,6 +9,25 @@ backward re-executes the function eagerly (RNG state restored) and backpropagate
 through the recomputed subgraph via autograd.grad — parameter grads accumulate as a
 side effect exactly like the reference's inner backward. Under a to_static trace,
 jax.checkpoint is the whole story and we simply mark the region.
+
+Rematerialization POLICIES (``policy=`` kwarg, compiled path):
+
+* ``"full"`` (default) — plain ``jax.checkpoint``: only the region inputs
+  survive; everything recomputes in backward. Maximum memory back, ~33%
+  extra FLOPs (a second forward).
+* ``"dots"`` — ``dots_with_no_batch_dims_saveable``: matmul outputs stay,
+  elementwise chains recompute. Cheap recompute, moderate memory.
+* ``"selective"`` — ``save_only_these_names`` over the canonical activation
+  names (``core.remat.SELECTIVE_SAVE_NAMES``: qkv projection, attention
+  context, attention output, first MLP matmul). The UNNAMED attention
+  score/softmax region — every [B, H, S, S] tensor — is dropped and
+  recomputed: Megatron-style selective recomputation, most of full
+  checkpointing's memory for a few percent recompute FLOPs.
+* any ``jax.checkpoint_policies`` callable passes through.
+
+The eager tape path accepts ``policy`` for API uniformity but always
+recomputes the whole region (the PyLayer form saves only inputs + RNG state
+by construction — there is no residual store to be selective about).
 """
 from __future__ import annotations
 
@@ -16,6 +35,7 @@ from typing import Any
 
 from ...core import dispatch
 from ...core import random as rnd
+from ...core import remat as _remat
 from ...core.autograd import GradNode, run_backward
 from ...core.tensor import Tensor
 
@@ -31,7 +51,7 @@ def _flatten_tensors(obj, out):
             _flatten_tensors(o, out)
 
 
-def _recompute_traced(function, args, kwargs):
+def _recompute_traced(function, args, kwargs, policy=None):
     """jax.checkpoint over the region inside an active trace.
 
     The function's INPUT tensors become the checkpoint arguments (their
@@ -73,7 +93,9 @@ def _recompute_traced(function, args, kwargs):
             for t, d in zip(in_tensors, saved):
                 t._data = d
 
-    out_arrays = jax.checkpoint(pure)(arrays)
+    jax_policy = _remat.resolve_policy(policy)
+    _remat.note_region(policy if isinstance(policy, str) else jax_policy)
+    out_arrays = jax.checkpoint(pure, policy=jax_policy)(arrays)
     n_out = out_struct["n_out"]
     # re-emit the region's buffer updates into the OUTER trace so TrainStep /
     # to_static thread them as program state (post-checkpoint values)
@@ -101,14 +123,16 @@ def _recompute_traced(function, args, kwargs):
 
 
 def recompute(function, *args, preserve_rng_state: bool = True,
-              use_reentrant: bool = True, **kwargs) -> Any:
-    """paddle.distributed.fleet.utils.recompute parity."""
+              use_reentrant: bool = True, policy="full", **kwargs) -> Any:
+    """paddle.distributed.fleet.utils.recompute parity, plus ``policy=``
+    (see module docstring: "full" | "dots" | "selective" | jax policy)."""
+    _remat.resolve_policy(policy)  # validate up front, both paths
     if dispatch.in_trace():
         # under jit/TrainStep tracing, apply jax.checkpoint so the compiled
         # program actually drops this region's residuals and recomputes them
         # in backward (a pass-through here would silently lose the memory
         # saving the user asked for)
-        return _recompute_traced(function, args, kwargs)
+        return _recompute_traced(function, args, kwargs, policy)
     if not dispatch.is_grad_enabled():
         return function(*args, **kwargs)  # nothing to save anyway
 
@@ -179,3 +203,37 @@ def recompute(function, *args, preserve_rng_state: bool = True,
         o._grad_node = node
         o._out_index = i
     return outs
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute_sequential parity: run a
+    LayerList/Sequential in ``segments`` chunks, each chunk under
+    :func:`recompute`. ``ctx`` keys: ``segments`` (default 1),
+    ``preserve_rng_state``, ``policy`` (the rematerialization policy each
+    segment compiles with — see :func:`recompute`)."""
+    ctx = ctx or {}
+    segments = max(int(ctx.get("segments", 1)), 1)
+    preserve = bool(ctx.get("preserve_rng_state", True))
+    policy = ctx.get("policy", "full")
+    layers = list(functions)
+    if not layers:
+        raise ValueError("recompute_sequential: empty function list")
+    per = max((len(layers) + segments - 1) // segments, 1)
+
+    def run_chunk(chunk, *xs):
+        out = chunk[0](*xs, **kwargs)
+        for fn in chunk[1:]:
+            out = fn(out, **kwargs) if not isinstance(out, (list, tuple)) \
+                else fn(*out, **kwargs)
+        return out
+
+    out = args
+    for s in range(0, len(layers), per):
+        chunk = layers[s:s + per]
+        # list and tuple outputs both unpack at segment boundaries, matching
+        # run_chunk's in-segment behavior (a list-returning layer must not
+        # change arity only when it lands on a chunk edge)
+        xs = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        out = recompute(lambda *a, _c=chunk: run_chunk(_c, *a), *xs,
+                        preserve_rng_state=preserve, policy=policy)
+    return out

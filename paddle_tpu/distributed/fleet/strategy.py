@@ -16,7 +16,13 @@ class DistributedStrategy:
         self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
                             "use_fp16_guard": True}
         self.recompute = False
-        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        # granularity: "full" | "selective" | "dots" (fleet/recompute.py
+        # policy layer; selective = Megatron-style, drop only the attention
+        # score/softmax region); interval: checkpoint every Nth block.
+        # distributed_model() applies these to models exposing
+        # enable_recompute (GPT/LLaMA).
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False,
+                                  "granularity": "full", "interval": 1}
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.sharding = False

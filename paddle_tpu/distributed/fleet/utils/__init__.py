@@ -1,4 +1,5 @@
 """fleet.utils namespace (reference: python/paddle/distributed/fleet/utils)."""
 from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+from ..recompute import recompute, recompute_sequential  # noqa: F401
 
-__all__ = ["FS", "LocalFS", "HDFSClient"]
+__all__ = ["FS", "LocalFS", "HDFSClient", "recompute", "recompute_sequential"]

@@ -39,6 +39,26 @@ class _LossNet(Layer):
         outs = self.net(*args[:split])
         return self._loss_fn(outs, *args[split:])
 
+    # forward the recompute surface so TrainStep's remat/* observability
+    # (and the PADDLE_REMAT_BASELINE twin) sees through the wrapper —
+    # Layer.__getattr__ only resolves params/sublayers/buffers, so without
+    # these the hapi path would silently report remat/requested=0
+    def enable_recompute(self, granularity="selective", interval: int = 1):
+        fn = getattr(self.net, "enable_recompute", None)
+        if fn is None:
+            raise AttributeError(
+                f"{type(self.net).__name__} exposes no enable_recompute")
+        fn(granularity, interval=interval)
+        return self
+
+    @property
+    def _recompute_wanted(self) -> bool:
+        return bool(getattr(self.net, "_recompute_wanted", False))
+
+    @property
+    def config(self):
+        return getattr(self.net, "config", None)
+
 
 def _as_batch_tensors(data):
     """DataLoader batch -> (inputs, labels) tensor lists."""
@@ -94,7 +114,7 @@ class Model:
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
                 jit_compile: bool = False, accumulate_steps: int = 1,
-                grad_scaler=None, grad_bucket_bytes=None):
+                grad_scaler=None, grad_bucket_bytes=None, recompute=None):
         """``accumulate_steps=K`` (K>1) trains through the compiled
         accumulation path: one ``jit.TrainStep`` executable consumes K
         stacked microbatches, runs forward/backward K times and applies ONE
@@ -110,7 +130,15 @@ class Model:
         ``distributed.group_sharded_parallel``), fuse per-microbatch grad
         reduce-scatters smaller than this into flat buckets inside the
         compiled accumulation scan (None = the optimizer wrapper's setting,
-        0 = one collective per parameter)."""
+        0 = one collective per parameter).
+
+        ``recompute``: activation-recompute policy applied to the network
+        (``fleet/recompute.py`` layer): ``"selective"`` | ``"full"`` |
+        ``"dots"`` | ``True`` (= "full") | ``"none"``/``False`` (off), or a
+        dict ``{"granularity": ..., "interval": N}`` to checkpoint every Nth
+        block. Requires the network to expose ``enable_recompute`` (GPT and
+        LLaMA do); raises otherwise — silently ignoring it would train
+        without the memory saving the caller sized their batch for."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -142,6 +170,26 @@ class Model:
         self._jit_compile = jit_compile
         self._train_step = None
         self._pending_microbatches = []
+        if recompute is not None:
+            if isinstance(recompute, dict):
+                gran = recompute.get("granularity", "full")
+                interval = int(recompute.get("interval", 1))
+            else:
+                gran, interval = recompute, 1
+            fn = getattr(self.network, "enable_recompute", None)
+            off = gran in (False, "none")
+            if fn is None:
+                # turning recompute OFF on a network without the hook is a
+                # no-op, not an error — only a requested SAVING that cannot
+                # be delivered fails loudly
+                if not off:
+                    raise ValueError(
+                        "prepare(recompute=...) needs a network exposing "
+                        "enable_recompute(granularity, interval) (GPT/LLaMA "
+                        "do); wrap block forwards in fleet.recompute(...) "
+                        "manually for custom architectures")
+            else:
+                fn(gran, interval=interval)
         return self
 
     # -------------------------------------------------------------- batches

@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .. import monitor as _monitor
 from ..core import dispatch
 from ..core import random as _random
+from ..core import remat as _remat
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer import Layer
 from ..profiler import _recorder as _prof_recorder, record_stage
@@ -709,6 +710,8 @@ class TrainStep:
         param_arrays, masters, states, buffer_arrays, scalars = \
             self._gather_args()
 
+        if mon is not None:
+            _remat.reset_trace_stats()  # a cache miss traces inside the call
         t0 = time.perf_counter() if mon is not None else 0.0
         loss_out, new_params, new_masters, new_states, new_buffers = \
             self._compiled(param_arrays, masters, states, buffer_arrays,
@@ -723,6 +726,7 @@ class TrainStep:
                 if self._acc_steps > 1:
                     mon.accum_config(self._acc_steps, self._grad_acc_bytes())
                 self._emit_shard_gauges(mon)
+                self._emit_remat_gauges(mon)
             else:
                 # steady-state dispatch latency; a cache-miss call is compile
                 # time, not dispatch, and is already covered by the recompile
@@ -820,6 +824,65 @@ class TrainStep:
                              else 0),
             buckets=plan.num_buckets if plan is not None else 0)
 
+    def _emit_remat_gauges(self, mon, compiled=None, baseline_args=None):
+        """remat/* gauges: what the trace actually checkpointed vs what the
+        model declared. ``remat/requested`` with ``remat/regions == 0`` is
+        the lost-checkpoint signature (recompute configured but nothing
+        routed through fleet.recompute / the scan remat) —
+        tools/metrics_summary.py WARNs on it, like the ZeRO lost-constraint
+        check. With env ``PADDLE_REMAT_BASELINE=1`` a no-remat twin of the
+        executable is also compiled (one extra compile per bucket) so the
+        gauges carry the MEASURED saved-residual bytes from
+        ``compiled.memory_analysis()``, not an estimate. The twin only
+        exists on the AOT path (callers pass ``compiled``/``baseline_args``
+        from _build_fast), where per-step dispatch never touches the jit
+        trace cache — so the clear_cache bracketing below cannot cost the
+        slow path a recompile."""
+        import os
+        wanted = bool(getattr(self._model, "_recompute_wanted", False))
+        stats = _remat.trace_stats()
+        if not wanted and stats["regions"] == 0:
+            return
+        base_total = saved = None
+        if (compiled is not None and baseline_args is not None
+                and os.environ.get("PADDLE_REMAT_BASELINE")
+                and hasattr(self._model, "enable_recompute")):
+            from ..monitor.memory import executable_memory_stats
+            cfg = getattr(self._model, "config", None)
+            gran = getattr(cfg, "recompute_granularity", None)
+            interval = getattr(cfg, "recompute_interval", 1)
+            if gran and gran != "none":
+                base = None
+                try:
+                    self._model.enable_recompute("none")
+                    args, input_arrays = baseline_args
+                    # the jit trace cache keys on avals only — without the
+                    # clear, lower() would reuse the WITH-remat jaxpr and
+                    # the "baseline" would measure the same executable
+                    self._compiled.clear_cache()
+                    base = self._compiled.lower(*args, input_arrays).compile()
+                except Exception as e:
+                    # diagnostics-only: a twin that fails to compile must
+                    # never take down the training step it was measuring
+                    import warnings
+                    warnings.warn(f"PADDLE_REMAT_BASELINE twin compile "
+                                  f"failed ({type(e).__name__}: {e}); "
+                                  f"emitting remat gauges without the "
+                                  f"measured baseline", RuntimeWarning)
+                finally:
+                    self._model.enable_recompute(gran, interval)
+                    self._compiled.clear_cache()
+                bs = executable_memory_stats(base) if base is not None \
+                    else None
+                ws = executable_memory_stats(compiled)
+                if bs is not None and ws is not None:
+                    base_total = bs["total_bytes"]
+                    saved = bs["total_bytes"] - ws["total_bytes"]
+        mon.remat_compiled(wanted, stats["regions"], stats["policy"],
+                           stats["total_named_bytes"], stats["named_bytes"],
+                           baseline_total_bytes=base_total,
+                           saved_residual_bytes=saved)
+
     def _finish_loss(self, loss_out):
         """Unpack the step's loss output; with a compiled-in scaler, replay
         the eager GradScaler state machine on the device found-inf flag."""
@@ -903,6 +966,7 @@ class TrainStep:
         else:
             args = self._gather_args()
         t_c = time.perf_counter()
+        _remat.reset_trace_stats()
         exe = self._compiled.lower(*args, input_arrays).compile()
         compile_s = time.perf_counter() - t_c
         sig = self._input_sig(input_arrays)
@@ -917,6 +981,8 @@ class TrainStep:
             if self._acc_steps > 1:
                 mon.accum_config(self._acc_steps, self._grad_acc_bytes())
             self._emit_shard_gauges(mon)
+            self._emit_remat_gauges(mon, compiled=exe,
+                                    baseline_args=(args, input_arrays))
         if self._fast_meta is None:
             opt = self._opt
             self._fast_meta = [

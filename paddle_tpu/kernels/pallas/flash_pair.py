@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -58,7 +59,23 @@ def _heads_per_block(head_dim: int) -> int:
 _MAX_FUSED_BWD_LANE_BUDGET = 4096 * 128
 
 
-def _max_fused_bwd(hpb: int, d: int) -> int:
+def _max_fused_bwd(hpb: int, d: int, override=None) -> int:
+    """Fused-bwd kv_pad cutoff. The heuristic (lane budget / lane width)
+    loses to reality on chips with other VMEM headroom — override with the
+    ``max_fused_bwd=`` kwarg (flash_pair_packed) or env
+    ``PADDLE_FLASH_FUSED_BWD_MAX=<kv_pad>`` (0 forces the split form).
+    The env fallback here runs when a backward first TRACES a static
+    signature; like anything read into a compiled program, a mid-process
+    env change only affects new signatures (flash_pair_packed resolves the
+    env at the call site instead, so its callers re-trace on change —
+    direct flash_pair callers wanting a per-call value must pass the
+    kwarg)."""
+    if override is None:
+        env = os.environ.get("PADDLE_FLASH_FUSED_BWD_MAX")
+        if env:
+            override = int(env)
+    if override is not None:
+        return int(override)
     return _MAX_FUSED_BWD_LANE_BUDGET // (hpb * d)
 
 
@@ -419,9 +436,10 @@ def _pair_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 @functools.partial(jax.jit, static_argnames=("heads", "d", "causal",
                                              "sm_scale", "block_q",
-                                             "dropout_rate", "interpret"))
+                                             "dropout_rate", "interpret",
+                                             "max_fused_bwd"))
 def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
-              dropout_rate=0.0, interpret=False):
+              dropout_rate=0.0, interpret=False, max_fused_bwd=None):
     b, L, width = qkv.shape
     hpb = _heads_per_block(d)
     h2 = heads // hpb
@@ -450,7 +468,7 @@ def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
                   block_q=block_q, block_k=block_k,
                   dropout_rate=dropout_rate, n_heads=heads, hpb=hpb)
 
-    if kv_pad <= _max_fused_bwd(hpb, d):
+    if kv_pad <= _max_fused_bwd(hpb, d, max_fused_bwd):
         # FUSED: s/p once per tile for all three grads
         gpart = pl.BlockSpec((None, kv_pad, hpb * d),
                              lambda bb, hh, i, j, *_: (bb, 0, hh))
@@ -532,26 +550,27 @@ def _pair_bwd(qkv, o, lse, g, seed, heads, d, causal, sm_scale, block_q,
 # ------------------------------------------------------------------ custom_vjp
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
 def flash_pair(qkv, seed, heads, d, causal, sm_scale, block_q, dropout_rate,
-               interpret):
+               interpret, max_fused_bwd=None):
     out, _ = _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
                        dropout_rate, interpret)
     return out
 
 
 def _pair_vjp_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
-                  dropout_rate, interpret):
+                  dropout_rate, interpret, max_fused_bwd=None):
     out, lse = _pair_fwd(qkv, seed, heads, d, causal, sm_scale, block_q,
                          dropout_rate, interpret)
     return out, (qkv, out, lse, seed)
 
 
 def _pair_vjp_bwd(heads, d, causal, sm_scale, block_q, dropout_rate,
-                  interpret, res, g):
+                  interpret, max_fused_bwd, res, g):
     qkv, out, lse, seed = res
     dqkv = _pair_bwd(qkv, out, lse, g, seed, heads, d, causal, sm_scale,
-                     block_q, dropout_rate, interpret)
+                     block_q, dropout_rate, interpret,
+                     max_fused_bwd=max_fused_bwd)
     return dqkv, None
 
 
@@ -559,9 +578,11 @@ flash_pair.defvjp(_pair_vjp_fwd, _pair_vjp_bwd)
 
 
 def flash_pair_packed(qkv, num_heads, causal, dropout_rate=0.0, seed=0,
-                      block_q=512, interpret=False):
+                      block_q=512, interpret=False, max_fused_bwd=None):
     """Keyword front door for the pair path: derives head_dim/scale/seed form
-    so call sites don't hand-assemble the 9-positional custom_vjp call."""
+    so call sites don't hand-assemble the positional custom_vjp call.
+    ``max_fused_bwd`` overrides the fused-backward kv_pad cutoff (see
+    _max_fused_bwd; env PADDLE_FLASH_FUSED_BWD_MAX works everywhere)."""
     d = qkv.shape[-1] // (3 * num_heads)
     if not pair_layout_supported(d, num_heads, qkv.shape[1]):
         # fail fast: a truncating heads // hpb would leave trailing heads'
@@ -571,7 +592,16 @@ def flash_pair_packed(qkv, num_heads, causal, dropout_rate=0.0, seed=0,
             f"num_heads={num_heads}); requires "
             f"num_heads % max(1, 128 // head_dim) == 0 and hpb*d % 128 == 0 "
             f"— use flash_attention_blhd/packed instead")
+    if max_fused_bwd is None:
+        # resolve the env HERE, outside any jit: max_fused_bwd is a static
+        # argname of the jitted _pair_bwd, so an env read at trace time
+        # would be frozen into the cached executable — resolving at the
+        # front door makes a changed env a new static value (fresh trace)
+        env = os.environ.get("PADDLE_FLASH_FUSED_BWD_MAX")
+        if env:
+            max_fused_bwd = int(env)
     seed_arr = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
     return flash_pair(qkv, seed_arr, int(num_heads), int(d), bool(causal),
                       1.0 / math.sqrt(d), int(block_q), float(dropout_rate),
-                      bool(interpret))
+                      bool(interpret),
+                      None if max_fused_bwd is None else int(max_fused_bwd))

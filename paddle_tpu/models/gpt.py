@@ -24,6 +24,9 @@ from .. import nn
 from ..nn import functional as F
 from .. import ops
 from ..core.dispatch import register_op
+from ..core.remat import (ATTN_CONTEXT, ATTN_OUT, ATTN_QKV, MLP_HIDDEN,
+                          normalize_granularity, note_region, resolve_policy,
+                          tag_activation, tag_array)
 from ..core.tensor import Tensor
 from ..ops._helpers import _op
 
@@ -92,7 +95,7 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
         (l1w_, l1b_, qw_, qb_, pw_, pb_, l2w_, l2b_, f1w_, f1b_, f2w_, f2b_,
          kd) = per
         y = ln(carry, l1w_, l1b_)
-        qkv = y @ qw_ + qb_                      # [B,S,3H]
+        qkv = tag_array(y @ qw_ + qb_, ATTN_QKV)     # [B,S,3H]
         from ..kernels.pallas.flash_attention import (
             flash_attention_blhd, flash_attention_qkv_packed,
             packed_layout_supported)
@@ -101,21 +104,23 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
         if use_flash and pair_layout_supported(hd, num_heads, s):
             # single-tile head-block kernels: zero relayouts + fused
             # single-pass dqkv backward (kernels/pallas/flash_pair.py)
-            att = flash_pair_packed(qkv, num_heads, True,
-                                    dropout_rate=attn_dropout,
-                                    seed=kd[0].astype(jnp.int32))
+            att = tag_array(flash_pair_packed(qkv, num_heads, True,
+                                              dropout_rate=attn_dropout,
+                                              seed=kd[0].astype(jnp.int32)),
+                            ATTN_CONTEXT)
         elif use_flash and packed_layout_supported(hd):
             # fused-projection kernel for longer sequences: no head
             # split/merge inside the scan
-            att = flash_attention_qkv_packed(
+            att = tag_array(flash_attention_qkv_packed(
                 qkv, num_heads, causal=True, dropout_rate=attn_dropout,
-                seed=kd[0].astype(jnp.int32))
+                seed=kd[0].astype(jnp.int32)), ATTN_CONTEXT)
         elif use_flash:
             q, k, v = (t.reshape(b, s, num_heads, hd)
                        for t in jnp.split(qkv, 3, axis=-1))
-            att = flash_attention_blhd(q, k, v, causal=True,
-                                       dropout_rate=attn_dropout,
-                                       seed=kd[0].astype(jnp.int32))
+            att = tag_array(flash_attention_blhd(q, k, v, causal=True,
+                                                 dropout_rate=attn_dropout,
+                                                 seed=kd[0].astype(jnp.int32)),
+                            ATTN_CONTEXT)
         else:
             q, k, v = (t.reshape(b, s, num_heads, hd)
                        for t in jnp.split(qkv, 3, axis=-1))
@@ -129,18 +134,23 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
                 k0 = jax.random.fold_in(jax.random.wrap_key_data(kd), 0)
                 keep = jax.random.bernoulli(k0, 1.0 - attn_dropout, probs.shape)
                 probs = probs * keep.astype(probs.dtype) / (1.0 - attn_dropout)
-            att = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vt), 1, 2)
-        att = att.reshape(b, s, h) @ pw_ + pb_
+            att = tag_array(
+                jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vt), 1, 2),
+                ATTN_CONTEXT)
+        att = tag_array(att.reshape(b, s, h) @ pw_ + pb_, ATTN_OUT)
         carry = carry + drop(att, kd, 1)
         y = ln(carry, l2w_, l2b_)
-        y = jax.nn.gelu(y @ f1w_ + f1b_, approximate=True) @ f2w_ + f2b_
+        y = jax.nn.gelu(tag_array(y @ f1w_ + f1b_, MLP_HIDDEN),
+                        approximate=True) @ f2w_ + f2b_
         return carry + drop(y, kd, 2), None
 
-    if remat == "full":
-        body = jax.checkpoint(body)
-    elif remat == "dots":
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if remat != "none":
+        # "full" | "dots" | "selective" on the scan BODY: one jax.checkpoint
+        # over the per-layer step, so the scan carries only what the policy
+        # saves per layer (selective: the named linear residuals; the
+        # unnamed [B,H,S,S] score/softmax region rematerializes in backward)
+        note_region(remat)
+        body = jax.checkpoint(body, policy=resolve_policy(remat))
     out, _ = jax.lax.scan(body, x, (l1w, l1b, qw, qb, pw, pb, l2w, l2b,
                                     f1w, f1b, f2w, f2b, keys))
     return out
@@ -164,11 +174,22 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     use_flash_attention: bool = True
     scan_layers: bool = False          # fold blocks into one lax.scan (fast compile)
-    remat: str = "none"                # "none" | "dots" | "full" checkpoint policy
+    remat: str = "none"                # legacy alias of recompute_granularity
+    # activation recompute (fleet/recompute.py policy layer):
+    # "none" | "selective" | "dots" | "full"; interval=N checkpoints every
+    # Nth block (discrete-block path; the scan path folds the policy into
+    # its single body and ignores interval)
+    recompute_granularity: str = "none"
+    recompute_interval: int = 1
 
     def __post_init__(self):
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
+        if self.recompute_granularity == "none" and self.remat != "none":
+            self.recompute_granularity = self.remat   # legacy remat= spelling
+        self.recompute_granularity, self.recompute_interval = \
+            normalize_granularity(self.recompute_granularity,
+                                  self.recompute_interval)
 
 
 def gpt3_1p3b(**overrides) -> "GPTConfig":
@@ -211,12 +232,13 @@ class GPTAttention(nn.Layer):
                 and flash_path_available(s, self.head_dim, x)):
             # packed path: the fused projection feeds the kernel directly and
             # the context comes back [b, s, h] — no head split/merge relayout
-            qkv = self.qkv_proj(x)
+            qkv = tag_activation(self.qkv_proj(x), ATTN_QKV)
             out = F.flash_attention_qkv_packed(qkv, self.num_heads,
                                                dropout=drop, causal=True,
                                                training=self.training)
-            return self.out_proj(out)
-        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+            return tag_activation(self.out_proj(out), ATTN_OUT)
+        qkv = tag_activation(self.qkv_proj(x), ATTN_QKV) \
+            .reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(2)          # each [b, s, heads, head_dim]
         if self.use_flash and attn_mask is None:
             # Pallas flash kernel on real TPUs (auto-detected, in-kernel
@@ -229,7 +251,7 @@ class GPTAttention(nn.Layer):
                 q, k, v, attn_mask=attn_mask, dropout_p=drop, training=self.training,
                 is_causal=True)
         out = out.reshape([b, s, h])
-        return self.out_proj(out)
+        return tag_activation(self.out_proj(out), ATTN_OUT)
 
     def _forward_cached(self, x, kv_cache):
         """KV-cache attention (serving): write this chunk's K/V into the
@@ -278,7 +300,8 @@ class GPTMLP(nn.Layer):
         self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size)
 
     def forward(self, x):
-        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+        return self.fc_out(F.gelu(tag_activation(self.fc_in(x), MLP_HIDDEN),
+                                  approximate=True))
 
 
 class GPTBlock(nn.Layer):
@@ -319,7 +342,7 @@ class GPTScannedBlocks(nn.Layer):
         self.attn_dropout = config.attention_dropout_prob
         self.eps = config.layer_norm_epsilon
         self.use_flash = config.use_flash_attention
-        self.remat = config.remat
+        self.remat = config.recompute_granularity
         std = config.initializer_range
         normal = nn.initializer.Normal(mean=0.0, std=std)
         resid = nn.initializer.Normal(mean=0.0, std=std / math.sqrt(2.0 * L))
@@ -419,9 +442,42 @@ class GPTModel(nn.Layer):
         if isinstance(self.h, GPTScannedBlocks):
             x = self.h(x, attn_mask)
         else:
-            for block in self.h:
-                x = block(x, attn_mask)
+            gran = self.config.recompute_granularity
+            interval = self.config.recompute_interval
+            from ..core import dispatch
+            use_rc = (gran != "none" and self.training
+                      and (dispatch.in_trace()
+                           or dispatch.is_grad_enabled()))
+            for i, block in enumerate(self.h):
+                if use_rc and i % interval == 0:
+                    # block forward under the recompute policy: the compiled
+                    # path drops this block's residuals per `gran` and
+                    # rematerializes them in backward
+                    from ..distributed.fleet.recompute import recompute
+                    x = recompute(block, x, attn_mask, policy=gran)
+                else:
+                    x = block(x, attn_mask)
         return self.ln_f(x)
+
+    def enable_recompute(self, granularity="selective", interval: int = 1):
+        """Turn activation recompute on/off after construction.
+
+        granularity: "none" | "selective" | "dots" | "full" (True maps to
+        "full", False/None to "none"); interval=N checkpoints every Nth
+        block. The scan_layers path folds the policy into its single scan
+        body (interval does not apply there)."""
+        self.config.recompute_granularity, self.config.recompute_interval = \
+            normalize_granularity(granularity, interval)
+        granularity = self.config.recompute_granularity
+        if isinstance(self.h, GPTScannedBlocks):
+            self.h.remat = granularity
+        return self
+
+    @property
+    def _recompute_wanted(self) -> bool:
+        """Observability hook (jit.TrainStep emits remat/* gauges when the
+        model it compiles declares recompute)."""
+        return self.config.recompute_granularity != "none"
 
 
 class GPTForCausalLM(nn.Layer):
@@ -436,6 +492,15 @@ class GPTForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+
+    def enable_recompute(self, granularity="selective", interval: int = 1):
+        """See GPTModel.enable_recompute."""
+        self.gpt.enable_recompute(granularity, interval)
+        return self
+
+    @property
+    def _recompute_wanted(self) -> bool:
+        return self.gpt._recompute_wanted
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.gpt(input_ids, attn_mask)

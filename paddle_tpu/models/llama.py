@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from .. import ops
+from ..core.remat import (ATTN_OUT, ATTN_QKV, MLP_HIDDEN,
+                          normalize_granularity, tag_activation)
 from ..ops._helpers import _op
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
@@ -40,10 +42,17 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     initializer_range: float = 0.02
+    # activation recompute ("none" | "selective" | "dots" | "full");
+    # interval=N checkpoints every Nth block — see fleet/recompute.py
+    recompute_granularity: str = "none"
+    recompute_interval: int = 1
 
     def __post_init__(self):
         if self.num_kv_heads == 0:
             self.num_kv_heads = self.num_heads
+        self.recompute_granularity, self.recompute_interval = \
+            normalize_granularity(self.recompute_granularity,
+                                  self.recompute_interval)
 
 
 def llama_7b(**overrides) -> LlamaConfig:
@@ -115,8 +124,14 @@ class LlamaAttention(nn.Layer):
         b, s, h = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv, self.head_dim])
-        v = self.v_proj(x).reshape([b, s, self.num_kv, self.head_dim])
+        v = tag_activation(
+            self.v_proj(x), ATTN_QKV).reshape([b, s, self.num_kv,
+                                               self.head_dim])
         q, k = _op("rope", q, k, theta=self.theta)
+        # selective recompute saves the POST-rope q/k (so backward replays
+        # neither the projections nor the rotation) and raw v
+        q = tag_activation(q, ATTN_QKV)
+        k = tag_activation(k, ATTN_QKV)
         # GQA is handled below the functional API: the Pallas kernel folds q
         # heads onto their KV head in its index map (repeated K/V never
         # materializes in HBM); the XLA sdpa fallback expands heads itself
@@ -127,7 +142,7 @@ class LlamaAttention(nn.Layer):
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  training=self.training)
-        return self.o_proj(out.reshape([b, s, h]))
+        return tag_activation(self.o_proj(out.reshape([b, s, h])), ATTN_OUT)
 
     def _forward_cached(self, x, kv_cache):
         """KV-cache attention with RoPE at absolute positions and GQA
@@ -182,7 +197,9 @@ class LlamaMLP(nn.Layer):
         self.down_proj = nn.Linear(I, H, bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        return self.down_proj(
+            F.silu(tag_activation(self.gate_proj(x), MLP_HIDDEN))
+            * tag_activation(self.up_proj(x), MLP_HIDDEN))
 
 
 class LlamaBlock(nn.Layer):
@@ -236,9 +253,28 @@ class LlamaModel(nn.Layer):
                 x, nc = block(x, kv_cache=(cache[0], cache[1], p0))
                 new_caches.append(nc)
             return self.norm(x), new_caches
-        for block in self.layers:
-            x = block(x)
+        gran = self.config.recompute_granularity
+        interval = self.config.recompute_interval
+        from ..core import dispatch
+        use_rc = (gran != "none" and self.training
+                  and (dispatch.in_trace() or dispatch.is_grad_enabled()))
+        for i, block in enumerate(self.layers):
+            if use_rc and i % interval == 0:
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(block, x, policy=gran)
+            else:
+                x = block(x)
         return self.norm(x)
+
+    def enable_recompute(self, granularity="selective", interval: int = 1):
+        """Activation recompute toggle — see GPTModel.enable_recompute."""
+        self.config.recompute_granularity, self.config.recompute_interval = \
+            normalize_granularity(granularity, interval)
+        return self
+
+    @property
+    def _recompute_wanted(self) -> bool:
+        return self.config.recompute_granularity != "none"
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -258,6 +294,15 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head.weight.set_value(
                 normal(tuple(self.lm_head.weight.shape),
                        self.lm_head.weight.dtype))
+
+    def enable_recompute(self, granularity="selective", interval: int = 1):
+        """See LlamaModel.enable_recompute."""
+        self.model.enable_recompute(granularity, interval)
+        return self
+
+    @property
+    def _recompute_wanted(self) -> bool:
+        return self.model._recompute_wanted
 
     def forward(self, input_ids, labels=None):
         hidden = self.model(input_ids)

@@ -224,6 +224,34 @@ class Monitor:
                   accum_ideal_bytes=accum_ideal_bytes,
                   opt_state_bytes=opt_state_bytes, buckets=buckets)
 
+    def remat_compiled(self, requested: bool, regions: int, policy,
+                       saved_name_bytes: int, named_bytes: dict,
+                       baseline_total_bytes=None, saved_residual_bytes=None):
+        """Activation-recompute gauges for a freshly minted executable.
+
+        ``requested`` = the compiled model declared a recompute config;
+        ``regions`` = checkpoint regions the trace actually applied;
+        ``saved_name_bytes`` = bytes of named activations the selective
+        policy keeps. ``requested`` with ``regions == 0`` (or a selective
+        policy with zero named bytes) is the lost-checkpoint signature —
+        the remat the user asked for silently fell out of the program.
+        ``baseline_total_bytes``/``saved_residual_bytes`` are the measured
+        ``memory_analysis()`` comparison against a no-remat twin when the
+        caller compiled one (``PADDLE_REMAT_BASELINE=1``)."""
+        g = self.registry.gauge
+        g("remat/requested").set(1 if requested else 0)
+        g("remat/regions").set(regions)
+        g("remat/saved_name_bytes").set(saved_name_bytes)
+        fields = dict(requested=bool(requested), regions=regions,
+                      policy=policy, saved_name_bytes=saved_name_bytes,
+                      named_bytes=dict(named_bytes or {}))
+        if baseline_total_bytes is not None:
+            g("remat/baseline_total_bytes").set(baseline_total_bytes)
+            g("remat/saved_residual_bytes").set(saved_residual_bytes or 0)
+            fields.update(baseline_total_bytes=baseline_total_bytes,
+                          saved_residual_bytes=saved_residual_bytes)
+        self.emit("remat", **fields)
+
     def update_skipped(self, microbatches: int = 1):
         """AMP found-inf: the compiled step discarded its whole update."""
         self.registry.counter("train_step/skipped_updates").inc()

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from ...core import random as rng
 from ...core.dispatch import register_op
+from ...core.remat import ATTN_CONTEXT, tag_array
 from ...core.tensor import Tensor
 from ...ops._helpers import _op
 
@@ -52,7 +53,11 @@ def _sdpa_fwd(q, k, v, *rest, causal=False, scale=None, has_mask=False,
                                     1.0 - dropout_p, probs.shape)
         probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_p)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
-    return jnp.swapaxes(out, 1, 2)  # back to [B,L,H,D]
+    # checkpoint name on the CONTEXT only: under the "selective" recompute
+    # policy the context survives while every [B,H,S,S] intermediate above
+    # (logits, probs, dropout mask) stays unnamed and is rematerialized in
+    # backward — the Megatron selective-recompute memory/FLOPs trade
+    return tag_array(jnp.swapaxes(out, 1, 2), ATTN_CONTEXT)  # [B,L,H,D]
 
 
 register_op("sdpa", _sdpa_fwd, nondiff_inputs=(3, 4))
@@ -61,8 +66,9 @@ register_op("sdpa", _sdpa_fwd, nondiff_inputs=(3, 4))
 def _flash_attn_pallas_fwd(q, k, v, *rest, causal=False, dropout_rate=0.0):
     from ...kernels.pallas.flash_attention import flash_attention_blhd
     seed = rest[0] if rest else 0
-    return flash_attention_blhd(q, k, v, causal=causal,
-                                dropout_rate=dropout_rate, seed=seed)
+    return tag_array(flash_attention_blhd(q, k, v, causal=causal,
+                                          dropout_rate=dropout_rate,
+                                          seed=seed), ATTN_CONTEXT)
 
 
 # Pallas flash attention as a dispatch op: flows through the autograd tape; its
@@ -81,10 +87,12 @@ def _flash_attn_packed_fwd(qkv, *rest, num_heads, causal=True,
     if pair_layout_supported(d, num_heads, qkv.shape[1]):
         # single-tile fast path (head-blocks fill the 128-lane quantum;
         # fused single-pass dqkv backward) — kernels/pallas/flash_pair.py
-        return flash_pair_packed(qkv, num_heads, causal,
-                                 dropout_rate=dropout_rate, seed=seed)
-    return flash_attention_qkv_packed(qkv, num_heads, causal=causal,
-                                      dropout_rate=dropout_rate, seed=seed)
+        return tag_array(flash_pair_packed(qkv, num_heads, causal,
+                                           dropout_rate=dropout_rate,
+                                           seed=seed), ATTN_CONTEXT)
+    return tag_array(flash_attention_qkv_packed(qkv, num_heads, causal=causal,
+                                                dropout_rate=dropout_rate,
+                                                seed=seed), ATTN_CONTEXT)
 
 
 register_op("flash_attn_qkv_packed", _flash_attn_packed_fwd,
@@ -94,9 +102,10 @@ register_op("flash_attn_qkv_packed", _flash_attn_packed_fwd,
 def _flash_attn_lens_fwd(q, k, v, lens, *rest, causal=False, dropout_rate=0.0):
     from ...kernels.pallas.flash_attention import flash_attention_blhd
     seed = rest[0] if rest else 0
-    return flash_attention_blhd(q, k, v, causal=causal,
-                                dropout_rate=dropout_rate, seed=seed,
-                                kv_lens=lens)
+    return tag_array(flash_attention_blhd(q, k, v, causal=causal,
+                                          dropout_rate=dropout_rate,
+                                          seed=seed, kv_lens=lens),
+                     ATTN_CONTEXT)
 
 
 # encoder padding-mask flash: per-sequence kv lengths as a nondiff input
@@ -108,9 +117,10 @@ def _flash_attn_segs_fwd(q, k, v, sq, sk, *rest, causal=False,
                          dropout_rate=0.0):
     from ...kernels.pallas.flash_attention import flash_attention_blhd
     seed = rest[0] if rest else 0
-    return flash_attention_blhd(q, k, v, causal=causal,
-                                dropout_rate=dropout_rate, seed=seed,
-                                q_segments=sq, kv_segments=sk)
+    return tag_array(flash_attention_blhd(q, k, v, causal=causal,
+                                          dropout_rate=dropout_rate,
+                                          seed=seed, q_segments=sq,
+                                          kv_segments=sk), ATTN_CONTEXT)
 
 
 # packed-sequence flash: segment ids gate attention (same-segment only)
@@ -122,9 +132,11 @@ def _flash_attn_segs_lens_fwd(q, k, v, lens, sq, sk, *rest, causal=False,
                               dropout_rate=0.0):
     from ...kernels.pallas.flash_attention import flash_attention_blhd
     seed = rest[0] if rest else 0
-    return flash_attention_blhd(q, k, v, causal=causal,
-                                dropout_rate=dropout_rate, seed=seed,
-                                kv_lens=lens, q_segments=sq, kv_segments=sk)
+    return tag_array(flash_attention_blhd(q, k, v, causal=causal,
+                                          dropout_rate=dropout_rate,
+                                          seed=seed, kv_lens=lens,
+                                          q_segments=sq, kv_segments=sk),
+                     ATTN_CONTEXT)
 
 
 # padding lengths AND packed segments together (the kernel masks with both)

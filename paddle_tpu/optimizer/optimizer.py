@@ -46,7 +46,20 @@ def _const_at(shape, dtype, value, sh):
 
 @functools.lru_cache(maxsize=None)
 def _jitted_update(cls, static_key):
-    """One compiled update over the whole parameter pytree per optimizer config."""
+    """One compiled update over the whole parameter pytree per optimizer config.
+
+    Params and accumulator states are DONATED: the update is elementwise, so
+    XLA writes new values into the incoming buffers instead of allocating a
+    second params+2·moments footprint per step — on the eager path that
+    transient was the largest allocation of the whole step (the compiled
+    TrainStep has donated these since PR 1). ``_step_group`` replaces
+    ``p._data`` / the accumulator dicts wholesale right after the call, so
+    the invalidated inputs are dead on arrival; the visible hazard is the
+    same one the sparse path documents — an array handle taken BEFORE the
+    step (``p.value()``, an old ``state_dict()``) is no longer readable
+    after it; holders should ``.copy()`` or snapshot to host first
+    (``AsyncCheckpointer`` already does). Grads are NOT donated:
+    ``p._grad`` stays readable after ``step()`` until ``clear_grad()``."""
     static = dict(static_key)
 
     def update(params, grads, states, scalars):
@@ -54,7 +67,7 @@ def _jitted_update(cls, static_key):
                                                   **static)
         return new_params, new_states
 
-    return jax.jit(update)
+    return jax.jit(update, donate_argnums=(0, 2))
 
 
 @functools.lru_cache(maxsize=None)
@@ -312,6 +325,13 @@ class Optimizer:
                 for i, n in enumerate(names)]
 
     def state_dict(self):
+        """Snapshot BY REFERENCE: the returned Tensors wrap the live moment/
+        master arrays. The dense compiled update donates those buffers
+        (see _jitted_update), so a snapshot taken before a later ``step()``
+        is no longer readable afterwards — serialize (``paddle.save``,
+        ``np.asarray``) or ``.copy()`` before stepping if you need it to
+        outlive the step. ``AsyncCheckpointer`` already host-copies at
+        ``save()`` time."""
         out = {"master_weights": {}, "LR_Scheduler": {}}
         for p, key in zip(self._parameter_list, self._param_keys()):
             pid = id(p)

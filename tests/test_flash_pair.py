@@ -125,6 +125,43 @@ def test_fused_bwd_cutoff_scales_with_lane_width():
     assert fp._max_fused_bwd(1, 512) == 1024
 
 
+def test_fused_bwd_cutoff_override_env_and_kwarg(monkeypatch):
+    """The cutoff is a heuristic — chips with different VMEM headroom need
+    the escape hatch: PADDLE_FLASH_FUSED_BWD_MAX env or the max_fused_bwd
+    kwarg (kwarg wins)."""
+    import paddle_tpu.kernels.pallas.flash_pair as fp
+    monkeypatch.delenv("PADDLE_FLASH_FUSED_BWD_MAX", raising=False)
+    assert fp._max_fused_bwd(2, 64) == 4096
+    monkeypatch.setenv("PADDLE_FLASH_FUSED_BWD_MAX", "512")
+    assert fp._max_fused_bwd(2, 64) == 512
+    assert fp._max_fused_bwd(1, 256) == 512     # env overrides the scaling
+    assert fp._max_fused_bwd(2, 64, 2048) == 2048   # kwarg beats env
+    monkeypatch.setenv("PADDLE_FLASH_FUSED_BWD_MAX", "0")
+    assert fp._max_fused_bwd(2, 64) == 0        # 0 forces the split form
+
+
+def test_pair_backward_kwarg_forces_split():
+    """max_fused_bwd= through the keyword front door routes L=1024 to the
+    SPLIT backward (block_q=32 gives this signature its own jit entry, so
+    no other test's cached trace can mask the kwarg)."""
+    import paddle_tpu.kernels.pallas.flash_pair as fp
+    b, L, heads, d = 1, 1024, 2, 64
+    qkv = _rand_qkv(b, L, heads, d, seed=11)
+
+    def f_pair(x):
+        return (fp.flash_pair_packed(x, heads, True, block_q=32,
+                                     interpret=True,
+                                     max_fused_bwd=512) ** 2).sum()
+
+    def f_ref(x):
+        return (_oracle(x, heads, d, True) ** 2).sum()
+
+    g_pair = jax.grad(f_pair)(qkv)
+    g_ref = jax.grad(f_ref)(qkv)
+    np.testing.assert_allclose(np.asarray(g_pair), np.asarray(g_ref),
+                               rtol=1e-2, atol=2e-2)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_pair_backward_split(causal, monkeypatch):
     """The SPLIT two-kernel backward (kv_pad beyond the fused VMEM bound) —

@@ -1,0 +1,475 @@
+"""Selective activation recompute in the compiled train path (ISSUE 7).
+
+* policy layer: ``fleet.recompute(policy=...)`` maps onto jax.checkpoint
+  rematerialization policies ("full" | "dots" | "selective" — names-based
+  ``save_only_these_names`` over the tagged linear residuals, dropping the
+  [B,H,S,S] attention score/softmax region);
+* THE acceptance gate: ``recompute_granularity="selective"`` on a 2-layer
+  GPT block stack compiles to ≤ 0.8x the no-remat step's peak-resident
+  bytes at equal batch, with numerics matching no-remat exactly;
+* composition: recompute × ``accumulate_steps=K`` × ZeRO stage-2 — loss and
+  weights bitwise vs the no-remat sharded path for K in {1, 2}, compile
+  count still 1/bucket, fp32 accumulators still shard-sized;
+* wiring: ``recompute_interval=N``, ``hapi.Model.prepare(recompute=...)``,
+  ``DistributedStrategy.recompute`` via ``fleet.distributed_model``;
+* observability: ``remat/*`` gauges + the metrics_summary "recompute"
+  section's lost-checkpoint WARNING;
+* satellites: the eager optimizer update donates params/opt-state
+  (peak-bytes assertion), ``bench.py --recompute`` emits a parseable
+  best-so-far line.
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor
+from paddle_tpu.core import remat as cremat
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.monitor.memory import executable_memory_stats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_env():
+    from paddle_tpu.distributed import env
+    env._env["initialized"] = False
+    env._env["mesh"] = None
+    env._env["hcg"] = None
+    from paddle_tpu.distributed import group
+    group._group_registry.clear()
+    monitor.disable()
+    yield
+    monitor.disable()
+
+
+def _gpt(gran, scan=False, layers=2, seq=256, interval=1, seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=layers,
+                    num_heads=4, max_position_embeddings=seq,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    recompute_granularity=gran, recompute_interval=interval,
+                    scan_layers=scan)
+    return GPTForCausalLM(cfg)
+
+
+def _ids(b=4, s=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randint(0, 256, (b, s)).astype("int32"))
+
+
+def _train(model, ids, steps=3, **step_kw):
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt, **step_kw)
+    losses = [float(step(ids, ids)) for _ in range(steps)]
+    weights = {n: np.asarray(p.value()) for n, p in model.named_parameters()}
+    mem = executable_memory_stats(next(iter(step._fast.values())))
+    return losses, weights, mem, step
+
+
+# ------------------------------------------------------------ policy mapping
+
+
+def test_policy_mapping():
+    assert cremat.resolve_policy("full") is None
+    assert cremat.resolve_policy(True) is None
+    assert cremat.resolve_policy(None) is None
+    assert callable(cremat.resolve_policy("dots"))
+    assert callable(cremat.resolve_policy("selective"))
+    custom = jax.checkpoint_policies.nothing_saveable
+    assert cremat.resolve_policy(custom) is custom
+    with pytest.raises(ValueError):
+        cremat.resolve_policy("bogus")
+    with pytest.raises(ValueError):
+        fleet.recompute(lambda x: x, paddle.to_tensor([1.0]), policy="bogus")
+
+
+def test_config_rejects_unknown_granularity():
+    with pytest.raises(ValueError):
+        GPTConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                  recompute_granularity="sometimes")
+    # legacy remat= spelling still routes into the policy layer
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                    remat="dots")
+    assert cfg.recompute_granularity == "dots"
+
+
+# ------------------------------------------------- THE memory/numerics gate
+
+
+def test_selective_memory_gate_2layer_stack():
+    """Acceptance: selective recompute on a 2-layer GPT block stack reaches
+    ≤ 0.8x the no-remat compiled peak at equal batch, numerics EXACT."""
+    ids = _ids()
+    l0, w0, m0, _ = _train(_gpt("none", scan=True), ids)
+    l1, w1, m1, _ = _train(_gpt("selective", scan=True), ids)
+    if m0 is None:
+        pytest.skip("backend exposes no memory_analysis()")
+    ratio = m1["total_bytes"] / m0["total_bytes"]
+    assert ratio <= 0.8, (ratio, m1, m0)
+    # bitwise: the checkpointed program replays the same primitives on the
+    # same inputs — losses AND updated weights identical to no-remat
+    assert l0 == l1
+    for n in w0:
+        np.testing.assert_array_equal(w0[n], w1[n], err_msg=n)
+
+
+@pytest.mark.slow
+def test_block_path_selective_and_full_parity():
+    """Discrete-block (scan_layers=False) path: fleet.recompute wraps each
+    block. Peak memory strictly drops; first-step loss (pure forward) is
+    bitwise, trained weights track within float-reassociation noise.
+    (slow: 3 discrete-block compiles ~21s; the tier-1 gate lives on the
+    scan path above, and block-path wiring is covered by the interval and
+    hapi/strategy tests)"""
+    ids = _ids()
+    l0, w0, m0, _ = _train(_gpt("none"), ids)
+    for gran in ("selective", "full"):
+        l1, w1, m1, _ = _train(_gpt(gran), ids)
+        assert l1[0] == l0[0], gran
+        if m0 is not None:
+            assert m1["total_bytes"] < m0["total_bytes"], gran
+        for n in w0:
+            # Adam divides reassociation-level grad noise by sqrt(v)+eps, so
+            # a 1-ulp grad difference can grow to ~1e-5 in 3 steps — the
+            # bitwise contract lives on the scan path (gate test above)
+            np.testing.assert_allclose(w0[n], w1[n], rtol=1e-3, atol=1e-5,
+                                       err_msg=f"{gran}:{n}")
+
+
+@pytest.mark.slow
+def test_recompute_interval_every_nth_block():
+    """interval=2 on 4 blocks checkpoints blocks 0 and 2 only. (slow: two
+    4-layer discrete-block compiles ~20s)"""
+    ids = _ids(s=64)
+    model = _gpt("selective", layers=4, seq=64, interval=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt)
+    cremat.reset_trace_stats()
+    l1 = float(step(ids, ids))
+    stats = cremat.trace_stats()
+    assert stats["regions"] == 2, stats
+    assert stats["policy"] == "selective"
+    l0, _, _, _ = _train(_gpt("none", layers=4, seq=64), ids, steps=1)
+    assert l1 == l0[0]
+
+
+# --------------------------------------- recompute × accumulation × ZeRO
+
+
+def _init_sharding_mesh(degree=8):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": degree, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_recompute_x_accum_x_zero_parity(k):
+    """Remat inside the accumulation scan body must not perturb the ZeRO
+    machinery: loss/weights bitwise vs the no-remat sharded path, compile
+    count still 1/bucket, fp32 accumulators still shard-sized."""
+    _init_sharding_mesh()
+    out = {}
+    for gran in ("none", "selective"):
+        model = _gpt(gran, scan=True, seq=64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        m2, opt2, _ = dist.group_sharded_parallel(model, opt, level="os_g")
+        step = paddle.jit.TrainStep(m2, opt2, accumulate_steps=k)
+        rng = np.random.RandomState(0)
+        shape = (k, 8, 64) if k > 1 else (8, 64)
+        ids = paddle.to_tensor(rng.randint(0, 256, shape).astype("int32"))
+        losses = [float(step(ids, ids)) for _ in range(2)]
+        out[gran] = (losses,
+                     {n: np.asarray(p.value())
+                      for n, p in model.named_parameters()})
+        assert step.num_compiles == 1, (gran, step.num_compiles)
+        if k > 1 and step._accum_plan is not None:
+            ideal = step._accum_plan.ideal_bytes()
+            assert step._accum_plan.accum_bytes() <= 1.15 * ideal
+    assert out["none"][0] == out["selective"][0]
+    for n in out["none"][1]:
+        np.testing.assert_array_equal(out["none"][1][n],
+                                      out["selective"][1][n], err_msg=n)
+
+
+# ----------------------------------------------------------------- wiring
+
+
+def test_hapi_prepare_recompute_routes():
+    lm = _gpt("none", seq=64)
+    m = paddle.Model(lm)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lm.parameters())
+    m.prepare(optimizer=opt, jit_compile=True,
+              recompute={"granularity": "selective", "interval": 2})
+    assert lm.config.recompute_granularity == "selective"
+    assert lm.config.recompute_interval == 2
+    assert lm._recompute_wanted
+    m.prepare(optimizer=opt, jit_compile=True, recompute=False)
+    assert lm.config.recompute_granularity == "none"
+    # a network without the hook fails loudly, not silently without remat
+    plain = paddle.Model(nn.Linear(4, 4))
+    with pytest.raises(ValueError, match="enable_recompute"):
+        plain.prepare(optimizer=None, recompute="selective")
+
+
+def test_strategy_recompute_via_distributed_model():
+    strategy = DistributedStrategy()
+    strategy.recompute = True
+    strategy.recompute_configs["granularity"] = "selective"
+    strategy.recompute_configs["interval"] = 3
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    lm = _gpt("none", seq=64)
+    fleet.distributed_model(lm)
+    assert lm.config.recompute_granularity == "selective"
+    assert lm.config.recompute_interval == 3
+    # a model without the hook: warn, don't crash
+    with pytest.warns(RuntimeWarning, match="enable_recompute"):
+        fleet.distributed_model(nn.Linear(4, 4))
+
+
+def test_llama_enable_recompute():
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    lm = LlamaForCausalLM(llama_tiny())
+    assert not lm._recompute_wanted
+    lm.enable_recompute("selective", interval=2)
+    assert lm.config.recompute_granularity == "selective"
+    assert lm._recompute_wanted
+    with pytest.raises(ValueError):
+        lm.enable_recompute("sometimes")
+
+
+@pytest.mark.slow
+def test_eager_recompute_parity():
+    """Tape-path recompute (GradNode replay) trains the same as no-remat.
+    (slow: eager per-op executables for two models ~9s; the tape machinery
+    itself predates this PR and test_recompute_sequential_segments keeps a
+    fast eager-path check in tier-1)"""
+    ids = _ids(s=64)
+
+    def train(gran):
+        model = _gpt(gran, seq=64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        for _ in range(2):
+            _, loss = model(ids, ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return float(loss), {n: np.asarray(p.value())
+                             for n, p in model.named_parameters()}
+
+    l0, w0 = train("none")
+    l1, w1 = train("full")
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for n in w0:
+        np.testing.assert_allclose(w0[n], w1[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)
+
+
+def test_hapi_lossnet_forwards_remat_observability(tmp_path):
+    """prepare(loss=...) wraps the network in _LossNet; the remat gauges
+    must see through the wrapper (remat/requested=1, not silently 0)."""
+    sink = str(tmp_path / "hapi.jsonl")
+    monitor.enable(sink)
+    lm = _gpt("selective", scan=True, seq=64)
+    m = paddle.Model(lm)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lm.parameters())
+    # passing a loss fn makes _ensure_train_step wrap the net in _LossNet;
+    # the model's (ids, labels) forward returns (None, loss)
+    m.prepare(optimizer=opt, loss=lambda outs, lbl: outs[1],
+              jit_compile=True)
+    ids = _ids(s=64)
+    m.train_batch([ids, ids], [ids])   # labels route through _LossNet
+    snap = monitor.snapshot()
+    assert snap["gauges"].get("remat/requested") == 1, snap["gauges"]
+    assert snap["gauges"].get("remat/regions", 0) >= 1
+
+
+def test_recompute_sequential_list_at_segment_boundary():
+    """A list-returning layer at a chunk edge must unpack exactly like it
+    does inside a chunk."""
+    paddle.seed(0)
+    a, b = nn.Linear(8, 8), nn.Linear(8, 8)
+    two_out = lambda x: [a(x), a(x)]           # list output
+    join = lambda u, v: b(u) + b(v)            # expects two args
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8)
+                         .astype("float32"))
+    x.stop_gradient = False
+    y = fleet.recompute_sequential({"segments": 2}, [two_out, join], x)
+    ref = join(*two_out(x))
+    np.testing.assert_allclose(np.asarray(y.value()),
+                               np.asarray(ref.value()), rtol=1e-6)
+
+
+def test_recompute_sequential_segments():
+    paddle.seed(0)
+    blocks = [nn.Linear(8, 8) for _ in range(4)]
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8)
+                         .astype("float32"))
+    x.stop_gradient = False
+    y = fleet.recompute_sequential({"segments": 2, "policy": "selective"},
+                                   blocks, x)
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+    np.testing.assert_allclose(np.asarray(y.value()),
+                               np.asarray(ref.value()), rtol=1e-6)
+    (y ** 2).mean().backward()
+    assert all(b.weight.grad is not None for b in blocks)
+
+
+# ----------------------------------------------------------- observability
+
+
+def test_remat_gauges_and_summary(tmp_path):
+    sink = str(tmp_path / "run.jsonl")
+    monitor.enable(sink)
+    ids = _ids(s=64)
+    _train(_gpt("selective", scan=True, seq=64), ids, steps=1)
+    snap = monitor.snapshot()
+    assert snap["gauges"]["remat/requested"] == 1
+    assert snap["gauges"]["remat/regions"] >= 1
+    assert snap["gauges"]["remat/saved_name_bytes"] > 0
+    monitor.disable()
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_summary
+    buf = io.StringIO()
+    metrics_summary.summarize([sink], out=buf)
+    txt = buf.getvalue()
+    assert "== recompute ==" in txt
+    assert "policy selective" in txt
+    assert "WARNING" not in txt.split("== recompute ==")[1] \
+        .split("==")[0]
+
+
+@pytest.mark.slow
+def test_remat_baseline_env_measures_saved_bytes(tmp_path, monkeypatch):
+    """PADDLE_REMAT_BASELINE=1 compiles a no-remat twin and the gauges carry
+    the MEASURED memory_analysis() delta (not an estimate). (slow: the twin
+    doubles the compile, ~10s)"""
+    monkeypatch.setenv("PADDLE_REMAT_BASELINE", "1")
+    monitor.enable(None)
+    ids = _ids()
+    _train(_gpt("selective", scan=True), ids, steps=1)
+    snap = monitor.snapshot()
+    base = snap["gauges"].get("remat/baseline_total_bytes", 0)
+    saved = snap["gauges"].get("remat/saved_residual_bytes", 0)
+    if not base:
+        pytest.skip("backend exposes no memory_analysis()")
+    # the twin must measure a real gap — and one consistent with the 0.8x
+    # acceptance gate on this exact config
+    assert saved >= 0.2 * base, (saved, base)
+
+
+def test_summary_warns_on_lost_checkpoint(tmp_path):
+    """remat requested + zero regions = the lost-checkpoint signature (the
+    pre-wiring repo state: fleet/recompute.py existed, nothing used it)."""
+    sink = tmp_path / "lost.jsonl"
+    recs = [
+        {"v": 1, "ts": 1.0, "kind": "meta", "schema": 1, "pid": 1, "proc": 0},
+        {"v": 1, "ts": 2.0, "kind": "remat", "requested": True, "regions": 0,
+         "policy": "selective", "saved_name_bytes": 0, "named_bytes": {}},
+        {"v": 1, "ts": 3.0, "kind": "counters", "metrics": {
+            "counters": {}, "histograms": {},
+            "gauges": {"remat/requested": 1, "remat/regions": 0,
+                       "remat/saved_name_bytes": 0}}},
+    ]
+    sink.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_summary
+    buf = io.StringIO()
+    metrics_summary.summarize([str(sink)], out=buf)
+    txt = buf.getvalue()
+    assert "== recompute ==" in txt
+    assert "WARNING" in txt and "lost-checkpoint" in txt
+
+
+# ------------------------------------------------------ eager donation gap
+
+
+def test_eager_update_donates_params_and_state():
+    """The eager optimizer.step() compiled update aliases params and
+    accumulator state onto their input buffers (the compiled TrainStep has
+    donated these since PR 1; the eager path used to pay a second
+    params+2-moments allocation every step)."""
+    from paddle_tpu.optimizer.optimizer import _jitted_update
+
+    paddle.seed(0)
+    m = nn.Linear(64, 64)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 64)
+                         .astype("float32"))
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    old_w = m.weight.value()
+    opt.step()
+    # the donated input buffer is dead; the parameter moved on
+    assert old_w.is_deleted()
+    assert np.isfinite(np.asarray(m.weight.value())).all()
+    # grads are NOT donated: still readable until clear_grad()
+    assert np.isfinite(np.asarray(m.weight.grad.value())).all()
+
+    # peak-bytes assertion: alias bytes cover params + states
+    params = [p.value() for p in m.parameters()]
+    states = [opt._accumulators[id(p)] for p in m.parameters()]
+    lr_scales = tuple(1.0 for _ in params)
+    wd_scales = tuple(opt._wd_scale(p) for p in m.parameters())
+    static_key = opt._static_config() + (("lr_scales", lr_scales),
+                                         ("wd_scales", wd_scales))
+    fn = _jitted_update(type(opt), static_key)
+    grads = [jnp_zeros_like(p) for p in params]
+    scalars = {k: jax.numpy.asarray(v, jax.numpy.float32)
+               for k, v in (("lr", 0.01), ("step", 1.0))}
+    ma = fn.lower(params, grads, states, scalars).compile().memory_analysis()
+    if ma is None:
+        pytest.skip("backend exposes no memory_analysis()")
+    donatable = sum(int(np.prod(p.shape)) * 4 for p in params) \
+        + sum(int(np.prod(s.shape)) * 4
+              for st in states for s in st.values())
+    assert ma.alias_size_in_bytes >= donatable, \
+        (ma.alias_size_in_bytes, donatable)
+
+
+def jnp_zeros_like(p):
+    import jax.numpy as jnp
+    return jnp.zeros(p.shape, p.dtype)
+
+
+# ------------------------------------------------------------- bench knob
+
+
+def test_bench_recompute_emits_parseable_line():
+    """bench.py --recompute (BENCH_TINY smoke config) must emit best-so-far
+    JSON lines carrying the recompute policy — the rc=124-safe contract."""
+    env = dict(os.environ, BENCH_TINY="1", JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_MONITOR", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--recompute"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert lines, out.stdout
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "gpt_medium_train_tokens_per_sec_per_chip"
+    assert rec["recompute"] == "selective"
+    assert rec["value"] > 0

@@ -238,6 +238,39 @@ def summarize(paths, show_events=False, out=sys.stdout):
         if opt_b:
             print(f"  opt state (per device) {_fmt_bytes(opt_b)}", file=out)
 
+    remat_events = by_kind.get("remat", [])
+    remat_on = gauges_m.get("remat/requested", 0) or remat_events or \
+        gauges_m.get("remat/regions", 0)
+    if remat_on:
+        regions = int(gauges_m.get("remat/regions", 0))
+        named = gauges_m.get("remat/saved_name_bytes", 0)
+        policy = next((r.get("policy") for r in reversed(remat_events)
+                       if r.get("policy")), None)
+        print(f"\n== recompute ==", file=out)
+        print(f"  policy {policy or '?'}  checkpoint regions {regions}  "
+              f"saved named activations {_fmt_bytes(named)}", file=out)
+        base = gauges_m.get("remat/baseline_total_bytes", 0)
+        if base:
+            saved = gauges_m.get("remat/saved_residual_bytes", 0)
+            print(f"  measured vs no-remat twin: baseline "
+                  f"{_fmt_bytes(base)}, saved residuals {_fmt_bytes(saved)} "
+                  f"({saved / base:.0%} of peak)", file=out)
+        # the regression this section exists to catch (the pre-wiring state
+        # of the repo: fleet/recompute.py existed but nothing routed through
+        # it): recompute is REQUESTED but the trace checkpointed nothing —
+        # the run silently trains at no-remat memory
+        if gauges_m.get("remat/requested", 0) and regions == 0:
+            print("  WARNING: recompute is on but zero checkpoint regions "
+                  "were applied at trace time — lost-checkpoint signature "
+                  "(model blocks not routed through fleet.recompute / scan "
+                  "remat; saved-residual bytes are ~0)", file=out)
+        elif policy == "selective" and regions > 0 and not named:
+            print("  WARNING: selective recompute applied but zero named "
+                  "activations were tagged — checkpoint names lost (flash/"
+                  "attention path not tagging attn_*/mlp_hidden), so the "
+                  "policy saves nothing and backward recomputes everything",
+                  file=out)
+
     counters_m = (metrics or {}).get("counters", {})
     hists_m = (metrics or {}).get("histograms", {})
     serves = by_kind.get("serve_engine", [])
