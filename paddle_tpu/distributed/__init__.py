@@ -26,6 +26,7 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from .fleet.recompute import recompute  # noqa: F401
 from . import auto_parallel  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
+from . import reshard  # noqa: F401,E402
 from . import preemption  # noqa: F401,E402
 from .preemption import PreemptionWatcher  # noqa: F401,E402
 from .auto_parallel import ProcessMesh  # noqa: F401,E402

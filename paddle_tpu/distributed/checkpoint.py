@@ -26,11 +26,16 @@ guarantee:
 * **Retry** — transient filesystem errors retry with exponential backoff +
   jitter (:class:`paddle_tpu.utils.retry.RetryPolicy`).
 
-TPU-native: sharded state dicts go through Orbax (the jax-ecosystem checkpoint
-library baked into this image): every host writes ONLY its addressable shards,
-restore re-assembles arrays directly onto their target shardings — no
-gather-to-host-0, so a 1.3B+ ZeRO-3 run checkpoints without materializing the
-full model anywhere.
+TPU-native: payloads containing SHARDED state (ZeRO moments/masters,
+multi-host arrays) are persisted per shard through
+:mod:`paddle_tpu.distributed.reshard` — every rank writes only its
+host-addressable blocks under a rank-indexed block map, restore reshards
+them directly onto the CURRENT mesh (so a snapshot taken at world size N
+resumes at M), and multi-rank jobs commit POD-wide: rank 0 stamps the
+COMMIT manifest only after every rank acked a durable payload through the
+launcher's KV master. Replicated/unsharded payloads keep the legacy layout
+(Orbax ``model/``, pickle ``optimizer.pdopt``), so a 1.3B+ ZeRO run still
+checkpoints without materializing full-size state anywhere.
 
 Fault injection (tests only): the module routes its state-changing filesystem
 calls through the ``_fs`` seam (monkeypatch to inject transient errors), and
@@ -56,6 +61,7 @@ import numpy as np
 from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from ..utils.retry import RetryPolicy
+from . import reshard as _reshard
 
 __all__ = ["save_state_dict", "load_state_dict", "save_checkpoint",
            "load_checkpoint", "latest_checkpoint", "committed_steps",
@@ -293,21 +299,38 @@ def verify_snapshot(base: str, manifest: Optional[dict] = None) -> List[str]:
 
 # --------------------------------------------------------------- state capture
 
-def _host_copy(obj):
-    """Deep-copy a state structure to host numpy — the async writer's
-    snapshot, immune to subsequent training steps and device donation.
+def _fully_addressable(a) -> bool:
+    """Seam for the shard-staging decision (tests monkeypatch this to
+    exercise the multi-host staging path on a single-host mesh)."""
+    return getattr(a, "is_fully_addressable", True)
 
-    Arrays spanning NON-addressable devices (multi-host shardings) cannot be
-    materialized on this host: those keep their jax.Array reference — jax
-    arrays are immutable and training replaces rather than mutates them, so
-    the reference is still a consistent snapshot, and Orbax then writes only
-    our addressable shards (the device buffers stay live until the write
-    finishes; per-shard host staging is the ROADMAP follow-up)."""
+
+def _needs_shard_stage(a) -> bool:
+    """True when this array must be persisted per shard: it spans devices
+    this process cannot address, or its NamedSharding actually splits a
+    dimension (ZeRO moments/masters). Mesh-replicated and single-device
+    arrays stay on the legacy whole-array path."""
+    if not isinstance(a, jax.Array):
+        return False
+    if not _fully_addressable(a):
+        return True
+    return _reshard.is_sharded_array(a)
+
+
+def _host_copy(obj):
+    """Deep-copy a state structure to host — the async writer's snapshot,
+    immune to subsequent training steps and device donation.
+
+    Sharded arrays (and arrays spanning NON-addressable devices) are staged
+    PER SHARD: only the blocks this host can address are copied to numpy
+    (:class:`reshard.StagedArray`), never a live jax reference and never an
+    assembled full-size buffer — closing the PR 4 carve-out where multi-host
+    arrays kept device buffers pinned until the background write finished."""
     if isinstance(obj, Tensor):
         obj = obj.value()
     if isinstance(obj, jax.Array):
-        if not getattr(obj, "is_fully_addressable", True):
-            return obj
+        if _needs_shard_stage(obj):
+            return _reshard.stage(obj)
         return np.asarray(obj)
     if isinstance(obj, dict):
         return {k: _host_copy(v) for k, v in obj.items()}
@@ -315,6 +338,22 @@ def _host_copy(obj):
         c = [_host_copy(v) for v in obj]
         return c if isinstance(obj, list) else tuple(c)
     return obj
+
+
+def _payload_is_sharded(state) -> bool:
+    """Route a payload to the per-shard format when ANY leaf needs it (a
+    staged shard copy, or a live sharded array on the sync path)."""
+    if isinstance(state, _reshard.StagedArray):
+        return True
+    if isinstance(state, Tensor):
+        return _needs_shard_stage(state.value())
+    if isinstance(state, jax.Array):
+        return _needs_shard_stage(state)
+    if isinstance(state, dict):
+        return any(_payload_is_sharded(v) for v in state.values())
+    if isinstance(state, (list, tuple)):
+        return any(_payload_is_sharded(v) for v in state)
+    return False
 
 
 def _capture(model, optimizer, grad_scaler, extra
@@ -331,15 +370,81 @@ def _capture(model, optimizer, grad_scaler, extra
 
 # ------------------------------------------------------------------ write path
 
+def _process_index() -> int:
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _write_payloads(tmp: str, rank: int, model_state, opt_state, extra,
+                    lead: Optional[bool] = None):
+    """Write one rank's payload files under the snapshot tmp dir.
+
+    Sharded payloads (any leaf is shard-staged or a live sharded array) go
+    through the per-shard format — every rank persists its own blocks under
+    ``<payload>.shards/rank_<r>/`` with a rank-indexed block map. Unsharded
+    payloads keep the legacy single-writer layout (``model/`` via Orbax,
+    ``optimizer.pdopt``/``extra.pkl`` pickles), written only by the
+    ``lead`` writer (pod mode: rank 0 of the shared directory; single
+    process / per-rank-private directories: this process, whatever its
+    global rank — its directory must be self-contained)."""
+    from .. import framework
+    if lead is None:
+        lead = rank == 0
+    if model_state is not None:
+        if _payload_is_sharded(model_state):
+            _reshard.save_sharded(os.path.join(tmp, "model.shards"),
+                                  model_state, rank=rank,
+                                  write_skeleton=lead)
+        elif lead:
+            save_state_dict(model_state, os.path.join(tmp, "model"))
+    if opt_state is not None:
+        if _payload_is_sharded(opt_state):
+            _reshard.save_sharded(os.path.join(tmp, "optimizer.shards"),
+                                  opt_state, rank=rank,
+                                  write_skeleton=lead)
+        elif lead:
+            framework.io.save(opt_state, os.path.join(tmp, "optimizer.pdopt"))
+    if extra and lead:
+        framework.io.save(extra, os.path.join(tmp, "extra.pkl"))
+
+
+def _resolve_coordinator(coordinator):
+    """An explicit coordinator wins; ``False`` forces the single-process
+    commit even under the launcher env (the per-rank-private-directory
+    layout); otherwise the launcher env contract (PADDLE_CKPT_MASTER +
+    PADDLE_TRAINERS_NUM>1) builds one, else None."""
+    if coordinator is False:
+        return None
+    if coordinator is not None:
+        return coordinator
+    return _reshard.pod_commit_from_env()
+
+
 def _write_snapshot(directory: str, step: int, model_state, opt_state, extra,
-                    retry: Optional[RetryPolicy], mode: str) -> str:
+                    retry: Optional[RetryPolicy], mode: str,
+                    coordinator=None) -> str:
     """The commit protocol. Returns the committed snapshot path.
 
     Emergency saves (mode="emergency") skip per-file hashing: re-reading a
     multi-GB payload to checksum it would spend the preemption grace window
     on I/O that only guards against later bit-rot — their manifests record
-    sizes only, which still catches truncation."""
+    sizes only, which still catches truncation.
+
+    With a pod coordinator (multi-rank jobs over the launcher's KV master),
+    the COMMIT manifest is pod-wide: rank 0 stamps it only after every rank
+    acked a durable payload — see :func:`_write_snapshot_pod`.
+
+    ``coordinator`` here is ALREADY RESOLVED by the public entry points
+    (``save_checkpoint``/``AsyncCheckpointer``): None means single-process
+    commit — re-resolving from env here would silently re-enable the pod
+    barrier after a caller opted out with ``coordinator=False``."""
     from .. import framework
+    coord = coordinator
+    if coord is not None and coord.world > 1:
+        return _write_snapshot_pod(directory, step, model_state, opt_state,
+                                   extra, retry, mode, coord)
     t0 = time.perf_counter()
     final = _snapshot_dir(directory, step)
     tmp = final + ".tmp"
@@ -357,12 +462,12 @@ def _write_snapshot(directory: str, step: int, model_state, opt_state, extra,
         if os.path.isdir(tmp):
             shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
-        if model_state is not None:
-            save_state_dict(model_state, os.path.join(tmp, "model"))
-        if opt_state is not None:
-            framework.io.save(opt_state, os.path.join(tmp, "optimizer.pdopt"))
-        if extra:
-            framework.io.save(extra, os.path.join(tmp, "extra.pkl"))
+        # single-process commit: this directory is self-contained — stage
+        # blocks under the REAL process rank (a multi-host job using
+        # per-rank-private dirs must not filter its own blocks out against
+        # a hardcoded rank 0) and write the skeleton/legacy payloads here
+        _write_payloads(tmp, _process_index(), model_state, opt_state,
+                        extra, lead=True)
         _fsync_tree(tmp)
         _maybe_die("die_before_rename", step)
         if os.path.isdir(final):
@@ -414,6 +519,110 @@ def _write_snapshot(directory: str, step: int, model_state, opt_state, extra,
     return final
 
 
+def _write_snapshot_pod(directory: str, step: int, model_state, opt_state,
+                        extra, retry: Optional[RetryPolicy], mode: str,
+                        coord) -> str:
+    """Pod-wide commit (multi-rank, shared filesystem, KV master barrier).
+
+    Rank 0 owns the directory protocol — tmp dir, rename, manifest, COMMIT
+    — exactly as in the single-process path; every other rank only writes
+    its own per-shard payload into the tmp dir and acks through the KV
+    master. The COMMIT manifest lands strictly after the last ack, so a
+    crash of ANY rank before that point leaves a manifest-less (invisible)
+    directory on every rank; the ack key itself is only PUT after the
+    rank's payload is written and fsynced (the "durable" half of the
+    barrier). Retry covers each rank's local payload writes; barrier
+    timeouts raise :class:`CheckpointError` with the missing ranks named.
+
+    Known limit: the re-save set-aside window (``step_N.old``) is guarded
+    by an in-process lock on rank 0 only — a sibling rank running the
+    resume scan DURING rank 0's re-save of an already-committed step could
+    heal the window early. Re-saves only happen post-rollback and resume
+    scans only at startup, so the orderings don't overlap in the launcher
+    lifecycle; a cross-process lease through the KV master is the upgrade
+    path if that ever changes."""
+    t0 = time.perf_counter()
+    coord = coord.for_dir(directory)  # keys scoped to THIS snapshot dir
+    final = _snapshot_dir(directory, step)
+    tmp = final + ".tmp"
+    old = final + ".old"
+    hash_files = mode != "emergency"
+    policy = retry or _default_retry()
+    mon = _monitor._active
+
+    if coord.rank != 0:
+        try:
+            token = coord.wait_ready(step)
+
+            def body():
+                _write_payloads(tmp, coord.rank, model_state, opt_state,
+                                extra)
+                _fsync_tree(tmp)
+
+            policy(body)
+            _maybe_die("die_before_ack", step)
+            coord.ack(step, token, {"mode": mode})
+            res = coord.wait_commit(step, token)
+        except _reshard.PodCommitError as e:
+            raise CheckpointError(str(e)) from e
+        if mon is not None:
+            mon.ckpt_saved(step=step, nbytes=0,
+                           dur_s=time.perf_counter() - t0, mode=mode)
+        return res.get("path", final)
+
+    with _aside_lock:  # same re-save set-aside protocol as single-process
+        if os.path.isdir(final):
+            if os.path.isdir(old):
+                shutil.rmtree(old, ignore_errors=True)
+            _fs.rename(final, old)
+        try:
+            def body():
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp, ignore_errors=True)
+                os.makedirs(tmp)
+                _write_payloads(tmp, 0, model_state, opt_state, extra)
+                _fsync_tree(tmp)
+
+            policy(body)
+            # the barrier opens only after rank 0's payload is durable AND
+            # the retry loop is done (a retry would rmtree the tmp dir out
+            # from under the other ranks' writes)
+            token = coord.publish_ready(step)
+            acks = coord.wait_acks(step, token)
+            _maybe_die("die_before_rename", step)
+            if os.path.isdir(final):
+                shutil.rmtree(final, ignore_errors=True)
+            _fs.replace(tmp, final)      # atomic publish of the pod payload
+            _fsync_dir(directory)
+            _maybe_die("die_before_commit", step)
+            manifest = _build_manifest(final, step, hash_files)
+            manifest["ranks"] = sorted([0] + list(acks))
+            _write_manifest(final, manifest)  # the snapshot now EXISTS
+        except BaseException as e:
+            if os.path.isdir(old):
+                try:
+                    if os.path.isdir(final):
+                        shutil.rmtree(final, ignore_errors=True)
+                    _fs.rename(old, final)
+                except OSError:
+                    pass
+            if isinstance(e, _reshard.PodCommitError):
+                raise CheckpointError(str(e)) from e
+            raise
+        if os.path.isdir(old):
+            shutil.rmtree(old, ignore_errors=True)
+    # past this point the snapshot is COMMITTED on disk: announcing it to
+    # the waiting ranks happens outside the rollback try above, so no
+    # announcement failure can ever restore .old over a committed snapshot
+    coord.publish_commit(step, token, final)
+    if mon is not None:
+        mon.ckpt_saved(step=step,
+                       nbytes=sum(f["bytes"]
+                                  for f in manifest["files"].values()),
+                       dur_s=time.perf_counter() - t0, mode=mode)
+    return final
+
+
 def _prune_committed(directory: str, keep: int, protect: str):
     """Prune to the newest `keep` snapshots by mtime (NOT step number — a
     post-rollback save with a lower step must survive). Only COMMITTED
@@ -440,16 +649,25 @@ def _prune_committed(directory: str, keep: int, protect: str):
 def save_checkpoint(directory: str, step: int, model=None, optimizer=None,
                     extra: Optional[Dict[str, Any]] = None, keep: int = 3,
                     grad_scaler=None, retry: Optional[RetryPolicy] = None,
-                    _mode: str = "sync") -> str:
+                    coordinator=None, _mode: str = "sync") -> str:
     """Periodic job snapshot: <dir>/step_<N>/{model,optimizer.pdopt,extra.pkl}
     committed atomically under a COMMIT manifest (reference auto_checkpoint).
-    Prunes committed snapshots beyond the newest `keep`. A ``grad_scaler``'s
-    state rides in ``extra["grad_scaler"]`` and is restored by
-    :func:`load_checkpoint`. Returns the committed snapshot path."""
+    Sharded state (ZeRO moments/masters, multi-host arrays) is persisted
+    per shard under ``<payload>.shards/`` instead — see
+    :mod:`paddle_tpu.distributed.reshard`. Prunes committed snapshots beyond
+    the newest `keep`. A ``grad_scaler``'s state rides in
+    ``extra["grad_scaler"]`` and is restored by :func:`load_checkpoint`.
+
+    ``coordinator``: a :class:`reshard.PodCommit` for multi-rank jobs
+    sharing one snapshot directory (defaults from the launcher env — the
+    COMMIT manifest then lands only after every rank's payload is durable).
+    Returns the committed snapshot path."""
     model_state, opt_state, ex = _capture(model, optimizer, grad_scaler, extra)
+    coord = _resolve_coordinator(coordinator)
     final = _write_snapshot(directory, step, model_state, opt_state, ex,
-                            retry, _mode)
-    _prune_committed(directory, keep, final)
+                            retry, _mode, coordinator=coord)
+    if coord is None or coord.rank == 0:
+        _prune_committed(directory, keep, final)
     return final
 
 
@@ -537,26 +755,126 @@ def _quarantine(base: str, problems: List[str]):
     return dst
 
 
-def _restore(base: str, step: int, model, optimizer, grad_scaler
-             ) -> Dict[str, Any]:
+def _load_sharded_model(path: str, model, force_gather: bool):
+    """Reshard a per-shard model payload onto the live params' placements.
+
+    Every live state entry MUST have a snapshot entry: silently leaving a
+    param at its init value (a model grew a weight since the snapshot)
+    would resume training with one random tensor at full confidence — the
+    legacy Orbax path errors on that, and so does this one."""
+    sd = dict(model.state_dict())
+    template = {}
+    for k, v in sd.items():
+        template[json.dumps([k])] = v.value() if isinstance(v, Tensor) else v
+    flat, _skel, stats = _reshard.load_sharded(path, template,
+                                               force_gather=force_gather)
+    missing = [k for k in sd if json.dumps([k]) not in flat]
+    if missing:
+        raise ValueError(
+            f"{path}: snapshot has no entry for model state "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''} — the "
+            f"snapshot does not fit this network (did the model grow?)")
+    for k, v in sd.items():
+        key = json.dumps([k])
+        if isinstance(v, Tensor):
+            v._data = flat[key]
+    return stats
+
+
+def _load_sharded_opt(path: str, optimizer, force_gather: bool):
+    """Reshard per-shard optimizer state onto the CURRENT mesh: states are
+    first materialized at their shard-sized placements (the ZeRO
+    ``_state_placement_fn`` hook, PR 5), which become the reshard targets —
+    an N-way snapshot's moments/masters land directly at the M-way layout,
+    no transient full-size buffer on the nestable paths."""
+    ensure = getattr(optimizer, "_ensure_all_states", None)
+    if ensure is not None:
+        ensure()
+    placer = getattr(optimizer, "_place_states", None)
+    if placer is not None:
+        placer()
+    template, _ = _reshard.flatten_state(optimizer.state_dict()) \
+        if hasattr(optimizer, "state_dict") else ({}, None)
+    flat, skel, stats = _reshard.load_sharded(path, template,
+                                              force_gather=force_gather)
+    if skel is None:
+        raise CheckpointError(
+            f"{path}: sharded optimizer payload has no skeleton.pkl "
+            f"(rank 0's payload missing) — cannot rebuild the state dict")
+    optimizer.set_state_dict(_reshard.unflatten_state(skel, flat))
+    return stats
+
+
+def _merge_reshard_stats(stats_list) -> Dict[str, Any]:
+    agg = _reshard.ReshardStats()
+    for s in stats_list:
+        agg.arrays += s.arrays
+        agg.identity += s.identity
+        agg.mapped += s.mapped
+        agg.gathered += s.gathered
+        agg.nestable_gather += s.nestable_gather
+        agg.bytes_read += s.bytes_read
+        agg.src_world = max(agg.src_world, s.src_world)
+        agg.dst_world = max(agg.dst_world, s.dst_world)
+        agg.wall_s += s.wall_s
+    return agg.as_dict()
+
+
+def _restore(base: str, step: int, model, optimizer, grad_scaler,
+             force_gather: bool = False) -> Dict[str, Any]:
     from .. import framework
-    if model is not None:
-        mpath = os.path.join(base, "model")
-        if not os.path.isdir(mpath):
-            raise CheckpointError(
-                f"snapshot {base} has no 'model/' payload (partial save or a "
-                f"model-less snapshot) — cannot restore model weights from it")
-        load_state_dict(mpath, dict(model.state_dict()))
-    info: Dict[str, Any] = {"step": step}
-    opt_path = os.path.join(base, "optimizer.pdopt")
-    if optimizer is not None and os.path.exists(opt_path):
-        optimizer.set_state_dict(framework.io.load(opt_path))
+    reshard_stats = []
+    try:
+        if model is not None:
+            mshards = os.path.join(base, "model.shards")
+            mpath = os.path.join(base, "model")
+            if os.path.isdir(mshards):
+                reshard_stats.append(
+                    _load_sharded_model(mshards, model, force_gather))
+            elif os.path.isdir(mpath):
+                load_state_dict(mpath, dict(model.state_dict()))
+            else:
+                raise CheckpointError(
+                    f"snapshot {base} has no 'model/' payload (partial save "
+                    f"or a model-less snapshot) — cannot restore model "
+                    f"weights from it")
+        info: Dict[str, Any] = {"step": step}
+        oshards = os.path.join(base, "optimizer.shards")
+        opt_path = os.path.join(base, "optimizer.pdopt")
+        if optimizer is not None and os.path.isdir(oshards):
+            reshard_stats.append(
+                _load_sharded_opt(oshards, optimizer, force_gather))
+        elif optimizer is not None and os.path.exists(opt_path):
+            optimizer.set_state_dict(framework.io.load(opt_path))
+        if model is not None and optimizer is not None:
+            # ZeRO eager path: the model restore COMMITS params to the
+            # placement they were saved at (possibly pre-mesh single-device
+            # from a different world size), while the optimizer states live
+            # at this mesh's shard placement — a mixed-device fused update
+            # would be rejected. The sharding wrapper's own all-gather-
+            # after-step placement rule re-places params onto this mesh
+            # (mesh placements kept, pre-mesh params -> mesh-replicated);
+            # compiled TrainStep re-commits in __init__ and is unaffected.
+            replace = getattr(optimizer, "_restore_param_placements", None)
+            if replace is not None:
+                replace()
+    except (_reshard.PartialSnapshotError, FileNotFoundError) as e:
+        # PARTIAL coverage / missing index from the sharded reader behaves
+        # like a torn save: a diagnostic CheckpointError, so auto-resume
+        # falls back past it. A template SHAPE mismatch (plain ValueError —
+        # the snapshot does not fit this network) stays a loud error: a
+        # wrong-architecture resume must never silently start fresh.
+        raise CheckpointError(f"snapshot {base}: {e}") from e
     extra_path = os.path.join(base, "extra.pkl")
     if os.path.exists(extra_path):
         info.update(framework.io.load(extra_path, return_numpy=True))
     if grad_scaler is not None and isinstance(info.get("grad_scaler"), dict):
         grad_scaler.load_state_dict(info["grad_scaler"])
     mon = _monitor._active
+    if reshard_stats:
+        info["reshard"] = _merge_reshard_stats(reshard_stats)
+        if mon is not None:
+            mon.reshard_loaded(**info["reshard"])
     if mon is not None:
         mon.ckpt_resumed(step, base)
     return info
@@ -564,7 +882,8 @@ def _restore(base: str, step: int, model, optimizer, grad_scaler
 
 def load_checkpoint(directory: str, model=None, optimizer=None,
                     step: Optional[int] = None, grad_scaler=None,
-                    verify: bool = True, quarantine: bool = True
+                    verify: bool = True, quarantine: bool = True,
+                    force_gather: bool = False
                     ) -> Optional[Dict[str, Any]]:
     """Resume from the newest committed snapshot (or the given ``step``).
 
@@ -593,7 +912,8 @@ def load_checkpoint(directory: str, model=None, optimizer=None,
                 # operator escape hatch: an EXPLICIT step with verify=False
                 # restores a manifest-less snapshot best-effort (pre-manifest
                 # legacy dirs, or salvage from a quarantine copy)
-                return _restore(base, step, model, optimizer, grad_scaler)
+                return _restore(base, step, model, optimizer, grad_scaler,
+                                force_gather)
             missing = [] if os.path.isdir(os.path.join(base, "model")) \
                 else ["model/"]
             raise CheckpointError(
@@ -610,7 +930,8 @@ def load_checkpoint(directory: str, model=None, optimizer=None,
             if problems:
                 raise CheckpointError(
                     "snapshot failed verification: " + "; ".join(problems))
-        return _restore(base, step, model, optimizer, grad_scaler)
+        return _restore(base, step, model, optimizer, grad_scaler,
+                                force_gather)
 
     all_steps = []
     if os.path.isdir(directory):
@@ -636,7 +957,8 @@ def load_checkpoint(directory: str, model=None, optimizer=None,
                 continue
         if not problems:
             try:
-                return _restore(base, s, model, optimizer, grad_scaler)
+                return _restore(base, s, model, optimizer, grad_scaler,
+                                force_gather)
             except CheckpointError:
                 # verified clean but incompatible with what the caller asked
                 # to restore — skip without destroying valid history
@@ -668,10 +990,13 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, directory: str, keep: int = 3,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None, coordinator=None):
         self.directory = directory
         self.keep = keep
         self._retry = retry
+        # pod-wide commit for multi-rank jobs sharing this directory
+        # (explicit wins; else the launcher env contract; else None)
+        self._coordinator = _resolve_coordinator(coordinator)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._last_path: Optional[str] = None
@@ -695,8 +1020,10 @@ class AsyncCheckpointer:
             try:
                 self._last_path = _write_snapshot(
                     self.directory, step, model_state, opt_state, ex,
-                    self._retry, mode)
-                _prune_committed(self.directory, self.keep, self._last_path)
+                    self._retry, mode, coordinator=self._coordinator)
+                if self._coordinator is None or self._coordinator.rank == 0:
+                    _prune_committed(self.directory, self.keep,
+                                     self._last_path)
             except BaseException as e:  # surfaced on the next call-in
                 self._error = e
 

@@ -14,6 +14,7 @@ endpoint rewrite.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, List, Optional
@@ -36,7 +37,7 @@ class ElasticManager:
 
     def __init__(self, master_endpoint: str, job_id: str, my_endpoint: str,
                  np_target: int, heartbeat_interval: float = 2.0,
-                 ttl: float = 6.0):
+                 ttl: float = 6.0, scale_file: Optional[str] = None):
         self._kv = KVClient(master_endpoint)
         self._prefix = f"/{job_id}/elastic/"
         self._me = my_endpoint
@@ -48,6 +49,14 @@ class ElasticManager:
         self._on_change: Optional[Callable[[List[str]], None]] = None
         self._last_peers: Optional[List[str]] = None
         self.status = ElasticStatus.HOLD
+        # the restart wire back to the launch controller: on membership
+        # change, the SURVIVING world size is written here and the elastic
+        # controller relaunches at that np (its elastic_np control file —
+        # the launcher exports the path as PADDLE_ELASTIC_NP_FILE). The
+        # relaunched workers then resume from the pod-committed checkpoint,
+        # resharded onto the new world (distributed/reshard).
+        self._scale_file = scale_file if scale_file is not None \
+            else os.environ.get("PADDLE_ELASTIC_NP_FILE")
 
     # ------------------------------------------------------------- lifecycle
 
@@ -107,16 +116,42 @@ class ElasticManager:
             except Exception:
                 self._stop.wait(self._interval)
                 continue  # never let a transient error kill the watcher
+            if len(peers) >= self._np:
+                # the target world has fully assembled at least once;
+                # membership changes are meaningful from here on
+                self._formed = True
             if self._last_peers is None:
                 self._last_peers = peers
             elif peers != self._last_peers:
                 # scale-in (dead node) or scale-out (join): reference rewrites
                 # PADDLE_TRAINER_ENDPOINTS and restarts local trainers
                 self._last_peers = peers
-                self.status = ElasticStatus.RESTART
-                if self._on_change is not None:
-                    self._on_change(peers)
+                if getattr(self, "_formed", False):
+                    # only a FORMED world announces: during staggered
+                    # startup the peer set grows through transient sizes,
+                    # and announcing those would make the controller
+                    # restart a perfectly healthy assembling pod
+                    self.status = ElasticStatus.RESTART
+                    self._announce_world(len(peers))
+                    if self._on_change is not None:
+                        self._on_change(peers)
             self._stop.wait(self._interval)
+
+    def _announce_world(self, np_new: int):
+        """Tell the launch controller to restart at the surviving world size
+        (atomic write of its elastic_np control file). Best-effort: with no
+        scale file configured, the controller's own liveness watch still
+        scales in on worker death — this wire just makes scale-out and
+        multi-node membership changes restart-driven too."""
+        if not self._scale_file or np_new < 1:
+            return
+        try:
+            tmp = f"{self._scale_file}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(str(int(np_new)))
+            os.replace(tmp, self._scale_file)
+        except OSError:
+            pass  # the controller keeps its current np until a writable beat
 
     # ------------------------------------------------------------------ info
 

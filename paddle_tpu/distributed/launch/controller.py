@@ -230,6 +230,17 @@ class PodController:
             # carry pickles); generated/shared once per job in _bus_token
             "PADDLE_BUS_TOKEN": self._token,
         })
+        if ctx.master:
+            # the KV master doubles as the pod-wide checkpoint-commit
+            # coordinator (distributed/reshard/commit.py): rank 0 stamps a
+            # snapshot's COMMIT only after every rank acked its payload
+            env["PADDLE_CKPT_MASTER"] = ctx.master
+        if ctx.elastic_level > 0 and ctx.log_dir:
+            # ElasticManager's restart wire: a worker that observes a
+            # membership change writes the surviving np here and this
+            # controller relaunches at that world size
+            env["PADDLE_ELASTIC_NP_FILE"] = os.path.join(ctx.log_dir,
+                                                         "elastic_np")
         if ctx.devices is not None:
             devices = ctx.devices.split(",")
             if ctx.nproc_per_node > 1:
@@ -419,13 +430,20 @@ class PodController:
                     time.sleep(0.3)
                     rc = self._poll()
                     want = desired_np()
-                    if rc is None and want is not None and want > np_now:
-                        print(f"[launch] elastic scale-OUT requested: "
-                              f"{np_now} -> {want}", file=sys.stderr)
+                    if rc is None and want is not None and want != np_now:
+                        # scale-out (operator control file) or scale-in
+                        # (ElasticManager announced a smaller surviving
+                        # world): restart the pod at the requested np; the
+                        # workers resume from their pod-committed
+                        # checkpoint, resharded onto the new world size
+                        direction = "OUT" if want > np_now else "IN"
+                        print(f"[launch] elastic scale-{direction} "
+                              f"requested: {np_now} -> {want}",
+                              file=sys.stderr)
                         self._terminate()
                         np_now = want
                         incarnation += 1
-                        fail_streak = 0  # operator-requested, not a failure
+                        fail_streak = 0  # requested, not a failure
                         break
                 else:
                     self._terminate()

@@ -89,6 +89,14 @@ class KVClient:
         except OSError:
             return None
 
+    def delete(self, key: str) -> bool:
+        req = urllib.request.Request(f"{self._base}{key}", method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
     def get_prefix(self, prefix: str) -> Dict[str, str]:
         try:
             with urllib.request.urlopen(f"{self._base}{prefix}", timeout=5) as r:

@@ -1,0 +1,35 @@
+"""Elastic resharding: resume training across world sizes.
+
+Reference analog: the fleet layer treats world size as a job-LIFETIME
+variable (HybridCommunicateGroup + elastic launch) — preempted pods come
+back smaller or larger and training continues. This package closes that
+loop for the checkpoint path:
+
+* :mod:`snapshot` — per-shard payloads: each rank persists only its
+  host-addressable blocks, under a rank-indexed block map recording every
+  array's global shape, sharding spec, and tiling;
+* :mod:`plan` — the N→M geometry: byte-identical N→N fast path,
+  index-mapped reads when shard boundaries nest, gather-then-re-place
+  fallback otherwise;
+* :mod:`commit` — pod-wide commit over the launcher's KV master: rank 0
+  stamps the COMMIT manifest only after every rank acked a durable payload,
+  so a multi-host snapshot is atomic fleet-wide.
+
+``distributed/checkpoint.py`` routes through this package automatically:
+saves of sharded state write the per-shard format, and
+``load_checkpoint``/``AutoCheckpoint``/``TrainStep.load_checkpoint``
+transparently reshard an N-way snapshot onto the current mesh.
+"""
+from .commit import PodCommit, PodCommitError, from_env as pod_commit_from_env
+from .plan import ReshardPlan, classify, normalize_index, target_indices
+from .snapshot import (PartialSnapshotError, ReshardStats, StagedArray,
+                       coverage_problems, flatten_state, is_sharded_array,
+                       load_sharded, read_index, save_sharded, stage,
+                       unflatten_state)
+
+__all__ = ["PodCommit", "PodCommitError", "pod_commit_from_env",
+           "ReshardPlan", "classify", "normalize_index", "target_indices",
+           "PartialSnapshotError", "ReshardStats", "StagedArray",
+           "coverage_problems", "flatten_state", "is_sharded_array",
+           "load_sharded", "read_index", "save_sharded", "stage",
+           "unflatten_state"]

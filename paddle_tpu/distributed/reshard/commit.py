@@ -1,0 +1,234 @@
+"""Pod-wide commit: a multi-host snapshot is atomic fleet-wide.
+
+Reference analog: the fleet checkpoint barrier — the elastic manager only
+trusts a snapshot every worker finished, because a relaunch that resumes
+from a half-written multi-host save silently loses ranks' state. Here the
+launcher's HTTP KV master (launch/master.py — the store ElasticManager
+already heartbeats through) doubles as the commit coordinator:
+
+    rank 0                                 rank r > 0
+    ------                                 ----------
+    mkdir step_N.tmp
+    write rank_0 payload
+    PUT  .../<step>/token = <random>  -->  poll token (the tmp dir exists)
+                                           write rank_r payload, fsync
+                                      <--  PUT .../<step>/ack/<r> =
+                                               {token, ts, files, bytes}
+    poll acks (token match, ts fresh)
+    rename tmp -> final
+    build + write COMMIT manifest
+    PUT .../<step>/commit = {token}   -->  poll commit -> done
+
+A SIGKILL anywhere between a rank's payload landing and rank 0's COMMIT
+write leaves the directory manifest-less — invisible to
+``latest_checkpoint`` on EVERY rank, which is the whole point. Ack keys
+carry a wall-clock stamp and a per-save random token: a crashed previous
+incarnation re-saving the same step can never satisfy this save's barrier
+(token mismatch), and acks older than ``ttl`` are ignored even on token
+match (a wedged rank's ancient ack must not vouch for bytes that later
+writes may have replaced).
+"""
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["PodCommit", "PodCommitError", "from_env"]
+
+
+class PodCommitError(RuntimeError):
+    """The pod barrier failed (timeout / master unreachable); the save is
+    NOT committed anywhere — the message names the missing ranks."""
+
+
+class PodCommit:
+    """One job's commit coordinator over the KV master."""
+
+    def __init__(self, endpoint: str, job_id: str, rank: int, world: int,
+                 timeout: float = 300.0, ttl: float = 900.0,
+                 poll: float = 0.1, scope: str = ""):
+        from ..launch.master import KVClient
+        self._kv = KVClient(endpoint)
+        self.endpoint = endpoint
+        self.job_id = job_id
+        self.rank = int(rank)
+        self.world = int(world)
+        self.timeout = timeout
+        self.ttl = ttl
+        self.poll = poll
+        self.scope = scope
+        # tokens this rank has already completed a save with, keyed
+        # (scope, step): a RE-save of the same step must not accept the
+        # previous save's still-published token as "rank 0 is ready" (see
+        # wait_ready). SHARED across for_dir clones — the memory must
+        # survive the per-save scoping copy.
+        self._done_tokens: Dict[Any, str] = {}
+
+    def for_dir(self, directory: str) -> "PodCommit":
+        """A copy whose barrier keys are scoped to one snapshot directory:
+        two jobs-phases saving to DIFFERENT directories at the same step
+        must not satisfy each other's barriers. The completed-token memory
+        is shared with the parent (clones are per-save)."""
+        import hashlib
+        scope = hashlib.sha256(
+            os.path.abspath(directory).encode()).hexdigest()[:12]
+        clone = PodCommit(self.endpoint, self.job_id, self.rank, self.world,
+                          timeout=self.timeout, ttl=self.ttl, poll=self.poll,
+                          scope=scope)
+        clone._done_tokens = self._done_tokens
+        return clone
+
+    # ------------------------------------------------------------------ keys
+
+    def _key(self, step: int, tail: str) -> str:
+        scope = f"{self.scope}/" if self.scope else ""
+        return f"/{self.job_id}/ckpt/{scope}{int(step)}/{tail}"
+
+    def _wait(self, key: str, pred, what: str) -> str:
+        deadline = time.time() + self.timeout
+        while True:
+            v = self._kv.get(key)
+            if v is not None and pred(v):
+                return v
+            if time.time() > deadline:
+                raise PodCommitError(
+                    f"pod commit: rank {self.rank} timed out after "
+                    f"{self.timeout:.0f}s waiting for {what} "
+                    f"(key {key} on {self.endpoint})")
+            time.sleep(self.poll)
+
+    # ---------------------------------------------------------------- rank 0
+
+    def publish_ready(self, step: int) -> str:
+        """The tmp dir exists and rank 0's own payload is in it: open this
+        save's barrier window under a fresh token.
+
+        Stale keys from a PREVIOUS save of this step (a post-rollback
+        re-save) are deleted first — most importantly the old ``commit``
+        key, which a sibling rank could otherwise read together with the
+        old token and return success without ever writing its payload."""
+        for r in range(1, self.world):
+            self._kv.delete(self._key(step, f"ack/{r}"))
+        self._kv.delete(self._key(step, "commit"))
+        token = secrets.token_hex(8)
+        if not self._kv.put(self._key(step, "token"), token):
+            raise PodCommitError(
+                f"pod commit: cannot reach KV master {self.endpoint} "
+                f"to open the step {step} barrier")
+        return token
+
+    def wait_acks(self, step: int, token: str) -> Dict[int, dict]:
+        """Block until every non-zero rank acked this token (fresh)."""
+        acks: Dict[int, dict] = {}
+        deadline = time.time() + self.timeout
+        while len(acks) < self.world - 1:
+            for r in range(1, self.world):
+                if r in acks:
+                    continue
+                v = self._kv.get(self._key(step, f"ack/{r}"))
+                if v is None:
+                    continue
+                try:
+                    a = json.loads(v)
+                except ValueError:
+                    continue
+                if a.get("token") != token:
+                    continue  # another incarnation's ack
+                if abs(time.time() - float(a.get("ts", 0))) > self.ttl:
+                    continue  # expired: do not trust these bytes
+                acks[r] = a
+            if len(acks) >= self.world - 1:
+                break
+            if time.time() > deadline:
+                missing = sorted(set(range(1, self.world)) - set(acks))
+                raise PodCommitError(
+                    f"pod commit: step {step} barrier timed out after "
+                    f"{self.timeout:.0f}s — no durable-payload ack from "
+                    f"rank(s) {missing}; snapshot left uncommitted")
+            time.sleep(self.poll)
+        return acks
+
+    def publish_commit(self, step: int, token: str, path: str):
+        """Announce the on-disk COMMIT to the waiting ranks. The manifest is
+        already durable when this runs, so a KV hiccup must not look like a
+        failed save: retry briefly, then WARN and return — the sibling
+        ranks' wait_commit timeout is the honest signal of the coordination
+        (not data) failure, and the snapshot stays fully resumable."""
+        body = json.dumps({"token": token, "ts": time.time(), "path": path})
+        deadline = time.time() + min(self.timeout, 30.0)
+        while not self._kv.put(self._key(step, "commit"), body):
+            if time.time() > deadline:
+                import warnings
+                warnings.warn(
+                    f"pod commit: step {step} IS committed on disk but the "
+                    f"KV master {self.endpoint} could not be told — sibling "
+                    f"ranks will time out waiting for the commit key",
+                    RuntimeWarning)
+                return
+            time.sleep(self.poll)
+
+    # -------------------------------------------------------------- rank > 0
+
+    def wait_ready(self, step: int) -> str:
+        """Block until rank 0 opened the barrier; returns the save token.
+
+        A token this rank already COMPLETED a save of this step with is the
+        previous barrier's leftover, not rank 0 being ready — keep polling
+        until rank 0 publishes a fresh one (publish_ready also deletes the
+        stale commit key, so the old token cannot reach a false success)."""
+        done = self._done_tokens.get((self.scope, int(step)))
+        return self._wait(self._key(step, "token"),
+                          lambda v: bool(v) and v != done,
+                          "rank 0 to open the save window")
+
+    def ack(self, step: int, token: str, info: Optional[Dict[str, Any]] = None):
+        """My payload is durable (written + fsynced) under the tmp dir."""
+        body = {"token": token, "ts": time.time(), "rank": self.rank}
+        body.update(info or {})
+        if not self._kv.put(self._key(step, f"ack/{self.rank}"),
+                            json.dumps(body)):
+            raise PodCommitError(
+                f"pod commit: rank {self.rank} cannot reach KV master "
+                f"{self.endpoint} to ack step {step}")
+
+    def wait_commit(self, step: int, token: str) -> dict:
+        v = self._wait(self._key(step, "commit"),
+                       lambda v: _token_of(v) == token,
+                       "rank 0's pod-wide COMMIT")
+        # supersession guard: if rank 0 has already opened a NEWER barrier
+        # for this step, the commit we just matched is history — our
+        # payload is not part of whatever is durable now
+        current = self._kv.get(self._key(step, "token"))
+        if current is not None and current != token:
+            raise PodCommitError(
+                f"pod commit: step {step} was superseded by a newer save "
+                f"while rank {self.rank} waited for the COMMIT")
+        self._done_tokens[(self.scope, int(step))] = token
+        return json.loads(v)
+
+
+def _token_of(v: str):
+    try:
+        return json.loads(v).get("token")
+    except ValueError:
+        return None
+
+
+def from_env(timeout: Optional[float] = None) -> Optional[PodCommit]:
+    """Build the coordinator from the launcher env contract, or None for
+    single-process jobs. ``PADDLE_CKPT_MASTER`` (the KV master endpoint) is
+    exported by the launch controller when a rendezvous master exists."""
+    endpoint = os.environ.get("PADDLE_CKPT_MASTER")
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    except ValueError:
+        world = 1
+    if not endpoint or world <= 1:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    job = os.environ.get("PADDLE_JOB_ID", "default")
+    kw = {} if timeout is None else {"timeout": timeout}
+    return PodCommit(endpoint, job, rank, world, **kw)

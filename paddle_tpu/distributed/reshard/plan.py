@@ -1,0 +1,185 @@
+"""Reshard geometry: map an N-way block-partitioned checkpoint onto an M-way
+target sharding.
+
+Reference analog: the fleet layer's elastic relaunch (elastic/manager.py)
+plus the GroupSharded save/load pair — the reference persists each rank's
+shard and rebuilds state dicts for whatever world size comes back. Here the
+same idea is expressed as pure slice geometry over the saved **block index
+map** (every array's global shape + the index each saved block covers):
+
+* **identity** — target shard cuts equal the source block cuts: each target
+  shard IS one saved block, passed through byte-identical (the N→N resume
+  fast path; no slicing, no concatenation, no gather).
+* **index-mapped** — the cut sets nest per dimension (every boundary of one
+  is a boundary of the other, the N%M==0 / M%N==0 family, plus N→1 and
+  1→M): each target shard is assembled from whole blocks and/or one
+  contiguous sub-slice per block, reading only the bytes that land on this
+  shard. Peak memory is one target shard, never the global array.
+* **gather** — boundaries cross (3→2, or the sharded dim moved because the
+  target world divides a different dimension): materialize the global array
+  once from its blocks, then re-place. Correct everywhere, costs a
+  full-array host buffer; :mod:`tools.metrics_summary` WARNs when a
+  *nestable* world pair still lands here (an array's spec moved dims).
+
+Pure numpy + slice math; jax enters only at :func:`place` (building the
+target ``jax.Array`` via ``make_array_from_callback``).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["normalize_index", "target_indices", "classify", "ReshardPlan"]
+
+Index = Tuple[Tuple[int, int], ...]  # ((start, stop), ...) per dim, concrete
+
+
+def normalize_index(idx, shape) -> Index:
+    """A tuple-of-slices (jax ``devices_indices_map`` style, Nones allowed)
+    -> concrete ((start, stop), ...) covering exactly the same region."""
+    out = []
+    for i, dim in enumerate(shape):
+        sl = idx[i] if idx is not None and i < len(idx) else slice(None)
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def target_indices(sharding, shape) -> List[Index]:
+    """Distinct shard regions of ``sharding`` over ``shape`` (replicas
+    deduplicated), sorted for determinism."""
+    if sharding is None:
+        return [normalize_index(None, shape)]
+    seen = {}
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        seen.setdefault(normalize_index(idx, shape), True)
+    return sorted(seen)
+
+
+def _cuts(indices: Sequence[Index], ndim: int) -> List[set]:
+    """Per-dimension boundary sets of a block partition."""
+    cuts = [set() for _ in range(ndim)]
+    for idx in indices:
+        for d, (a, b) in enumerate(idx):
+            cuts[d].add(a)
+            cuts[d].add(b)
+    return cuts
+
+
+def classify(src_indices: Sequence[Index], dst_indices: Sequence[Index],
+             ndim: int) -> str:
+    """'identity' | 'mapped' | 'gather' for this (source blocks, target
+    shards) pair — see the module docstring for the semantics."""
+    if set(src_indices) == set(dst_indices):
+        return "identity"
+    sc, dc = _cuts(src_indices, ndim), _cuts(dst_indices, ndim)
+    for d in range(ndim):
+        if not (sc[d] <= dc[d] or dc[d] <= sc[d]):
+            return "gather"
+    return "mapped"
+
+
+def _intersect(a: Index, b: Index) -> Optional[Index]:
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def _local(region: Index, base: Index) -> Tuple[slice, ...]:
+    """``region`` re-expressed in the coordinates of the ``base`` block."""
+    return tuple(slice(a - b0, b - b0)
+                 for (a, b), (b0, _b1) in zip(region, base))
+
+
+def _nbytes(idx: Index, itemsize: int) -> int:
+    return itemsize * int(math.prod(b - a for a, b in idx) or 1)
+
+
+class ReshardPlan:
+    """One array's read plan: saved blocks -> target shard regions.
+
+    ``blocks`` maps each saved block's :data:`Index` to a zero-argument
+    reader returning its numpy payload (readers are memoized here, so a
+    block feeding several target shards loads once)."""
+
+    def __init__(self, shape, dtype,
+                 blocks: Dict[Index, Callable[[], np.ndarray]],
+                 dst_indices: Sequence[Index]):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self._blocks = dict(blocks)
+        self._cache: Dict[Index, np.ndarray] = {}
+        self.dst_indices = list(dst_indices)
+        self.kind = classify(list(blocks), self.dst_indices, len(self.shape))
+        self.bytes_read = 0
+        self._full: Optional[np.ndarray] = None
+        self._shards: Dict[Index, np.ndarray] = {}
+
+    # ------------------------------------------------------------- plumbing
+
+    def _read(self, idx: Index) -> np.ndarray:
+        """The block's array — possibly a lazy memmap: bytes_read is
+        accounted where regions are actually consumed (shard/_gathered),
+        not here, so an index-mapped load is charged only for the slices
+        it copies out."""
+        arr = self._cache.get(idx)
+        if arr is None:
+            arr = self._blocks[idx]()
+            self._cache[idx] = arr
+        return arr
+
+    def _gathered(self) -> np.ndarray:
+        if self._full is None:
+            full = np.empty(self.shape, self.dtype)
+            for idx in self._blocks:
+                full[tuple(slice(a, b) for a, b in idx)] = self._read(idx)
+                self.bytes_read += _nbytes(idx, self.dtype.itemsize)
+            self._full = full
+        return self._full
+
+    # ------------------------------------------------------------------ api
+
+    def shard(self, dst: Index) -> np.ndarray:
+        """The numpy payload for one target shard region."""
+        out = self._shards.get(dst)
+        if out is not None:
+            return out
+        if self.kind == "identity":
+            # the saved block IS the shard: materialize it byte-exact
+            out = np.asarray(self._read(dst))
+            self.bytes_read += _nbytes(dst, self.dtype.itemsize)
+        elif self.kind == "gather":
+            out = self._gathered()[tuple(slice(a, b) for a, b in dst)]
+        else:
+            shape = tuple(b - a for a, b in dst)
+            out = np.empty(shape, self.dtype)
+            for bidx in self._blocks:
+                inter = _intersect(bidx, dst)
+                if inter is None:
+                    continue
+                out[_local(inter, dst)] = self._read(bidx)[_local(inter, bidx)]
+                self.bytes_read += _nbytes(inter, self.dtype.itemsize)
+        self._shards[dst] = out
+        return out
+
+    def place(self, sharding=None):
+        """Materialize the target array: a ``jax.Array`` at ``sharding``
+        (replicas served from the per-region cache — each distinct region is
+        assembled once), or plain numpy when ``sharding`` is None."""
+        if sharding is None:
+            if self.kind == "identity" and len(self._blocks) == 1:
+                return self.shard(next(iter(self._blocks)))
+            return self._gathered()
+        import jax
+
+        def cb(raw_idx):
+            return self.shard(normalize_index(raw_idx, self.shape))
+
+        return jax.make_array_from_callback(self.shape, sharding, cb)

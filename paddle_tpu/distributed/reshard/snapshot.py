@@ -1,0 +1,460 @@
+"""Per-shard checkpoint payloads with a rank-indexed block map.
+
+Reference analog: GroupSharded save paths — every rank persists the shards
+it owns, and a manifest records how they tile each global array. This is the
+on-disk format the elastic resume path reshards from:
+
+    <payload>.shards/
+        index.rank<r>.json      one per writing rank (schema below)
+        skeleton.pkl            rank 0: the state structure with arrays
+                                replaced by {"__reshard_array__": <key>}
+        rank_<r>/a<i>_b<j>.bin  raw C-order bytes of one block
+
+Index schema (per rank)::
+
+    {"schema": 1, "rank": r,
+     "arrays": {<key>: {
+         "shape": [...], "dtype": "float32",
+         "spec": [null, "sharding", ["data", "model"], ...],   # per dim
+         "mesh": {"data": 2, "sharding": 4},                   # axis sizes
+         "blocks": [{"file": "rank_0/a0_b0.bin",
+                     "index": [[0, 8], [0, 16]]}],              # MY blocks
+         "all_blocks": [{"index": [[0, 8], [0, 16]], "owner": 0}, ...]}}}
+
+``all_blocks`` is the full tiling every rank can compute from the array's
+global sharding metadata; ``blocks`` are the ones THIS rank persisted. A
+snapshot whose union of present blocks does not cover ``all_blocks`` is
+PARTIAL — ``tools/ckpt_inspect.py`` flags it and :func:`load_sharded`
+refuses it (a rank's payload never landed).
+
+Keys are JSON-encoded paths into the (nested) state structure, so array
+names may contain any character. Non-array leaves (step counters, LR
+scheduler state) ride in the rank-0 skeleton pickle.
+
+Raw ``.bin`` blocks instead of ``.npy``: extended dtypes (bfloat16) do not
+survive ``np.save``, and a headerless block is byte-comparable across
+worlds — the N→N fast path's "byte-identical" contract is literal.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .plan import Index, ReshardPlan, normalize_index, target_indices
+
+__all__ = ["StagedArray", "stage", "is_sharded_array", "flatten_state",
+           "unflatten_state", "save_sharded", "load_sharded", "read_index",
+           "coverage_problems", "ReshardStats", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+_MARKER = "__reshard_array__"
+
+
+class PartialSnapshotError(ValueError):
+    """The present rank payloads do not cover the block index map — a
+    rank's shards never landed (or were lost). Distinct from a template
+    shape mismatch: resume treats PARTIAL like a torn save (skip and fall
+    back), while a snapshot that does not FIT the network must stay a loud
+    error."""
+
+
+def _dtype_name(dt) -> str:
+    return np.dtype(dt).name
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax ships it; bfloat16/float8 live here
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _spec_json(sharding) -> Tuple[Optional[list], Dict[str, int]]:
+    """(per-dim spec, mesh axis sizes) of a NamedSharding, JSON-ready."""
+    from jax.sharding import NamedSharding
+    if not isinstance(sharding, NamedSharding):
+        return None, {}
+    spec = []
+    for s in tuple(sharding.spec):
+        spec.append(list(s) if isinstance(s, tuple) else s)
+    return spec, {str(k): int(v) for k, v in sharding.mesh.shape.items()}
+
+
+class StagedArray:
+    """One array staged to host as per-shard numpy blocks.
+
+    This is what :func:`paddle_tpu.distributed.checkpoint._host_copy` now
+    produces for sharded arrays: only the shards THIS process can address
+    are copied (``blocks``), never the assembled global array — the PR 4
+    carve-out where non-fully-addressable arrays kept live jax references
+    is closed by construction. ``all_blocks`` (index -> owner rank) is the
+    global tiling used for the manifest's coverage map."""
+
+    def __init__(self, shape, dtype_name: str, spec, mesh_axes,
+                 blocks: Dict[Index, np.ndarray],
+                 all_blocks: Dict[Index, int]):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype_name = dtype_name
+        self.spec = spec
+        self.mesh_axes = dict(mesh_axes)
+        self.blocks = blocks          # index -> numpy payload (host copies)
+        self.all_blocks = all_blocks  # index -> owner rank
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self.blocks.values())
+
+
+def is_sharded_array(a) -> bool:
+    """True when ``a`` must go through the per-shard format: it spans
+    devices this process cannot address, or its NamedSharding actually
+    splits a dimension (a mesh-replicated array is neither)."""
+    import jax
+    from jax.sharding import NamedSharding
+    if not isinstance(a, jax.Array):
+        return False
+    if not getattr(a, "is_fully_addressable", True):
+        return True
+    sh = getattr(a, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return False
+    for s in tuple(sh.spec):
+        axes = s if isinstance(s, tuple) else ((s,) if s is not None else ())
+        for ax in axes:
+            if sh.mesh.shape.get(ax, 1) > 1:
+                return True
+    return False
+
+
+def stage(a, rank: Optional[int] = None) -> StagedArray:
+    """Host-stage a jax array per shard. Each distinct shard region is
+    copied once; regions replicated across processes are owned by the
+    lowest process index holding them (that rank persists the bytes)."""
+    import jax
+    if rank is None:
+        rank = jax.process_index()
+    shape = tuple(a.shape)
+    spec, mesh_axes = _spec_json(getattr(a, "sharding", None))
+    owners: Dict[Index, int] = {}
+    sh = getattr(a, "sharding", None)
+    if sh is not None:
+        for dev, raw in sh.devices_indices_map(shape).items():
+            idx = normalize_index(raw, shape)
+            proc = getattr(dev, "process_index", 0)
+            if idx not in owners or proc < owners[idx]:
+                owners[idx] = proc
+    else:
+        owners[normalize_index(None, shape)] = rank
+    blocks: Dict[Index, np.ndarray] = {}
+    for shard in getattr(a, "addressable_shards", ()):
+        idx = normalize_index(shard.index, shape)
+        if owners.get(idx, rank) == rank and idx not in blocks:
+            blocks[idx] = np.ascontiguousarray(np.asarray(shard.data))
+    if not blocks and owners and rank in owners.values():
+        # no .addressable_shards (plain numpy fed through): whole array
+        blocks[normalize_index(None, shape)] = np.ascontiguousarray(
+            np.asarray(a))
+    return StagedArray(shape, _dtype_name(a.dtype), spec, mesh_axes,
+                       blocks, owners)
+
+
+# -------------------------------------------------------------- state walking
+
+def _is_array_leaf(v) -> bool:
+    import jax
+    from ...core.tensor import Tensor
+    return isinstance(v, (jax.Array, np.ndarray, Tensor, StagedArray))
+
+
+def flatten_state(state) -> Tuple[Dict[str, Any], Any]:
+    """(flat arrays keyed by JSON path, skeleton with markers). The skeleton
+    preserves every non-array leaf (ints, floats, scheduler dicts) in
+    place."""
+    flat: Dict[str, Any] = {}
+
+    def walk(obj, path):
+        from ...core.tensor import Tensor
+        if isinstance(obj, Tensor):
+            obj = obj.value()
+        if _is_array_leaf(obj):
+            key = json.dumps(path)
+            flat[key] = obj
+            return {_MARKER: key}
+        if isinstance(obj, dict):
+            return {k: walk(v, path + [str(k)]) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [walk(v, path + [i]) for i, v in enumerate(obj)]
+            return out if isinstance(obj, list) else tuple(out)
+        return obj
+
+    skeleton = walk(state, [])
+    return flat, skeleton
+
+
+def unflatten_state(skeleton, flat: Dict[str, Any]):
+    if isinstance(skeleton, dict):
+        if set(skeleton) == {_MARKER}:
+            return flat[skeleton[_MARKER]]
+        return {k: unflatten_state(v, flat) for k, v in skeleton.items()}
+    if isinstance(skeleton, (list, tuple)):
+        out = [unflatten_state(v, flat) for v in skeleton]
+        return out if isinstance(skeleton, list) else tuple(out)
+    return skeleton
+
+
+# --------------------------------------------------------------------- saving
+
+def save_sharded(path: str, state, rank: int = 0,
+                 write_skeleton: Optional[bool] = None) -> Dict[str, Any]:
+    """Write this rank's blocks + index under ``path``. Returns a summary
+    ({"files": n, "bytes": n}) for the pod-commit ack. The skeleton (the
+    state structure around the arrays) is written when ``write_skeleton``
+    (default: rank 0 — pod mode's lead writer; per-rank-private directories
+    pass True so each directory is self-contained)."""
+    os.makedirs(path, exist_ok=True)
+    rank_dir = os.path.join(path, f"rank_{rank}")
+    os.makedirs(rank_dir, exist_ok=True)
+    flat, skeleton = flatten_state(state)
+    index = {"schema": SCHEMA_VERSION, "rank": int(rank), "arrays": {}}
+    files = 0
+    total = 0
+    for i, (key, val) in enumerate(flat.items()):
+        staged = val if isinstance(val, StagedArray) else stage(val, rank)
+        entry = {"shape": list(staged.shape), "dtype": staged.dtype_name,
+                 "spec": staged.spec,
+                 "mesh": staged.mesh_axes,
+                 "blocks": [],
+                 "all_blocks": [{"index": [list(ab) for ab in idx],
+                                 "owner": owner}
+                                for idx, owner in sorted(
+                                    staged.all_blocks.items())]}
+        for j, (idx, data) in enumerate(sorted(staged.blocks.items())):
+            rel = f"rank_{rank}/a{i}_b{j}.bin"
+            with open(os.path.join(path, rel), "wb") as f:
+                f.write(np.ascontiguousarray(data).tobytes())
+            entry["blocks"].append({"file": rel,
+                                    "index": [list(ab) for ab in idx]})
+            files += 1
+            total += data.nbytes
+        index["arrays"][key] = entry
+    if write_skeleton if write_skeleton is not None else rank == 0:
+        from ... import framework
+        framework.io.save(skeleton, os.path.join(path, "skeleton.pkl"))
+        files += 1
+    with open(os.path.join(path, f"index.rank{rank}.json"), "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+    files += 1
+    return {"files": files, "bytes": total}
+
+
+# -------------------------------------------------------------------- loading
+
+def read_index(path: str) -> Dict[str, Any]:
+    """Merge every rank's index under ``path``: {key: meta + present blocks}.
+    Raises FileNotFoundError when no index exists (not a sharded payload)."""
+    ranks = []
+    merged: Dict[str, Any] = {}
+    for name in sorted(os.listdir(path)):
+        if not (name.startswith("index.rank") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(path, name)) as f:
+                idx = json.load(f)
+        except (OSError, ValueError):
+            # a rotted rank index reads as that rank's payload missing:
+            # coverage flags the gap (PARTIAL) and auto-resume falls back,
+            # instead of a raw JSONDecodeError crashing the resume scan
+            continue
+        ranks.append(int(idx.get("rank", 0)))
+        for key, entry in idx.get("arrays", {}).items():
+            tgt = merged.setdefault(key, {"shape": entry["shape"],
+                                          "dtype": entry["dtype"],
+                                          "spec": entry.get("spec"),
+                                          "mesh": entry.get("mesh", {}),
+                                          "blocks": [],
+                                          "all_blocks":
+                                              entry.get("all_blocks", [])})
+            tgt["blocks"].extend(entry.get("blocks", []))
+    if not merged and not ranks:
+        raise FileNotFoundError(f"{path}: no index.rank*.json")
+    return {"ranks": sorted(set(ranks)), "arrays": merged}
+
+
+def _entry_indices(entry) -> Dict[Index, str]:
+    return {tuple(tuple(ab) for ab in b["index"]): b["file"]
+            for b in entry["blocks"]}
+
+
+def coverage_problems(index: Dict[str, Any], path: Optional[str] = None
+                      ) -> List[str]:
+    """PARTIAL detection: every ``all_blocks`` region must have a present
+    block (and, when ``path`` is given, a file of the right size)."""
+    problems = []
+    for key, entry in sorted(index["arrays"].items()):
+        present = _entry_indices(entry)
+        itemsize = _resolve_dtype(entry["dtype"]).itemsize
+        for ab in entry["all_blocks"]:
+            idx = tuple(tuple(x) for x in ab["index"])
+            rel = present.get(idx)
+            if rel is None:
+                problems.append(
+                    f"{key}: block {idx} (owner rank {ab.get('owner')}) "
+                    f"missing — rank payload never landed")
+                continue
+            if path is not None:
+                p = os.path.join(path, rel)
+                # prod(()) == 1 covers scalars; a genuinely zero-size dim
+                # means a legitimately 0-byte block — no `or 1` fudge, or
+                # every snapshot holding an empty array self-rejects
+                want = itemsize * int(math.prod(b - a for a, b in idx))
+                if not os.path.isfile(p):
+                    problems.append(f"{key}: {rel} missing on disk")
+                elif os.path.getsize(p) != want:
+                    problems.append(f"{key}: {rel} is "
+                                    f"{os.path.getsize(p)} bytes, expected "
+                                    f"{want}")
+    return problems
+
+
+def _src_world(entry) -> int:
+    """Sharded degree of one saved array: product of mesh axis sizes its
+    spec actually uses (1 for replicated/unsharded)."""
+    world = 1
+    mesh = entry.get("mesh") or {}
+    seen = set()
+    for s in entry.get("spec") or []:
+        axes = s if isinstance(s, list) else ([s] if s is not None else [])
+        for ax in axes:
+            if ax not in seen:
+                seen.add(ax)
+                world *= int(mesh.get(ax, 1))
+    return world
+
+
+class ReshardStats:
+    """What a sharded load did, for the reshard/* gauges."""
+
+    def __init__(self):
+        self.arrays = 0
+        self.identity = 0
+        self.mapped = 0
+        self.gathered = 0
+        self.nestable_gather = 0
+        self.bytes_read = 0
+        self.src_world = 1
+        self.dst_world = 1
+        self.wall_s = 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(vars(self))
+
+
+def _nestable(n: int, m: int) -> bool:
+    return n > 0 and m > 0 and (n % m == 0 or m % n == 0)
+
+
+def load_sharded(path: str, template: Optional[Dict[str, Any]] = None,
+                 partial_ok: bool = False, force_gather: bool = False
+                 ) -> Tuple[Dict[str, Any], Any, ReshardStats]:
+    """Load a sharded payload, resharding onto the template's placements.
+
+    ``template``: flat {json-path-key: array-with-target-sharding} (from
+    :func:`flatten_state` over the live state). Keys absent from the
+    template load to host numpy; template keys absent from the snapshot are
+    ignored (the caller decides whether that is an error). Returns
+    ``(flat arrays, skeleton, stats)``; shape mismatches raise ValueError
+    naming the key (restoring through a mismatched template would silently
+    truncate — the load_state_dict contract). ``force_gather`` routes every
+    array through the gather fallback — the trivially-correct path the
+    index-mapped reader is tested against."""
+    import time
+    t0 = time.perf_counter()
+    index = read_index(path)
+    problems = coverage_problems(index, path)
+    if problems and not partial_ok:
+        raise PartialSnapshotError(
+            f"{path}: PARTIAL sharded snapshot — " + "; ".join(problems[:4])
+            + (f" (+{len(problems) - 4} more)"
+               if len(problems) > 4 else ""))
+    from ... import framework
+    skel_path = os.path.join(path, "skeleton.pkl")
+    try:
+        skeleton = framework.io.load(skel_path) \
+            if os.path.exists(skel_path) else None
+    except Exception as e:
+        # a rotted skeleton is the same class of fault as a lost rank
+        # payload: resume must fall back past it, not crash on unpickling
+        raise PartialSnapshotError(
+            f"{path}: skeleton.pkl unreadable ({type(e).__name__}: {e})")
+    stats = ReshardStats()
+    template = template or {}
+    out: Dict[str, Any] = {}
+    for key, entry in index["arrays"].items():
+        shape = tuple(entry["shape"])
+        dtype = _resolve_dtype(entry["dtype"])
+        tmpl = template.get(key)
+        if tmpl is not None:
+            t_arr = tmpl
+            from ...core.tensor import Tensor
+            if isinstance(t_arr, Tensor):
+                t_arr = t_arr.value()
+            if tuple(t_arr.shape) != shape:
+                raise ValueError(
+                    f"reshard load: {json.loads(key)!r} is "
+                    f"{tuple(t_arr.shape)} in this run but {shape} in the "
+                    f"checkpoint ({path}) — the snapshot does not fit")
+            sharding = getattr(t_arr, "sharding", None)
+        else:
+            sharding = None
+        blocks = {}
+        for idx, rel in _entry_indices(entry).items():
+            p = os.path.join(path, rel)
+            if os.path.isfile(p):
+                blocks[idx] = _make_reader(p, dtype, idx)
+        want = {tuple(tuple(x) for x in ab["index"])
+                for ab in entry["all_blocks"]}
+        if not want <= set(blocks):
+            # only reachable under partial_ok (coverage raised above
+            # otherwise): salvage whole arrays, skip the torn one
+            continue
+        plan = ReshardPlan(shape, dtype, blocks,
+                           target_indices(sharding, shape))
+        if force_gather and plan.kind != "identity":
+            plan.kind = "gather"
+        out[key] = plan.place(sharding)
+        stats.arrays += 1
+        stats.bytes_read += plan.bytes_read
+        src_w = _src_world(entry)
+        stats.src_world = max(stats.src_world, src_w)
+        dst_w = len(plan.dst_indices)
+        stats.dst_world = max(stats.dst_world, dst_w)
+        if plan.kind == "identity":
+            stats.identity += 1
+        elif plan.kind == "mapped":
+            stats.mapped += 1
+        else:
+            stats.gathered += 1
+            if _nestable(src_w, dst_w) and not force_gather:
+                stats.nestable_gather += 1
+    stats.wall_s = time.perf_counter() - t0
+    return out, skeleton, stats
+
+
+def _make_reader(path: str, dtype: np.dtype, idx: Index):
+    shape = tuple(b - a for a, b in idx)
+
+    def read() -> np.ndarray:
+        if not shape or 0 in shape:
+            # scalars and zero-size blocks: mmap rejects empty files
+            return np.fromfile(path, dtype=dtype).reshape(shape)
+        # memmap, not fromfile: an index-mapped load slices only its own
+        # regions out of each block, and the OS pages in just those bytes —
+        # a 1->M scale-out must not materialize the full array per shard
+        return np.memmap(path, dtype=dtype, mode="r", shape=shape)
+
+    return read

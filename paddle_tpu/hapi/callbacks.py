@@ -214,7 +214,7 @@ class AutoCheckpoint(Callback):
                  save_secs: Optional[float] = None, keep: int = 3,
                  resume: bool = True, asynchronous: bool = True,
                  grad_scaler=None, watch_signals: bool = True,
-                 verbose: int = 1):
+                 verbose: int = 1, coordinator=None):
         super().__init__()
         if not save_steps and save_secs is None:
             save_steps = 100  # save SOMETHING periodically by default
@@ -227,6 +227,11 @@ class AutoCheckpoint(Callback):
         self.grad_scaler = grad_scaler
         self.watch_signals = watch_signals
         self.verbose = verbose
+        # multi-rank jobs sharing one snapshot directory: a reshard.PodCommit
+        # (or None to adopt the launcher env contract) — snapshots then
+        # commit POD-wide, and an elastic relaunch at a different world size
+        # reshards transparently at the resume below
+        self.coordinator = coordinator
         self._ckptr = None
         self._watcher = None
         self._global_step = 0
@@ -254,7 +259,8 @@ class AutoCheckpoint(Callback):
     def on_train_begin(self, logs=None):
         from ..distributed import checkpoint as _ckpt
         from ..distributed.preemption import PreemptionWatcher
-        self._ckptr = _ckpt.AsyncCheckpointer(self.directory, keep=self.keep)
+        self._ckptr = _ckpt.AsyncCheckpointer(self.directory, keep=self.keep,
+                                              coordinator=self.coordinator)
         self._global_step = 0
         self._last_saved = -1
         self._emergency_done = False
@@ -276,8 +282,15 @@ class AutoCheckpoint(Callback):
                 self._last_saved = self._global_step
                 self.model._resume_step = self._global_step
                 if self.verbose:
+                    rs = info.get("reshard")
+                    detail = ""
+                    if rs:
+                        detail = (f", resharded {rs['src_world']}-way -> "
+                                  f"{rs['dst_world']}-way: {rs['identity']} "
+                                  f"identity / {rs['mapped']} index-mapped / "
+                                  f"{rs['gathered']} gathered arrays")
                     print(f"AutoCheckpoint: resuming from step "
-                          f"{self._global_step} ({self.directory})",
+                          f"{self._global_step} ({self.directory}{detail})",
                           file=sys.stderr)
         # install the process-global handlers only once the fallible resume
         # is done: if it raises, fit unwinds before on_train_abort/-end

@@ -905,20 +905,25 @@ class TrainStep:
     # --------------------------------------------------------- checkpointing
 
     def save_checkpoint(self, directory: str, step: int, extra=None,
-                        keep: int = 3, block: bool = False):
+                        keep: int = 3, block: bool = False,
+                        coordinator=None):
         """Snapshot model + optimizer (+ compiled-in GradScaler) through the
         fault-tolerant checkpoint subsystem — the raw-loop counterpart of
         ``hapi.callbacks.AutoCheckpoint``. Async by default (``block=False``):
-        state is snapshotted to host now, written in the background, at most
-        one save in flight; a prior write error surfaces on the next call.
-        ``block=True`` is the emergency-save form (e.g. after
-        ``PreemptionWatcher.requested()``)."""
+        state is snapshotted to host now (sharded arrays staged PER SHARD),
+        written in the background, at most one save in flight; a prior write
+        error surfaces on the next call. ``block=True`` is the
+        emergency-save form (e.g. after ``PreemptionWatcher.requested()``).
+        ``coordinator``: a ``reshard.PodCommit`` for multi-rank jobs sharing
+        one directory (defaults from the launcher env) — the COMMIT manifest
+        then lands pod-wide, only after every rank's payload is durable."""
         from ..distributed.checkpoint import AsyncCheckpointer
         ckptr = getattr(self, "_ckptr", None)
         if ckptr is None or ckptr.directory != directory:
             if ckptr is not None:
                 ckptr.close()
-            ckptr = AsyncCheckpointer(directory, keep=keep)
+            ckptr = AsyncCheckpointer(directory, keep=keep,
+                                      coordinator=coordinator)
             self._ckptr = ckptr
         ckptr.keep = keep
         ckptr.save(step, model=self._model, optimizer=self._opt,
@@ -933,8 +938,13 @@ class TrainStep:
     def load_checkpoint(self, directory: str, step=None):
         """Resume model/optimizer/scaler from the newest committed snapshot
         (falling back past torn/corrupt ones); returns the checkpoint info
-        dict ({'step': N, ...}) or None when nothing is loadable. The fast
-        path re-adopts the restored arrays on the next call."""
+        dict ({'step': N, ...}) or None when nothing is loadable.
+
+        A snapshot saved at a DIFFERENT world size reshards transparently:
+        per-shard payloads land directly on the live arrays' placements
+        (this TrainStep's mesh commitment from __init__), so the fast path's
+        AOT executables stay valid — ``info["reshard"]`` carries what the
+        load did (index-mapped vs gathered arrays, bytes read)."""
         from ..distributed.checkpoint import load_checkpoint
         return load_checkpoint(directory, model=self._model,
                                optimizer=self._opt, step=step,

@@ -324,6 +324,34 @@ class Monitor:
         self.registry.counter("preempt/signals").inc()
         self.emit("preemption", signum=int(signum))
 
+    def reshard_loaded(self, src_world: int, dst_world: int, arrays: int,
+                       identity: int, mapped: int, gathered: int,
+                       nestable_gather: int, bytes_read: int, wall_s: float):
+        """A checkpoint restore resharded an N-way snapshot onto this mesh.
+
+        ``nestable_gather`` counts arrays that fell back to the
+        gather-then-re-place path even though the WORLD pair nests
+        (N%M==0 or M%N==0) — an array's sharded dim moved between worlds,
+        paying a full-size host buffer the index-mapped reader would have
+        avoided. tools/metrics_summary.py WARNs on it."""
+        g = self.registry.gauge
+        g("reshard/src_world").set(src_world)
+        g("reshard/dst_world").set(dst_world)
+        g("reshard/arrays").set(arrays)
+        g("reshard/arrays_identity").set(identity)
+        g("reshard/arrays_mapped").set(mapped)
+        g("reshard/arrays_gathered").set(gathered)
+        g("reshard/bytes_read").set(bytes_read)
+        self.registry.counter("reshard/loads").inc()
+        if nestable_gather:
+            self.registry.counter("reshard/nestable_gather_fallbacks").inc(
+                nestable_gather)
+        self.registry.histogram("reshard/load_s").observe(wall_s)
+        self.emit("reshard", src_world=src_world, dst_world=dst_world,
+                  arrays=arrays, identity=identity, mapped=mapped,
+                  gathered=gathered, nestable_gather=nestable_gather,
+                  bytes_read=bytes_read, wall_s=wall_s)
+
     # ----------------------------------------------------- integration: serving
 
     def serve_engine(self, max_slots: int, max_len: int, buckets, quantize,

@@ -1,0 +1,105 @@
+"""Elastic-reshard worker (test_reshard_e2e.py).
+
+One incarnation of a ZeRO-sharded compiled training job on a virtual CPU
+mesh whose device count the DRIVER chooses per incarnation
+(``--xla_force_host_platform_device_count``). Every step trains on a
+step-seeded batch (identical across incarnations and world sizes),
+checkpoints synchronously, and logs ``{step, loss, digest, world}`` where
+``digest`` is a SHA-256 over the full params + optimizer moments + global
+step — the bitwise observable the driver compares across world sizes. On
+start it auto-resumes from the shared checkpoint directory, resharding the
+previous incarnation's world onto this one, and logs a ``resume`` record
+with the post-load digest (must equal the digest logged right after the
+step that produced the snapshot).
+
+argv: outdir ckptdir incarnation steps_total [die_save_step]
+``die_save_step``: export PADDLE_CKPT_FAULT=die_before_commit:<n> before
+the run — the save of step n SIGKILLs mid-commit (torn, invisible).
+"""
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    outdir, ckptdir = sys.argv[1], sys.argv[2]
+    incarnation, steps_total = int(sys.argv[3]), int(sys.argv[4])
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.jit import TrainStep
+    from jax.sharding import Mesh
+
+    world = jax.device_count()
+    denv.set_mesh(Mesh(np.array(jax.devices()), ("sharding",)))
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(16, 32)
+            self.b = nn.Linear(32, 16)
+
+        def forward(self, x):
+            return ((self.b((self.a(x)) ** 2)) ** 2).mean()
+
+    paddle.seed(0)
+    model = Net()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    _, opt2, _ = dist.group_sharded_parallel(model, opt, level="os_g")
+    ts = TrainStep(model, opt2)
+
+    def digest():
+        h = hashlib.sha256()
+        for name, p in sorted(model.state_dict().items()):
+            h.update(np.ascontiguousarray(np.asarray(p.value())).tobytes())
+        raw = ts._opt
+        for p, key in zip(raw._parameter_list, raw._param_keys()):
+            for sname in sorted(raw._state_names):
+                h.update(np.ascontiguousarray(
+                    np.asarray(raw._accumulators[id(p)][sname])).tobytes())
+        h.update(str(raw._step_count).encode())
+        return h.hexdigest()
+
+    log = open(os.path.join(outdir, f"events.{incarnation}.jsonl"), "a")
+
+    def emit(rec):
+        log.write(json.dumps(rec) + "\n")
+        log.flush()
+
+    info = ts.load_checkpoint(ckptdir)
+    start = 0
+    if info is not None:
+        start = int(info["step"])
+        emit({"kind": "resume", "incarnation": incarnation, "world": world,
+              "step": start, "digest": digest(),
+              "reshard": info.get("reshard")})
+
+    def batch(step):
+        rng = np.random.RandomState(1000 + step)
+        return paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+
+    for step in range(start, steps_total):
+        loss = float(ts(batch(step)))
+        emit({"kind": "step", "incarnation": incarnation, "world": world,
+              "step": step, "loss": loss, "digest": digest()})
+        # synchronous commit: PADDLE_CKPT_FAULT=die_before_commit:<n>
+        # SIGKILLs inside this call, after the payload rename but before
+        # the COMMIT manifest — the torn-save drill
+        ts.save_checkpoint(ckptdir, step + 1, block=True)
+    ts.wait_checkpoint()
+    log.close()
+
+
+if __name__ == "__main__":
+    main()

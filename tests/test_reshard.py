@@ -1,0 +1,695 @@
+"""Elastic resharding (ISSUE 8 acceptance).
+
+* per-shard snapshots: ``_host_copy`` stages sharded (and non-addressable)
+  arrays as per-shard numpy blocks — never a live jax reference (the PR 4
+  carve-out this subsystem closes);
+* reshard-on-load geometry: N→N is a byte-identical fast path (no gather),
+  nestable N→M (N%M==0 / M%N==0, incl. N→1 and 1→M) is index-mapped,
+  non-divisible splits (3→2 over a dim neither divides) gather-then-re-place;
+* the tier-1 2→4 e2e: a ZeRO job checkpointed on a 2-device virtual mesh
+  resumes on 4 devices bitwise-identically, and one post-load compiled step
+  matches a force-gather control bitwise (optimizer state included);
+* pod-wide commit: rank 0 writes COMMIT only after every rank's payload
+  acked through the KV master; a death in the payload→COMMIT window leaves
+  the snapshot invisible to ``latest_checkpoint`` on every rank;
+* ``tools/ckpt_inspect.py`` understands sharded manifests (per-rank payload
+  health, PARTIAL when the rank set doesn't cover the index map);
+* ``monitor`` reshard/* gauges + the metrics_summary "reshard" section WARN
+  on a nestable load that fell back to gather;
+* ``ElasticManager`` membership change announces the surviving world size
+  through the launcher's elastic_np control file.
+"""
+import io
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu import monitor
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import reshard
+from paddle_tpu.distributed.launch.master import KVServer
+from paddle_tpu.jit import TrainStep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_env():
+    from paddle_tpu.distributed import env
+    env._env["initialized"] = False
+    env._env["mesh"] = None
+    env._env["hcg"] = None
+    from paddle_tpu.distributed import group
+    group._group_registry.clear()
+    monitor.disable()
+    yield
+    monitor.disable()
+
+
+def _mesh(world):
+    from paddle_tpu.distributed import env
+    env._env["initialized"] = False
+    env._env["mesh"] = None
+    m = Mesh(np.array(jax.devices()[:world]), ("sharding",))
+    env.set_mesh(m)
+    return m
+
+
+def _sharded(mesh, values, spec):
+    return jax.device_put(jnp.asarray(values), NamedSharding(mesh, spec))
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------- plan geometry
+
+def test_classify_identity_mapped_gather():
+    # 4-way cuts on a dim of 8
+    src = [((i * 2, i * 2 + 2),) for i in range(4)]
+    assert reshard.classify(src, src, 1) == "identity"
+    # 2-way target nests (4%2==0)
+    dst2 = [((0, 4),), ((4, 8),)]
+    assert reshard.classify(src, dst2, 1) == "mapped"
+    # 1-way (N->1) and 8-way (M%N==0) nest too
+    assert reshard.classify(src, [((0, 8),)], 1) == "mapped"
+    # 3-way over 8: jax-style ceil split (3,3,2) — boundaries cross
+    dst3 = [((0, 3),), ((3, 6),), ((6, 8),)]
+    assert reshard.classify(src, dst3, 1) == "gather"
+
+
+def test_reshard_plan_assembles_exactly():
+    full = np.arange(24, dtype=np.float32).reshape(8, 3)
+    blocks = {((i * 2, i * 2 + 2), (0, 3)):
+              (lambda i=i: full[i * 2:i * 2 + 2]) for i in range(4)}
+    for dst in ([((0, 4), (0, 3)), ((4, 8), (0, 3))],        # mapped
+                [((0, 3), (0, 3)), ((3, 6), (0, 3)), ((6, 8), (0, 3))],
+                [((0, 8), (0, 3))]):                          # N->1
+        plan = reshard.ReshardPlan((8, 3), np.float32, dict(blocks), dst)
+        got = np.concatenate([plan.shard(d) for d in dst], axis=0)
+        assert np.array_equal(got, full)
+    gather = reshard.ReshardPlan((8, 3), np.float32, dict(blocks),
+                                 [((0, 3), (0, 3)), ((3, 6), (0, 3)),
+                                  ((6, 8), (0, 3))])
+    assert gather.kind == "gather"
+
+
+# ----------------------------------------------------------- host-copy staging
+
+def test_host_copy_stages_sharded_arrays_per_shard():
+    """The PR 4 carve-out: sharded state must stage as per-shard numpy
+    blocks, never keep a live jax.Array reference pinning device buffers."""
+    mesh = _mesh(4)
+    arr = _sharded(mesh, np.arange(8.0, dtype=np.float32), P("sharding"))
+    staged = ckpt._host_copy({"m": arr})["m"]
+    assert isinstance(staged, reshard.StagedArray)
+    assert len(staged.blocks) == 4
+    for idx, block in staged.blocks.items():
+        assert isinstance(block, np.ndarray) and not isinstance(
+            block, jax.Array)
+        assert np.array_equal(block, np.arange(*idx[0], dtype=np.float32))
+    # regression: an array REPORTING itself non-fully-addressable (the
+    # multi-host case, simulated through the seam) stages per shard instead
+    # of keeping the jax reference the old code returned
+    rep = jax.device_put(jnp.arange(4.0), NamedSharding(mesh, P()))
+    old = ckpt._fully_addressable
+    ckpt._fully_addressable = lambda a: False
+    try:
+        staged = ckpt._host_copy(rep)
+    finally:
+        ckpt._fully_addressable = old
+    assert isinstance(staged, reshard.StagedArray)
+    assert all(isinstance(b, np.ndarray) and not isinstance(b, jax.Array)
+               for b in staged.blocks.values())
+    # replicated arrays dedupe to ONE owned block, not one per replica
+    assert len(staged.blocks) == 1
+
+
+def test_host_copy_plain_arrays_unchanged():
+    out = ckpt._host_copy({"a": jnp.arange(3.0), "b": 7})
+    assert isinstance(out["a"], np.ndarray) and out["b"] == 7
+
+
+# ------------------------------------------------------- degenerate geometries
+
+def _save_state(tmp_path, mesh_n, name="s"):
+    """A 2-param state saved on an N-way mesh; returns (dir, host copies)."""
+    w = np.arange(48, dtype=np.float32).reshape(12, 4)
+    v = np.arange(8, dtype=np.float32)
+    mesh = _mesh(mesh_n)
+    spec_w = P("sharding") if mesh_n > 1 else P()
+    spec_v = P("sharding") if mesh_n > 1 and 8 % mesh_n == 0 else P()
+    state = {"w": _sharded(mesh, w, spec_w), "v": _sharded(mesh, v, spec_v),
+             "step": 5}
+    d = str(tmp_path / name)
+    reshard.save_sharded(d, state, rank=0)
+    return d, {"w": w, "v": v}
+
+
+def _load_on(d, world, force_gather=False):
+    mesh = _mesh(world)
+    spec = P("sharding") if world > 1 else P()
+    tmpl = {json.dumps(["w"]): _sharded(mesh, np.zeros((12, 4), np.float32),
+                                        spec),
+            json.dumps(["v"]): _sharded(mesh, np.zeros(8, np.float32),
+                                        P("sharding") if world in (2, 4)
+                                        else P())}
+    flat, skel, stats = reshard.load_sharded(d, tmpl,
+                                             force_gather=force_gather)
+    state = reshard.unflatten_state(skel, flat)
+    return state, stats
+
+
+def test_n_to_n_is_byte_identical_fast_path(tmp_path):
+    d, host = _save_state(tmp_path, 4)
+    state, stats = _load_on(d, 4)
+    assert stats.gathered == 0 and stats.mapped == 0
+    assert stats.identity == 2  # every array served block-for-block
+    assert np.array_equal(np.asarray(state["w"]), host["w"])
+    assert np.array_equal(np.asarray(state["v"]), host["v"])
+    assert state["step"] == 5
+
+
+def test_n_to_1_and_1_to_m_index_mapped(tmp_path):
+    d, host = _save_state(tmp_path, 4)
+    state, stats = _load_on(d, 1)      # N -> 1
+    assert stats.gathered == 0
+    assert np.array_equal(np.asarray(state["w"]), host["w"])
+    d1, host1 = _save_state(tmp_path, 1, name="s1")  # 1 -> M
+    state, stats = _load_on(d1, 4)
+    assert stats.gathered == 0 and stats.src_world == 1
+    assert stats.dst_world == 4
+    assert np.array_equal(np.asarray(state["w"]), host1["w"])
+    assert np.array_equal(np.asarray(state["v"]), host1["v"])
+
+
+def test_3_to_2_gather_fallback(tmp_path):
+    """12 rows split 3-way ({0,4,8,12}) vs 2-way ({0,6,12}): boundaries
+    cross — the non-divisible pair must take (and count) the gather path."""
+    d, host = _save_state(tmp_path, 3)
+    state, stats = _load_on(d, 2)
+    assert stats.gathered >= 1
+    assert stats.nestable_gather == 0  # 3->2 is NOT nestable: no false WARN
+    assert np.array_equal(np.asarray(state["w"]), host["w"])
+    assert np.array_equal(np.asarray(state["v"]), host["v"])
+
+
+class _Net12(nn.Layer):
+    """Dims divisible by every tested world (1/2/3/4), with 3-way vs 2-way
+    cuts CROSSING (12: {0,4,8,12} vs {0,6,12}) — the gather-fallback
+    geometry."""
+
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(12, 24)
+        self.b = nn.Linear(24, 12)
+
+    def forward(self, x):
+        return ((self.b((self.a(x)) ** 2)) ** 2).mean()
+
+
+def _build_eager(world, seed=0):
+    """Model + eager ZeRO stage-1 optimizer on a world-sized mesh."""
+    _mesh(world)
+    paddle.seed(seed)
+    m = _Net12()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    _, opt2, _ = dist.group_sharded_parallel(m, opt, level="os")
+    return m, opt2
+
+
+def _eager_step(m, opt, seed=9):
+    rng = np.random.RandomState(seed)
+    for p in m.parameters():
+        p._grad = jnp.asarray(
+            rng.randn(*[int(s) for s in p.shape]).astype("float32"))
+    opt.step()
+    opt.clear_grad()
+
+
+def _opt_host(opt):
+    raw = opt
+    while hasattr(raw, "_inner_opt"):
+        raw = raw._inner_opt
+    out = {}
+    for p, key in zip(raw._parameter_list, raw._param_keys()):
+        if id(p) in raw._accumulators:
+            for name, arr in raw._accumulators[id(p)].items():
+                out[f"{key}_{name}"] = np.asarray(arr)
+    return out
+
+
+@pytest.mark.parametrize("src,dst", [
+    (4, 4), (3, 2),
+    # tier-1 budget: N->1 / 1->M post-step parity ride the slow lane (~6s
+    # of eager-ZeRO compiles each); their LOAD-level bitwise coverage stays
+    # tier-1 in test_n_to_1_and_1_to_m_index_mapped
+    pytest.param(4, 1, marks=pytest.mark.slow),
+    pytest.param(1, 4, marks=pytest.mark.slow)])
+def test_degenerate_post_step_optimizer_parity(tmp_path, src, dst):
+    """Each degenerate world pair: optimizer state is bitwise-equal after
+    ONE post-load eager step vs an unresharded (force-gather) control on
+    the same target mesh. 4->4 must additionally never gather."""
+    m, opt = _build_eager(src)
+    _eager_step(m, opt, seed=1)
+    _eager_step(m, opt, seed=2)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(d, 2, model=m, optimizer=opt)
+
+    sink = str(tmp_path / "run.jsonl")
+    monitor.enable(sink)
+    m2, opt2 = _build_eager(dst, seed=1)
+    info = ckpt.load_checkpoint(d, model=m2, optimizer=opt2)
+    monitor.disable()
+    if src > 1:  # sharded payload: the reshard path ran
+        rs = info["reshard"]
+        if (src, dst) == (4, 4):
+            assert rs["gathered"] == 0 and rs["mapped"] == 0  # identity only
+        elif (src, dst) == (3, 2):
+            assert rs["gathered"] >= 1  # the non-divisible fallback
+        else:
+            assert rs["gathered"] == 0  # nestable: index-mapped
+    _eager_step(m2, opt2, seed=3)
+
+    m3, opt3 = _build_eager(dst, seed=2)
+    ckpt.load_checkpoint(d, model=m3, optimizer=opt3, force_gather=True)
+    _eager_step(m3, opt3, seed=3)
+
+    for k, v in m2.state_dict().items():
+        assert np.array_equal(np.asarray(v.value()),
+                              np.asarray(m3.state_dict()[k].value())), k
+    a2, a3 = _opt_host(opt2), _opt_host(opt3)
+    assert a2 and set(a2) == set(a3)
+    for k in a2:
+        assert np.array_equal(a2[k], a3[k]), k
+
+
+def test_partial_snapshot_refused_and_loadable_with_partial_ok(tmp_path):
+    d, _ = _save_state(tmp_path, 4)
+    # lose one block file: coverage breaks
+    idx = reshard.read_index(d)
+    victim = idx["arrays"][json.dumps(["w"])]["blocks"][0]["file"]
+    os.remove(os.path.join(d, victim))
+    with pytest.raises(ValueError, match="PARTIAL"):
+        reshard.load_sharded(d)
+    flat, _, _ = reshard.load_sharded(d, partial_ok=True)
+    assert json.dumps(["v"]) in flat
+
+
+# ------------------------------------------------- tier-1 2->4 TrainStep e2e
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(16, 32)
+        self.b = nn.Linear(32, 16)
+
+    def forward(self, x):
+        return ((self.b((self.a(x)) ** 2)) ** 2).mean()
+
+
+def _build_zero(world, seed=0):
+    _mesh(world)
+    paddle.seed(seed)
+    m = _Net()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    _, opt2, _ = dist.group_sharded_parallel(m, opt, level="os_g")
+    return m, TrainStep(m, opt2)
+
+
+def _opt_host_state(ts):
+    out = {}
+    for p, key in zip(ts._opt._parameter_list, ts._opt._param_keys()):
+        for name, arr in ts._opt._accumulators[id(p)].items():
+            out[f"{key}_{name}"] = np.asarray(arr)
+    return out
+
+
+def test_reshard_2_to_4_bitwise_with_post_step_parity(tmp_path):
+    """The tier-1 elastic e2e: train on a 2-way ZeRO mesh, checkpoint,
+    resume on a 4-way mesh — params/moments/step bitwise-identical right
+    after load, reshard gauges emitted, and one post-load compiled step
+    bitwise-matches a force-gather control."""
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 16).astype("float32"))
+    m2, ts2 = _build_zero(2)
+    for _ in range(3):
+        ts2(x)
+    d = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(d, 3, model=m2, optimizer=ts2._opt)
+    params_host = {k: np.asarray(v.value()) for k, v in
+                   m2.state_dict().items()}
+    moments_host = _opt_host_state(ts2)
+    step_host = ts2._opt._step_count
+
+    sink = str(tmp_path / "run.jsonl")
+    monitor.enable(sink)
+    m4, ts4 = _build_zero(4, seed=1)  # different init: load must overwrite
+    info = ts4.load_checkpoint(d)
+    assert info["step"] == 3
+    rs = info["reshard"]
+    assert rs["src_world"] == 2 and rs["dst_world"] == 4
+    assert rs["gathered"] == 0 and rs["nestable_gather"] == 0
+    snap = monitor.snapshot()
+    assert snap["gauges"]["reshard/src_world"] == 2
+    assert snap["gauges"]["reshard/dst_world"] == 4
+    assert snap["counters"]["reshard/loads"] >= 1
+    monitor.disable()
+
+    # bitwise immediately after load: params, moments, global step
+    for k, v in m4.state_dict().items():
+        assert np.array_equal(np.asarray(v.value()), params_host[k]), k
+    assert ts4._opt._step_count == step_host
+    for k, v in _opt_host_state(ts4).items():
+        assert np.array_equal(v, moments_host[k]), k
+    # moments really live at the 4-way placement (no stealth gather)
+    any_m = next(iter(ts4._opt._accumulators.values()))["moment1"]
+    assert any_m.sharding.mesh.shape["sharding"] == 4
+
+    # one post-load step vs the force-gather control: bitwise
+    l_fast = float(ts4(x))
+    m4g, ts4g = _build_zero(4, seed=2)
+    ckpt.load_checkpoint(d, model=m4g, optimizer=ts4g._opt,
+                         force_gather=True)
+    l_ctl = float(ts4g(x))
+    assert l_fast == l_ctl
+    for (p1, p2) in zip(ts4._params, ts4g._params):
+        assert np.array_equal(np.asarray(p1.value()), np.asarray(p2.value()))
+    a1, a2 = _opt_host_state(ts4), _opt_host_state(ts4g)
+    for k in a1:
+        assert np.array_equal(a1[k], a2[k]), k
+
+
+# ------------------------------------------------------------ pod-wide commit
+
+def _staged(shape, values, block_slices, owners, rank):
+    """Handcraft a StagedArray: this rank's blocks + the full owner map."""
+    blocks = {}
+    all_blocks = {}
+    for idx, owner in zip(block_slices, owners):
+        all_blocks[idx] = owner
+        if owner == rank:
+            blocks[idx] = values[tuple(slice(a, b) for a, b in idx)]
+    return reshard.StagedArray(shape, "float32", ["sharding"],
+                               {"sharding": len(block_slices)}, blocks,
+                               all_blocks)
+
+
+def _two_rank_state(rank):
+    vals = np.arange(8, dtype=np.float32)
+    return {"m": _staged((8,), vals, [((0, 4),), ((4, 8),)], [0, 1], rank)}
+
+
+def _pod(endpoint, rank, world, timeout=20.0):
+    return reshard.PodCommit(endpoint, "job", rank, world, timeout=timeout,
+                             poll=0.02)
+
+
+@pytest.fixture
+def kv_master():
+    port = _free_port()
+    srv = KVServer(port)
+    srv.start()
+    yield f"127.0.0.1:{port}"
+    srv.stop()
+
+
+def test_pod_commit_two_ranks(tmp_path, kv_master):
+    d = str(tmp_path / "pod")
+    results = {}
+
+    def run(rank):
+        try:
+            results[rank] = ckpt._write_snapshot(
+                d, 7, None, _two_rank_state(rank), {"note": 1} if rank == 0
+                else None, None, "sync", coordinator=_pod(kv_master, rank, 2))
+        except BaseException as e:  # pragma: no cover - surfaced below
+            results[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    base = ckpt._snapshot_dir(d, 7)
+    assert results[0] == base and results[1] == base, results
+    manifest = ckpt.read_manifest(base)
+    assert manifest is not None and manifest["ranks"] == [0, 1]
+    assert ckpt.latest_checkpoint(d) == 7
+    assert ckpt.verify_snapshot(base, manifest) == []
+    # both ranks' blocks merged: the full array loads back
+    flat, _, stats = reshard.load_sharded(
+        os.path.join(base, "optimizer.shards"))
+    assert np.array_equal(flat[json.dumps(["m"])],
+                          np.arange(8, dtype=np.float32))
+
+
+def test_pod_commit_death_window_leaves_snapshot_invisible(
+        tmp_path, kv_master, monkeypatch):
+    """SIGKILL-equivalent between a rank payload landing and the pod-wide
+    COMMIT: rank 1's payload is durable and acked, rank 0 dies before the
+    manifest — no rank may ever see the snapshot as a resume target."""
+    d = str(tmp_path / "pod")
+
+    def boom(*a, **k):
+        raise RuntimeError("rank 0 died before the pod COMMIT")
+
+    monkeypatch.setattr(ckpt, "_build_manifest", boom)
+    results = {}
+
+    def run(rank):
+        try:
+            results[rank] = ckpt._write_snapshot(
+                d, 9, None, _two_rank_state(rank), None, None, "sync",
+                coordinator=_pod(kv_master, rank, 2, timeout=3.0))
+        except BaseException as e:
+            results[rank] = e
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert isinstance(results[0], RuntimeError)
+    # rank 1 acked a durable payload but must NOT trust the step: no COMMIT
+    assert isinstance(results[1], ckpt.CheckpointError)
+    assert ckpt.latest_checkpoint(d) is None  # invisible on every rank
+    assert ckpt.read_manifest(ckpt._snapshot_dir(d, 9)) is None
+
+
+def test_pod_commit_ack_timeout_names_missing_rank(tmp_path, kv_master):
+    d = str(tmp_path / "pod")
+    with pytest.raises(ckpt.CheckpointError, match=r"rank\(s\) \[1\]"):
+        ckpt._write_snapshot(d, 3, None, _two_rank_state(0), None, None,
+                             "sync", coordinator=_pod(kv_master, 0, 2,
+                                                      timeout=1.0))
+    assert ckpt.latest_checkpoint(d) is None
+
+
+def test_pod_commit_resave_same_step(tmp_path, kv_master):
+    """Post-rollback re-save of an already-committed step: the previous
+    save's still-published token/commit keys must not let a rank return
+    success without writing its new payload. Rank 1 even enters the
+    re-save BEFORE rank 0 (the stale-key window the barrier must survive)."""
+    d = str(tmp_path / "pod")
+    coords = {r: _pod(kv_master, r, 2) for r in (0, 1)}
+
+    def save_once(delay0=0.0):
+        results = {}
+
+        def run(rank):
+            if rank == 0 and delay0:
+                time.sleep(delay0)
+            try:
+                results[rank] = ckpt._write_snapshot(
+                    d, 7, None, _two_rank_state(rank), None, None, "sync",
+                    coordinator=coords[rank])
+            except BaseException as e:
+                results[rank] = e
+        threads = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        return results
+
+    base = ckpt._snapshot_dir(d, 7)
+    assert save_once()[0] == base
+    first_manifest = ckpt.read_manifest(base)
+    results = save_once(delay0=0.5)  # rank 1 sees only stale keys at first
+    assert results[0] == base and results[1] == base, results
+    second_manifest = ckpt.read_manifest(base)
+    assert second_manifest is not None
+    assert second_manifest["wall"] > first_manifest["wall"]
+    assert ckpt.verify_snapshot(base, second_manifest) == []
+
+
+def test_coordinator_false_forces_single_process_commit(tmp_path,
+                                                        monkeypatch):
+    """The documented escape hatch: under the launcher env contract,
+    coordinator=False must run the single-process commit (per-rank-private
+    directory layout) — not re-resolve the pod barrier from env and stall
+    waiting for acks that will never come."""
+    monkeypatch.setenv("PADDLE_CKPT_MASTER", "127.0.0.1:1")  # unreachable
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    d = str(tmp_path / "priv")
+    t0 = time.time()
+    path = ckpt.save_checkpoint(d, 1, extra={"w": 3}, coordinator=False)
+    assert time.time() - t0 < 5.0  # no barrier wait, no KV traffic
+    assert ckpt.latest_checkpoint(d) == 1
+    assert ckpt.load_checkpoint(d)["w"] == 3
+    # AsyncCheckpointer honors the same escape
+    with ckpt.AsyncCheckpointer(d, coordinator=False) as ac:
+        ac.save(2, extra={"w": 4}, block=True)
+    assert ckpt.latest_checkpoint(d) == 2
+
+
+def test_pod_commit_stale_token_ignored(kv_master):
+    """An ack from a previous incarnation (different token) cannot satisfy
+    this save's barrier."""
+    c0, c1 = _pod(kv_master, 0, 2, timeout=1.0), _pod(kv_master, 1, 2)
+    token = c0.publish_ready(4)
+    c1.ack(4, "deadbeef00000000")  # stale incarnation's token
+    with pytest.raises(reshard.PodCommitError):
+        c0.wait_acks(4, token)
+    c1.ack(4, token)
+    assert list(c0.wait_acks(4, token)) == [1]
+
+
+# ------------------------------------------------------------- ckpt_inspect
+
+def test_ckpt_inspect_partial_and_rank_health(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import ckpt_inspect
+
+    d = str(tmp_path / "ckpt")
+    # a complete pod snapshot, manifested
+    base = ckpt._snapshot_dir(d, 2)
+    os.makedirs(base)
+    reshard.save_sharded(os.path.join(base, "optimizer.shards"),
+                         _two_rank_state(0), rank=0)
+    reshard.save_sharded(os.path.join(base, "optimizer.shards"),
+                         _two_rank_state(1), rank=1)
+    ckpt._write_manifest(base, ckpt._build_manifest(base, 2))
+    rows = ckpt_inspect.scan(d, do_verify=True)
+    assert [r["status"] for r in rows] == ["COMMITTED"]
+    ranks = rows[0]["shards"]["optimizer.shards"]["ranks"]
+    assert sorted(ranks) == [0, 1] and ranks[1]["files"] == 1
+
+    # rank 1's payload never landed: PARTIAL, unhealthy exit code
+    base5 = ckpt._snapshot_dir(d, 5)
+    os.makedirs(base5)
+    reshard.save_sharded(os.path.join(base5, "optimizer.shards"),
+                         _two_rank_state(0), rank=0)
+    ckpt._write_manifest(base5, ckpt._build_manifest(base5, 5))
+    rows = ckpt_inspect.scan(d, do_verify=True)
+    by_step = {r["step"]: r for r in rows}
+    assert by_step[5]["status"] == "PARTIAL"
+    assert any("owner rank 1" in p for p in by_step[5]["problems"])
+    rc = ckpt_inspect.main([d, "--verify"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "PARTIAL" in out and "rank 0" in out
+    # auto-resume must not restore the partial step 5: it falls back to 2
+    tmpl_probe = {}
+    info = ckpt.load_checkpoint(d)  # nothing restorable (no model/opt) ...
+    # ... but the PARTIAL payload is refused with a diagnostic when asked
+    class _Opt:
+        def state_dict(self):
+            return {}
+
+        def set_state_dict(self, s):
+            self.loaded = s
+    o = _Opt()
+    with pytest.raises(ckpt.CheckpointError, match="PARTIAL"):
+        ckpt.load_checkpoint(d, optimizer=o, step=5)
+    assert ckpt.load_checkpoint(d, optimizer=o, step=2)["step"] == 2
+
+
+# ----------------------------------------------------- monitor/metrics summary
+
+def test_metrics_summary_reshard_section_and_warn(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_summary
+
+    sink = str(tmp_path / "run.jsonl")
+    mon = monitor.enable(sink)
+    mon.reshard_loaded(src_world=8, dst_world=4, arrays=10, identity=1,
+                       mapped=7, gathered=2, nestable_gather=2,
+                       bytes_read=1 << 20, wall_s=0.25)
+    monitor.disable()
+    out = io.StringIO()
+    metrics_summary.summarize([sink], out=out)
+    text = out.getvalue()
+    assert "== reshard ==" in text
+    assert "world 8 -> 4" in text
+    assert "index-mapped 7" in text
+    assert "WARNING: 2 array(s) of a NESTABLE 8->4 load" in text
+
+    # healthy nestable load: section renders, no WARN
+    sink2 = str(tmp_path / "run2.jsonl")
+    mon = monitor.enable(sink2)
+    mon.reshard_loaded(src_world=2, dst_world=4, arrays=3, identity=0,
+                       mapped=3, gathered=0, nestable_gather=0,
+                       bytes_read=4096, wall_s=0.01)
+    monitor.disable()
+    out = io.StringIO()
+    metrics_summary.summarize([sink2], out=out)
+    assert "WARNING" not in out.getvalue().split("== reshard ==")[1]
+
+
+# ------------------------------------------------------------ elastic restart
+
+def test_elastic_membership_change_announces_np(tmp_path):
+    port = _free_port()
+    srv = KVServer(port)
+    srv.start()
+    try:
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+        scale = str(tmp_path / "elastic_np")
+        mgrs = [ElasticManager(f"127.0.0.1:{port}", "j", f"ep{i}", 2,
+                               heartbeat_interval=0.05, ttl=0.6,
+                               scale_file=scale) for i in range(2)]
+        for m in mgrs:
+            m.register()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(mgrs[0].peers()) < 2:
+            time.sleep(0.05)
+        assert len(mgrs[0].peers()) == 2
+        # let the watcher observe the full world before the departure
+        deadline = time.time() + 10
+        while time.time() < deadline and mgrs[0]._last_peers != ["ep0",
+                                                                 "ep1"]:
+            time.sleep(0.05)
+        mgrs[1].exit(completed=False)  # tombstone: a preempted worker
+        # the join itself may have announced "2" first; the surviving world
+        # ("1") must be the eventual announcement
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if os.path.exists(scale) and open(scale).read().strip() == "1":
+                break
+            time.sleep(0.05)
+        assert os.path.exists(scale), "membership change never announced"
+        assert open(scale).read().strip() == "1"
+        assert mgrs[0].status == ElasticStatus.RESTART
+        mgrs[0].exit()
+    finally:
+        srv.stop()

@@ -9,15 +9,25 @@ Lists every ``step_<N>`` snapshot with its commit status:
     COMMITTED  — has a valid COMMIT manifest (a resume candidate)
     TORN       — dir exists but no/invalid manifest (interrupted save;
                  auto-resume skips and quarantines these)
+    PARTIAL    — sharded payloads whose present rank payloads do NOT cover
+                 the block index map (a rank's shards never landed, or a
+                 rank dir was lost after the fact) — NOT safely resumable
     IN-FLIGHT  — a ``step_<N>.tmp`` dir (save in progress, or died mid-write)
     CORRUPT    — a quarantined ``step_<N>.corrupt*`` dir
     SET-ASIDE  — a ``step_<N>.old`` dir parked by an interrupted re-save
                  (the library's resume scan restores a committed one)
     BAD        — (--verify) manifest present but checksum/size re-hash failed
 
+Sharded snapshots (``<payload>.shards/`` with per-rank block payloads —
+see paddle_tpu/distributed/reshard/) additionally list per-rank payload
+health: which ranks wrote, how many block files each contributed, and
+whether every region of the block index map is covered.
+
 ``--verify`` re-hashes every manifest-listed file (SHA-256) — the same check
-auto-resume performs. Exit code: 0 when every ``step_*`` entry is a healthy
-committed snapshot, 1 otherwise (monitoring-friendly).
+auto-resume performs — and, for sharded payloads, re-checks every block
+file's size against its region ACROSS ranks. Exit code: 0 when every
+``step_*`` entry is a healthy committed snapshot, 1 otherwise
+(monitoring-friendly).
 
 Deliberately standalone (stdlib only — no jax/paddle import): the manifest
 format is the schema-versioned contract of
@@ -68,6 +78,96 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
+# itemsizes for the block-size cross-check (stdlib only — no numpy import);
+# unknown dtypes skip the size check rather than fail the tool
+_ITEMSIZE = {"bool": 1, "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+             "float16": 2, "bfloat16": 2, "int32": 4, "uint32": 4,
+             "float32": 4, "int64": 8, "uint64": 8, "float64": 8,
+             "complex64": 8, "complex128": 16}
+
+
+def _shards_payloads(base: str):
+    return sorted(d for d in os.listdir(base)
+                  if d.endswith(".shards")
+                  and os.path.isdir(os.path.join(base, d)))
+
+
+def _read_shard_index(payload_dir: str):
+    """Merge every rank's index.rank<r>.json: per-rank file/byte tallies +
+    the union of present blocks per array."""
+    ranks = {}
+    arrays = {}
+    for name in sorted(os.listdir(payload_dir)):
+        if not (name.startswith("index.rank") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(payload_dir, name)) as f:
+                idx = json.load(f)
+        except (OSError, ValueError):
+            continue
+        r = int(idx.get("rank", 0))
+        info = ranks.setdefault(r, {"files": 0, "bytes": 0, "missing": 0})
+        for key, entry in idx.get("arrays", {}).items():
+            tgt = arrays.setdefault(key, {"dtype": entry.get("dtype"),
+                                          "present": {},
+                                          "all_blocks":
+                                              entry.get("all_blocks", [])})
+            for b in entry.get("blocks", []):
+                bidx = tuple(tuple(x) for x in b["index"])
+                tgt["present"][bidx] = b["file"]
+                p = os.path.join(payload_dir, b["file"])
+                info["files"] += 1
+                if os.path.isfile(p):
+                    info["bytes"] += os.path.getsize(p)
+                else:
+                    info["missing"] += 1
+    return ranks, arrays
+
+
+def _shard_coverage(payload_dir: str, arrays: dict, deep: bool):
+    """Coverage problems: every all_blocks region needs a present block
+    (and with ``deep``, a file whose size matches the region)."""
+    problems = []
+    for key, entry in sorted(arrays.items()):
+        itemsize = _ITEMSIZE.get(entry.get("dtype"))
+        for ab in entry["all_blocks"]:
+            bidx = tuple(tuple(x) for x in ab["index"])
+            rel = entry["present"].get(bidx)
+            if rel is None:
+                problems.append(
+                    f"{key}: block {list(bidx)} (owner rank "
+                    f"{ab.get('owner')}) not covered by any rank payload")
+                continue
+            p = os.path.join(payload_dir, rel)
+            if not os.path.isfile(p):
+                problems.append(f"{key}: {rel} missing on disk")
+            elif deep and itemsize is not None:
+                # same formula as the library's coverage check: scalars
+                # (no dims) want itemsize bytes, zero-size dims want 0
+                want = itemsize
+                for a, b in bidx:
+                    want *= b - a
+                if os.path.getsize(p) != want:
+                    problems.append(
+                        f"{key}: {rel} is {os.path.getsize(p)} bytes, "
+                        f"block {list(bidx)} needs {want}")
+    return problems
+
+
+def inspect_shards(base: str, deep: bool):
+    """(per-payload rank health, coverage problems) for a snapshot dir."""
+    payloads = {}
+    problems = []
+    for d in _shards_payloads(base):
+        pdir = os.path.join(base, d)
+        ranks, arrays = _read_shard_index(pdir)
+        payloads[d] = {"ranks": {r: dict(v) for r, v in sorted(ranks.items())},
+                       "arrays": len(arrays)}
+        problems += [f"{d}: {p}"
+                     for p in _shard_coverage(pdir, arrays, deep)]
+    return payloads, problems
+
+
 def verify(base: str, manifest: dict):
     problems = []
     for rel, meta in sorted(manifest["files"].items()):
@@ -94,10 +194,12 @@ def scan(directory: str, do_verify: bool):
             continue
         m_step = _STEP_RE.match(name)
         if m_step:
+            shards, cover = inspect_shards(path, do_verify)
             manifest = read_manifest(path)
             if manifest is None:
                 rows.append({"name": name, "step": int(m_step.group(1)),
-                             "status": "TORN", "problems":
+                             "status": "TORN", "shards": shards,
+                             "problems":
                              [f"no valid {MANIFEST_NAME} manifest"]})
                 continue
             row = {"name": name, "step": int(m_step.group(1)),
@@ -106,12 +208,22 @@ def scan(directory: str, do_verify: bool):
                                 for f in manifest["files"].values()),
                    "files": len(manifest["files"]),
                    "world_size": manifest.get("world_size"),
+                   "ranks": manifest.get("ranks"),
+                   "shards": shards,
                    "wall": manifest.get("wall"), "problems": []}
             if do_verify:
                 problems = verify(path, manifest)
                 if problems:
                     row["status"] = "BAD"
                     row["problems"] = problems
+            if cover:
+                # committed but the rank payloads do not tile the arrays:
+                # resharding load would refuse it — not safely resumable.
+                # PARTIAL outranks BAD: "a rank's payload is missing" is
+                # the actionable diagnosis (restore that rank_<r>/ dir),
+                # while BAD alone means bit-rot in present files.
+                row["status"] = "PARTIAL"
+                row["problems"] = cover + row["problems"]
             rows.append(row)
         elif _TMP_RE.match(name):
             rows.append({"name": name,
@@ -177,6 +289,14 @@ def main(argv=None) -> int:
             if r.get("bytes") is not None else ""
         files = f"  {r['files']:3d} files" if r.get("files") else ""
         print(f"  {r['name']:<24} {r['status']:<10}{size}{files}{age}")
+        for payload, info in sorted((r.get("shards") or {}).items()):
+            for rank, h in sorted(info["ranks"].items()):
+                miss = f"  MISSING {h['missing']} files" if h["missing"] \
+                    else ""
+                print(f"      {payload} rank {rank}: {h['files']:3d} blocks"
+                      f"  {_fmt_bytes(h['bytes']):>9}{miss}")
+            if not info["ranks"]:
+                print(f"      {payload}: no rank index present")
         for p in r["problems"]:
             print(f"      ! {p}")
     return 0 if healthy else 1

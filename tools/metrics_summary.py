@@ -238,6 +238,37 @@ def summarize(paths, show_events=False, out=sys.stdout):
         if opt_b:
             print(f"  opt state (per device) {_fmt_bytes(opt_b)}", file=out)
 
+    counters_all = (metrics or {}).get("counters", {})
+    reshard_events = by_kind.get("reshard", [])
+    if reshard_events or counters_all.get("reshard/loads", 0):
+        src = int(gauges_m.get("reshard/src_world", 0))
+        dst = int(gauges_m.get("reshard/dst_world", 0))
+        ident = int(gauges_m.get("reshard/arrays_identity", 0))
+        mapped = int(gauges_m.get("reshard/arrays_mapped", 0))
+        gath = int(gauges_m.get("reshard/arrays_gathered", 0))
+        moved = gauges_m.get("reshard/bytes_read", 0)
+        hists_r = (metrics or {}).get("histograms", {})
+        load_s = hists_r.get("reshard/load_s", {})
+        print(f"\n== reshard ==", file=out)
+        print(f"  world {src} -> {dst}  "
+              f"loads {int(counters_all.get('reshard/loads', 0))}  "
+              f"arrays {int(gauges_m.get('reshard/arrays', 0))} "
+              f"(identity {ident}, index-mapped {mapped}, gathered {gath})",
+              file=out)
+        print(f"  bytes read {_fmt_bytes(moved)}  "
+              f"load wall {load_s.get('max', 0):.3f}s max", file=out)
+        # the regression this section exists to catch: a nestable N->M
+        # resume (N%M==0 or M%N==0) should be served by index-mapped reads;
+        # a gather there means an array's sharded dim moved between worlds
+        # and the load materialized the full array on host anyway
+        fallbacks = counters_all.get("reshard/nestable_gather_fallbacks", 0)
+        if fallbacks:
+            print(f"  WARNING: {int(fallbacks)} array(s) of a NESTABLE "
+                  f"{src}->{dst} load fell back to gather-then-re-place — "
+                  f"the sharded dim moved between world sizes (spec drift), "
+                  f"so the load paid a full-size host buffer instead of "
+                  f"index-mapped shard reads", file=out)
+
     remat_events = by_kind.get("remat", [])
     remat_on = gauges_m.get("remat/requested", 0) or remat_events or \
         gauges_m.get("remat/regions", 0)
