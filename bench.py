@@ -158,15 +158,22 @@ def main(argv=()):
         report(best, w)
 
 
-def main_decode():
-    """Serving decode throughput: a DecodeEngine (paged KV cache +
-    continuous batching, see paddle_tpu/serving/) over the same GPT-medium
+def main_decode(argv=()):
+    """Serving decode throughput: a DecodeEngine over the GPT-medium
     config, every slot kept hot with staggered requests so admissions and
-    evictions run continuously — the steady state being measured. Same
-    output contract as training: best-so-far JSON line after every window,
-    flushed (rc=124-safe). ``steady_state_recompiles`` must stay 0; a
-    nonzero value means the zero-recompile contract broke and the tokens/s
-    number is compile-bound garbage."""
+    evictions run continuously — the steady state being measured.
+
+    ``--paged`` serves through the block page table + chunked prefill
+    (shared-prefix workload: every prompt opens with a common system-prompt
+    prefix, so the pager's sharing/COW machinery is ON the measured path);
+    default is the slot-owns-a-row control arm. Same output contract as
+    training: best-so-far JSON line after every window, flushed
+    (rc=124-safe), now carrying ``kv_util`` (live tokens / pooled token
+    capacity) and TTFT p50/p95 from the window's completed requests.
+    ``steady_state_recompiles`` must stay 0; a nonzero value means the
+    zero-recompile contract broke and the tokens/s number is compile-bound
+    garbage. ``BENCH_TINY=1`` shrinks everything to a seconds-scale CI
+    smoke config."""
     import jax
     # same BENCH_TINY guard as main(): the persistent cache corrupts
     # restored CPU executables on this jaxlib (tests/conftest.py)
@@ -179,34 +186,65 @@ def main_decode():
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
     from paddle_tpu.serving import DecodeEngine
 
+    paged = _cli_flag(argv, "paged") is not None
+    tiny = bool(os.environ.get("BENCH_TINY"))
+
     paddle.seed(0)
-    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
-                    num_heads=8, max_position_embeddings=1024,
-                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    size = (dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                 max_position_embeddings=128) if tiny else
+            dict(vocab_size=50304, hidden_size=1024, num_layers=16,
+                 num_heads=8, max_position_embeddings=1024))
+    cfg = GPTConfig(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    **size)
     model = GPTForCausalLM(cfg)
     for _, p in model.named_parameters():
         p._data = p.value().astype("bfloat16")
 
-    engine = DecodeEngine(model, max_slots=16, max_len=256,
-                          prefill_buckets=[64])
+    slots, horizon = (4, 64) if tiny else (16, 256)
+    if paged:
+        engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
+                              paged=True, block_size=16,
+                              prefill_chunk=16 if tiny else 32)
+    else:
+        engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
+                              paged=False,
+                              prefill_buckets=[32 if tiny else 64])
     rng = np.random.RandomState(0)
+    # shared-prefix serving workload: a common "system prompt" opens every
+    # request (half the prompt) — on --paged the pager serves it from
+    # shared blocks, which is the concurrency-at-fixed-bytes story
+    sys_prefix = rng.randint(0, cfg.vocab_size, horizon // 8).tolist()
+    lo = max(len(sys_prefix) + 4, horizon // 4)
+    hi = horizon // 2
+    ttfts = []
 
     def refill():
         # staggered prompt lengths and decode budgets: requests finish at
         # different steps, freeing slots the next refill re-admits into
-        while engine.queue_depth + engine.live_count < engine.max_slots:
-            n = int(rng.randint(16, 65))
-            engine.submit(rng.randint(0, cfg.vocab_size, n),
-                          max_new_tokens=int(rng.randint(64, 129)))
+        while engine.queue_depth + engine.active_count < engine.max_slots:
+            n = int(rng.randint(lo, hi + 1))
+            prompt = sys_prefix + rng.randint(
+                0, cfg.vocab_size, n - len(sys_prefix)).tolist()
+            r = engine.submit(prompt,
+                              max_new_tokens=int(rng.randint(
+                                  horizon // 4, horizon // 2)))
+            reqs.append(r)
 
-    # warmup: fills all slots and mints both executables (one prefill
-    # bucket + the decode step)
+    def drain_ttfts():
+        done = [r for r in reqs if r.t_first_token is not None]
+        ttfts.extend(r.t_first_token - r.t_submit for r in done)
+        reqs[:] = [r for r in reqs if r.t_first_token is None]
+
+    reqs = []
+    # warmup: fill all slots and step until the first decode ran — by then
+    # every executable (chunk/prefill + decode) is minted
     refill()
-    engine.step()
+    while engine.decode_steps == 0:
+        engine.step()
     warm_compiles = engine.compile_count
     kind = jax.devices()[0].device_kind
 
-    iters, windows = 20, 6
+    iters, windows = (4, 2) if tiny else (20, 6)
     best = 0.0
     for w in range(windows):
         tok0 = engine.tokens_generated
@@ -215,13 +253,19 @@ def main_decode():
             refill()
             engine.step()   # host readback of the step's tokens syncs
         dt = time.time() - t0
+        drain_ttfts()
         best = max(best, (engine.tokens_generated - tok0) / dt)
+        q = (lambda v, p: float(np.percentile(v, p)) if v else None)
         print(json.dumps({
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best, 1),
             "unit": "tokens/s (decode)",
             "vs_baseline": (round(best / REF_DECODE_TOKENS_PER_SEC, 3)
                             if REF_DECODE_TOKENS_PER_SEC else None),
+            "paged": paged,
+            "kv_util": round(engine.kv_util(), 3),
+            "ttft_p50_ms": (round(q(ttfts, 50) * 1e3, 2) if ttfts else None),
+            "ttft_p95_ms": (round(q(ttfts, 95) * 1e3, 2) if ttfts else None),
             "live_slots": engine.live_count,
             "compiles": engine.compile_count,
             "steady_state_recompiles": engine.compile_count - warm_compiles,
@@ -232,5 +276,5 @@ def main_decode():
 
 
 if __name__ == "__main__":
-    sys.exit(main_decode() if "decode" in sys.argv[1:]
+    sys.exit(main_decode(sys.argv[1:]) if "decode" in sys.argv[1:]
              else main(sys.argv[1:]))

@@ -208,6 +208,45 @@ def gpt_tiny(**overrides) -> "GPTConfig":
     return GPTConfig(**cfg)
 
 
+def _paged_kv_update(kv_cache, k, v):
+    """Paged-cache write + gather, shared by GPT and LLaMA cached attention.
+
+    ``kv_cache`` is ``(pool_k, pool_v, table, pos, write_end)``: per-layer
+    [NB, BS, n_kv, hd] pools, a [B, mbs] int32 block table, the write
+    cursor(s) and the exclusive end of VALID new positions. ``k``/``v`` are
+    this call's fresh projections, [B, S, n_kv, hd].
+
+    Writes scatter each position to ``(table[b, p // BS], p % BS)``;
+    positions >= write_end (padded chunk tails) or beyond the table width
+    redirect to trash block 0, so padding can never corrupt a live or
+    shared block. Reads gather every row's blocks back into a contiguous
+    [B, mbs*BS, n_kv, hd] view with ``jnp.take`` on the block axis — the
+    caller's causal mask (key position <= query position) hides the stale
+    tail exactly as it does for the contiguous layout.
+    """
+    pool_k, pool_v, table, pos, write_end = kv_cache
+    b, s = k.shape[:2]
+    bs_blk = pool_k.shape[1]
+    mbs = table.shape[1]
+    if jnp.ndim(pos) == 1:             # per-slot cursors: decode, S == 1
+        wpos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        end = write_end[:, None]
+    else:                              # scalar cursor: one slot's chunk
+        wpos = (pos + jnp.arange(s, dtype=jnp.int32))[None, :]
+        wpos = jnp.broadcast_to(wpos, (b, s))
+        end = jnp.broadcast_to(jnp.asarray(write_end)[None, None], (b, 1))
+    lidx = wpos // bs_blk                                     # [B, S]
+    phys = jnp.take_along_axis(table, jnp.minimum(lidx, mbs - 1), axis=1)
+    phys = jnp.where((wpos < end) & (lidx < mbs), phys, 0)    # -> trash
+    off = wpos % bs_blk
+    pool_k = pool_k.at[phys, off].set(k.astype(pool_k.dtype))
+    pool_v = pool_v.at[phys, off].set(v.astype(pool_v.dtype))
+    nkv, hd = pool_k.shape[2], pool_k.shape[3]
+    k_view = jnp.take(pool_k, table, axis=0).reshape(b, mbs * bs_blk, nkv, hd)
+    v_view = jnp.take(pool_v, table, axis=0).reshape(b, mbs * bs_blk, nkv, hd)
+    return k_view, v_view, (pool_k, pool_v)
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -254,32 +293,51 @@ class GPTAttention(nn.Layer):
         return tag_activation(self.out_proj(out), ATTN_OUT)
 
     def _forward_cached(self, x, kv_cache):
-        """KV-cache attention (serving): write this chunk's K/V into the
-        static [B, M, nh, hd] buffers at `pos` and attend the queries over
-        every cached position <= their own (reference: the cache tensors
-        fused_multi_transformer threads through generation). Inference-only
-        math on raw arrays — no tape, runs inside the jitted generate loop
-        with static shapes throughout.
+        """KV-cache attention (serving): write this chunk's K/V at `pos` and
+        attend the queries over every cached position <= their own
+        (reference: the cache tensors fused_multi_transformer threads
+        through generation). Inference-only math on raw arrays — no tape,
+        runs inside the jitted generate loop with static shapes throughout.
 
-        `pos` is a scalar (one shared cursor: generate()'s lockstep batch)
-        or a [B] vector (per-row cursors: the serving engine's slots, each
-        batch row a request at its own depth)."""
-        k_buf, v_buf, pos = kv_cache          # jnp arrays + int32 scalar/[B]
+        Two cache layouts:
+          * contiguous — ``(k_buf, v_buf, pos)`` with [B, M, nh, hd]
+            buffers, each batch row owning one row;
+          * paged — ``(pool_k, pool_v, table, pos, write_end)`` with
+            [NB, BS, nh, hd] pools shared by all slots and a [B, mbs] int32
+            block table. K/V lands at physical ``(table[b, p//BS], p%BS)``;
+            the read side gathers each row's blocks back into a contiguous
+            [B, mbs*BS, nh, hd] view via ``jnp.take`` on the block axis.
+            Writes past ``write_end`` (padded chunk tails) or past the
+            table redirect to trash block 0 so a shared or out-of-range
+            block can never be corrupted by padding.
+
+        `pos` is a scalar (one shared cursor: generate()'s lockstep batch /
+        one slot's prefill chunk) or a [B] vector (per-row cursors: the
+        serving engine's slots, each batch row a request at its own depth).
+        """
         b, s, h = x.shape
         nh, hd = self.num_heads, self.head_dim
         qkv = self.qkv_proj(x).reshape([b, s, 3, nh, hd]).value()
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if len(kv_cache) == 5:
+            pos = kv_cache[3]
+            k_buf, v_buf, new_cache = _paged_kv_update(kv_cache, k, v)
+        else:
+            k_buf, v_buf, pos = kv_cache   # jnp arrays + int32 scalar/[B]
+            if jnp.ndim(pos) == 1:
+                upd = lambda buf, kv, p: jax.lax.dynamic_update_slice(
+                    buf, kv, (p, 0, 0))
+                k_buf = jax.vmap(upd)(k_buf, k.astype(k_buf.dtype), pos)
+                v_buf = jax.vmap(upd)(v_buf, v.astype(v_buf.dtype), pos)
+            else:
+                k_buf = jax.lax.dynamic_update_slice(
+                    k_buf, k.astype(k_buf.dtype), (0, pos, 0, 0))
+                v_buf = jax.lax.dynamic_update_slice(
+                    v_buf, v.astype(v_buf.dtype), (0, pos, 0, 0))
+            new_cache = (k_buf, v_buf)
         if jnp.ndim(pos) == 1:
-            upd = lambda buf, kv, p: jax.lax.dynamic_update_slice(
-                buf, kv, (p, 0, 0))
-            k_buf = jax.vmap(upd)(k_buf, k.astype(k_buf.dtype), pos)
-            v_buf = jax.vmap(upd)(v_buf, v.astype(v_buf.dtype), pos)
             q_pos = (pos[:, None] + jnp.arange(s))[:, None, :, None]
         else:
-            k_buf = jax.lax.dynamic_update_slice(k_buf, k.astype(k_buf.dtype),
-                                                 (0, pos, 0, 0))
-            v_buf = jax.lax.dynamic_update_slice(v_buf, v.astype(v_buf.dtype),
-                                                 (0, pos, 0, 0))
             q_pos = (pos + jnp.arange(s))[None, None, :, None]
         m = k_buf.shape[1]
         scores = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
@@ -290,7 +348,7 @@ class GPTAttention(nn.Layer):
         ctx = jnp.einsum("bnqk,bknd->bqnd", probs,
                          v_buf.astype(jnp.float32)).astype(q.dtype)
         out = self.out_proj(Tensor(ctx.reshape(b, s, h)))
-        return out, (k_buf, v_buf)
+        return out, new_cache
 
 
 class GPTMLP(nn.Layer):
@@ -417,7 +475,7 @@ class GPTModel(nn.Layer):
                 p.set_value(init(tuple(p.shape), p.dtype))
 
     def forward(self, input_ids, attn_mask=None, kv_caches=None,
-                start_pos=None):
+                start_pos=None, write_end=None):
         b, s = input_ids.shape
         if kv_caches is not None:
             if isinstance(self.h, GPTScannedBlocks):
@@ -426,14 +484,23 @@ class GPTModel(nn.Layer):
             p0 = start_pos if start_pos is not None else jnp.int32(0)
             if jnp.ndim(p0) == 1:
                 # per-slot cursors: each batch row reads its own positions
-                pos_ids = Tensor(p0[:, None]
-                                 + jnp.arange(s, dtype=jnp.int32)[None, :])
+                raw = p0[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
             else:
-                pos_ids = Tensor((p0 + jnp.arange(s, dtype=jnp.int32))[None, :])
+                raw = (p0 + jnp.arange(s, dtype=jnp.int32))[None, :]
+            # clamp for the LEARNED table: a padded chunk tail can step past
+            # it; valid positions are engine-validated < max_pos, so the
+            # clamp only ever touches garbage lanes
+            pos_ids = Tensor(jnp.minimum(
+                raw, self.config.max_position_embeddings - 1))
+            we = write_end if write_end is not None else p0 + s
             x = self.wte(input_ids) + self.wpe(pos_ids)
             new_caches = []
             for block, cache in zip(self.h, kv_caches):
-                x, nc = block(x, kv_cache=(cache[0], cache[1], p0))
+                if len(cache) == 3:    # paged: (pool_k, pool_v, block_table)
+                    kc = (cache[0], cache[1], cache[2], p0, we)
+                else:                  # contiguous: (k_buf, v_buf)
+                    kc = (cache[0], cache[1], p0)
+                x, nc = block(x, kv_cache=kc)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         pos = ops.arange(0, s, dtype="int32").unsqueeze(0)

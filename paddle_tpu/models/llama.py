@@ -147,30 +147,40 @@ class LlamaAttention(nn.Layer):
     def _forward_cached(self, x, kv_cache):
         """KV-cache attention with RoPE at absolute positions and GQA
         (queries fold onto their KV head). Inference-only raw-array math —
-        mirrors GPTAttention._forward_cached."""
+        mirrors GPTAttention._forward_cached, including the paged layout
+        (``(pool_k, pool_v, table, pos, write_end)``: block-pooled K/V read
+        through the table via jnp.take; see gpt._paged_kv_update)."""
         from ..core.tensor import Tensor
+        from .gpt import _paged_kv_update
 
-        k_buf, v_buf, pos = kv_cache        # [B, M, n_kv, hd], scalar int32
         b, s, h = x.shape
         nh, nkv, hd = self.num_heads, self.num_kv, self.head_dim
+        pos = kv_cache[3] if len(kv_cache) == 5 else kv_cache[2]
         q = self.q_proj(x).reshape([b, s, nh, hd])
         k = self.k_proj(x).reshape([b, s, nkv, hd])
         v = self.v_proj(x).reshape([b, s, nkv, hd])
         q, k = _op("rope", q, k, Tensor(jnp.asarray(pos)), theta=self.theta,
                    has_pos=True)
         qv, kv_, vv = q.value(), k.value(), v.value()
+        if len(kv_cache) == 5:
+            k_buf, v_buf, new_cache = _paged_kv_update(kv_cache, kv_, vv)
+        else:
+            k_buf, v_buf, _ = kv_cache      # [B, M, n_kv, hd] + cursor
+            if jnp.ndim(pos) == 1:
+                # per-slot cursors (serving engine): vmapped per-row writes
+                upd = lambda buf, kv, p: jax.lax.dynamic_update_slice(
+                    buf, kv, (p, 0, 0))
+                k_buf = jax.vmap(upd)(k_buf, kv_.astype(k_buf.dtype), pos)
+                v_buf = jax.vmap(upd)(v_buf, vv.astype(v_buf.dtype), pos)
+            else:
+                k_buf = jax.lax.dynamic_update_slice(
+                    k_buf, kv_.astype(k_buf.dtype), (0, pos, 0, 0))
+                v_buf = jax.lax.dynamic_update_slice(
+                    v_buf, vv.astype(v_buf.dtype), (0, pos, 0, 0))
+            new_cache = (k_buf, v_buf)
         if jnp.ndim(pos) == 1:
-            # per-slot cursors (serving engine): vmapped per-row writes
-            upd = lambda buf, kv, p: jax.lax.dynamic_update_slice(
-                buf, kv, (p, 0, 0))
-            k_buf = jax.vmap(upd)(k_buf, kv_.astype(k_buf.dtype), pos)
-            v_buf = jax.vmap(upd)(v_buf, vv.astype(v_buf.dtype), pos)
             q_pos = (pos[:, None] + jnp.arange(s))[:, None, None, :, None]
         else:
-            k_buf = jax.lax.dynamic_update_slice(
-                k_buf, kv_.astype(k_buf.dtype), (0, pos, 0, 0))
-            v_buf = jax.lax.dynamic_update_slice(
-                v_buf, vv.astype(v_buf.dtype), (0, pos, 0, 0))
             q_pos = (pos + jnp.arange(s))[None, None, None, :, None]
         m = k_buf.shape[1]
         group = nh // nkv
@@ -183,7 +193,7 @@ class LlamaAttention(nn.Layer):
         ctx = jnp.einsum("bkgqm,bmkd->bqkgd", probs,
                          v_buf.astype(jnp.float32)).astype(qv.dtype)
         out = self.o_proj(Tensor(ctx.reshape(b, s, h)))
-        return out, (k_buf, v_buf)
+        return out, new_cache
 
 
 class LlamaMLP(nn.Layer):
@@ -244,13 +254,20 @@ class LlamaModel(nn.Layer):
                         else normal)
                 p.set_value(init(tuple(p.shape), p.dtype))
 
-    def forward(self, input_ids, kv_caches=None, start_pos=None):
+    def forward(self, input_ids, kv_caches=None, start_pos=None,
+                write_end=None):
         x = self.embed_tokens(input_ids)
         if kv_caches is not None:
             p0 = start_pos if start_pos is not None else jnp.int32(0)
+            we = write_end if write_end is not None else p0 + \
+                jnp.int32(input_ids.shape[1])
             new_caches = []
             for block, cache in zip(self.layers, kv_caches):
-                x, nc = block(x, kv_cache=(cache[0], cache[1], p0))
+                if len(cache) == 3:    # paged: (pool_k, pool_v, block_table)
+                    kc = (cache[0], cache[1], cache[2], p0, we)
+                else:                  # contiguous: (k_buf, v_buf)
+                    kc = (cache[0], cache[1], p0)
+                x, nc = block(x, kv_cache=kc)
                 new_caches.append(nc)
             return self.norm(x), new_caches
         gran = self.config.recompute_granularity

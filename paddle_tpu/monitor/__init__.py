@@ -355,14 +355,20 @@ class Monitor:
     # ----------------------------------------------------- integration: serving
 
     def serve_engine(self, max_slots: int, max_len: int, buckets, quantize,
-                     engine_id=None):
-        """A DecodeEngine came up: record its static geometry."""
+                     engine_id=None, paged=None, block_size=None,
+                     kv_blocks=None, prefill_chunk=None):
+        """A DecodeEngine came up: record its static geometry (paged
+        engines add the block pool shape and the prefill chunk size)."""
         g = self.registry.gauge
         g("serve/max_slots").set(max_slots)
         g("serve/max_len").set(max_len)
+        if kv_blocks:
+            g("serve/kv_blocks").set(kv_blocks)
+            g("serve/block_size").set(block_size or 0)
         self.emit("serve_engine", max_slots=max_slots, max_len=max_len,
                   prefill_buckets=list(buckets), quantize=quantize,
-                  engine=engine_id)
+                  engine=engine_id, paged=paged, block_size=block_size,
+                  kv_blocks=kv_blocks, prefill_chunk=prefill_chunk)
 
     def serve_compiled(self, kind: str, bucket, compile_s: float, count: int,
                        engine_id=None):
@@ -378,14 +384,55 @@ class Monitor:
         self.emit("serve_compile", path=kind, bucket=bucket,
                   compile_s=compile_s, count=count, engine=engine_id)
 
-    def serve_request(self, queued: bool, error: Optional[str] = None):
+    def serve_request(self, queued: bool, error: Optional[str] = None,
+                      overload: bool = False):
         """submit() outcome: admitted to the queue, or rejected at the door
-        (malformed requests never reach a slot)."""
+        (malformed requests never reach a slot; ``overload`` marks a
+        well-formed request bounced off a full admission queue)."""
         if queued:
             self.registry.counter("serve/requests").inc()
         else:
             self.registry.counter("serve/rejected").inc()
-            self.emit("serve_reject", error=error)
+            if overload:
+                self.registry.counter("serve/rejected_overload").inc()
+            self.emit("serve_reject", error=error, overload=overload)
+
+    def serve_queue_wait(self, wait_s: float):
+        """Time a request sat in the admission queue before its slot
+        (saturation made visible: the queue is bounded, the wait is
+        measured)."""
+        self.registry.histogram("serve/queue_wait_s").observe(wait_s)
+
+    def serve_page_reject(self, free_blocks: int, needed_blocks: int):
+        """Paged admission refused for lack of KV blocks. ``free >=
+        needed`` in this event is the allocator-bug signature (refusal
+        without real pressure) that metrics_summary WARNs on."""
+        self.registry.counter("serve/page_rejects").inc()
+        self.emit("serve_page_reject", free_blocks=int(free_blocks),
+                  needed_blocks=int(needed_blocks))
+
+    def serve_preempted(self, nth: int):
+        """Pool pressure evicted a tenant back to the queue (its compute
+        is redone on re-admission)."""
+        self.registry.counter("serve/preemptions").inc()
+        self.emit("serve_preempt", nth=int(nth))
+
+    def serve_paged(self, pager_stats, kv_util: float, preemptions: int):
+        """Per-decode-step paged-pool gauges (cheap sets, no event)."""
+        g = self.registry.gauge
+        g("serve/blocks_free").set(pager_stats.blocks_free)
+        g("serve/blocks_used").set(pager_stats.blocks_used)
+        g("serve/blocks_shared").set(pager_stats.blocks_shared)
+        g("serve/block_refs").set(pager_stats.block_refs)
+        g("serve/cow_copies").set(pager_stats.cow_copies)
+        g("serve/kv_util").set(kv_util)
+        g("serve/page_occupancy").set(
+            pager_stats.blocks_used / pager_stats.blocks_total
+            if pager_stats.blocks_total else 0.0)
+        g("serve/sharing_ratio").set(
+            pager_stats.block_refs / pager_stats.blocks_used
+            if pager_stats.blocks_used else 1.0)
+        g("serve/preemptions").set(preemptions)
 
     def serve_admitted(self, ttft_s: float, bucket: int, prefill_s: float):
         """A request's prefill folded into a free slot; its first token is

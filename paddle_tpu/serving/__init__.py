@@ -20,7 +20,9 @@ Telemetry: ``serve/*`` counters/gauges/histograms in ``paddle_tpu.monitor``
 """
 from .engine import (DecodeEngine, Request, generate_via_engine,
                      quantize_for_serving)
+from .pager import BlockPager
 from .scheduler import AdmissionQueue, SlotAllocator
 
 __all__ = ["DecodeEngine", "Request", "generate_via_engine",
-           "quantize_for_serving", "AdmissionQueue", "SlotAllocator"]
+           "quantize_for_serving", "AdmissionQueue", "SlotAllocator",
+           "BlockPager"]

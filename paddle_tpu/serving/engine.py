@@ -1,27 +1,40 @@
-"""Compiled decode engine: paged KV cache + continuous batching.
+"""Compiled decode engine: block-paged KV cache + continuous batching.
 
 The serving analog of ``jit.TrainStep``: every hot-path computation is an
 AOT executable (``jax.jit(...).lower().compile()``) minted ONCE per shape
 bucket, and the steady state runs zero recompiles no matter which requests
-come and go. Two executable families:
+come and go.
+
+Default (``paged=True``) memory model — **block page table** (vLLM, Kwon
+et al. 2023): the KV pool is per-layer ``[kv_blocks, block_size, n_kv,
+hd]`` K/V pairs plus a fixed-shape ``[max_slots, max_blocks_per_slot]``
+int32 block-index table. Which physical block backs which logical position
+is table DATA, never executable shape — admissions, evictions, block
+allocation, prefix sharing and copy-on-write all leave the compiled
+programs untouched. A host-side ``pager.BlockPager`` owns the free list,
+refcounts, hash-keyed shared prefix blocks and COW decisions; the device
+copies a COW needs ride INTO the next decode/chunk call as ``(src, dst)``
+index arguments (padded with trash-block pairs), so COW costs no extra
+executable and no extra dispatch. Executable families:
 
 * **decode step** — fixed shape ``[max_slots, 1]``: one token for every
-  slot of the preallocated KV cache, each slot reading/writing at its OWN
-  cursor (``pos`` is a ``[max_slots]`` vector; the models' cached-attention
-  path vmaps a per-row ``dynamic_update_slice``). Slot membership is data,
-  not shape: admissions and evictions change ``pos``/``tok`` values, never
-  the executable. One compile, ever.
-* **prefill** — one executable per prompt-length bucket ``[1, S_b]``: runs
-  the prompt through the backbone with a small bucket-sized cache, writes
-  the resulting K/V block into the big cache at the assigned slot row
-  (``dynamic_update_slice`` at ``(slot, 0, 0, 0)``), and emits the first
-  generated token from the TRUE last prompt position (padding is masked by
-  causality). While one slot prefills, every other slot's state just waits
-  — the next decode step picks them all up together (vLLM/Orca-style
-  iteration-level scheduling, PAPERS.md).
+  slot, each row reading its K/V through the block table (``jnp.take`` on
+  the block axis) and writing at its own cursor. One compile, ever.
+* **chunk prefill** — ONE executable of shape ``[1, prefill_chunk]``
+  (decode-shaped: same pool + table machinery, serves any prompt length):
+  each scheduler iteration feeds at most ``prefill_chunk`` prompt tokens
+  of the admitting request through it, so a 2k-token prompt admits over
+  several steps instead of freezing every live slot behind a monolithic
+  prefill (Sarathi-Serve). ``prefill_chunk=None`` falls back to one
+  bucketed whole-prompt chunk per admission (monolithic; one executable
+  per prompt-length bucket, the PR 6 scheduling behavior).
 
-The paged cache is per-layer ``[max_slots, max_len, n_kv, hd]`` K/V pairs,
-donated through every executable call so XLA updates them in place —
+``paged=False`` keeps the slot-owns-a-row layout (per-layer
+``[max_slots, max_len, n_kv, hd]`` buffers, bucketed monolithic prefill
+writing the K/V block at the slot row) — the control arm the paged
+microbenches gate against.
+
+Pools/buffers are donated through every call so XLA updates them in place;
 steady-state decode allocates nothing. Stale K/V from a slot's previous
 tenant is harmless by construction: causal masking only exposes positions
 ``<= cursor``, and every position below the cursor was freshly written by
@@ -47,6 +60,7 @@ from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from ..models.gpt import (_lm_head_logits, _pick_token,
                           _resolve_decode_horizon)
+from .pager import TRASH_BLOCK, BlockPager
 from .scheduler import AdmissionQueue, Request, SlotAllocator
 
 __all__ = ["DecodeEngine", "Request", "generate_via_engine",
@@ -115,31 +129,66 @@ def quantize_for_serving(model, skip: Sequence = ()):
     return swap_sublayers(model, swap)
 
 
+class _PrefillState:
+    """One slot's in-flight chunked prefill: which prompt positions are
+    cached so far (shared-prefix coverage counts) and the pending COW
+    copies the next chunk call must apply."""
+
+    __slots__ = ("req", "prompt", "n", "done", "pending_copies",
+                 "prefill_s", "chunks")
+
+    def __init__(self, req: Request, start: int,
+                 pending_copies: List[tuple]):
+        self.req = req
+        self.prompt = np.asarray(req.prompt, np.int32)
+        self.n = len(req.prompt)
+        self.done = int(start)            # positions already cached
+        self.pending_copies = list(pending_copies)
+        self.prefill_s = 0.0
+        self.chunks = 0
+
+
 class DecodeEngine:
     """AOT-compiled serving engine over one causal LM.
 
     Knobs:
-      max_slots        batch rows of the paged KV cache (concurrent requests)
+      max_slots        batch rows of the decode step (concurrent requests)
       max_len          per-slot KV horizon; prompt + new tokens must fit
-      prefill_buckets  padded prompt lengths (one executable each);
-                       default: powers of two up to max_len
+      paged            block page table (default) vs slot-owns-a-row cache
+      block_size       tokens per KV block (paged)
+      kv_blocks        physical pool size incl. the reserved trash block;
+                       default max_slots*ceil(max_len/block_size)+1 (full
+                       row-cache capacity) — set it SMALLER to oversubscribe
+                       (prefix sharing is what makes that safe)
+      prefill_chunk    paged only: at most this many prompt tokens run per
+                       scheduler iteration through ONE [1, chunk] executable
+                       (None: whole-prompt bucketed chunks, monolithic)
+      prefill_buckets  padded prompt lengths for monolithic prefill (one
+                       executable each); default: powers of two up to
+                       max_len; unused when prefill_chunk is set
+      max_queue        admission-queue bound; a full queue rejects at the
+                       door with status="rejected_overload" (None: unbounded)
       quantize         None | "int8" (weight-only, converts model in place)
       do_sample/temperature/top_k/seed
                        sampling config — STATIC per engine (baked into the
                        executables); greedy by default
 
     ``submit()`` validates and queues; ``step()`` runs ONE scheduler
-    iteration (admit into free slots via prefill, then one decode step over
-    all live slots); ``run()`` drains. Telemetry lands under ``serve/*``
-    when the monitor is enabled, and every minted executable bumps
-    ``compile_count`` (the serving recompile sentinel — flat in steady
-    state).
+    iteration (admit into free slots, advance pending prefill chunks, then
+    one decode step over all live slots); ``run()`` drains. Telemetry lands
+    under ``serve/*`` when the monitor is enabled, and every minted
+    executable bumps ``compile_count`` (the serving recompile sentinel —
+    flat in steady state).
     """
 
     _ids = itertools.count()
 
     def __init__(self, model, *, max_slots: int = 8, max_len: int = 256,
+                 paged: bool = True, block_size: int = 16,
+                 kv_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
+                 max_queue: Optional[int] = 1024,
                  quantize: Optional[str] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, seed: int = 0):
         if max_slots < 1:
@@ -161,6 +210,7 @@ class DecodeEngine:
         self.quantize = quantize
         self.max_slots = int(max_slots)
         self.max_len = int(max_len)
+        self.paged = bool(paged)
         self._do_sample = bool(do_sample)
         self._temperature = float(temperature)
         self._top_k = int(top_k)
@@ -170,12 +220,54 @@ class DecodeEngine:
         self._leaves = [p for _, p in model.named_parameters()] \
             + [b for _, b in model.named_buffers()]
         self._cache_dtype = spec.head_weight.value().dtype
-        self._caches = [
-            (jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
-                        spec.head_dim), self._cache_dtype),
-             jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
-                        spec.head_dim), self._cache_dtype))
-            for _ in range(spec.num_layers)]
+        if self.paged:
+            if block_size < 1:
+                raise ValueError(f"block_size must be >= 1, got {block_size}")
+            self.block_size = int(min(block_size, self.max_len))
+            self._mbs = -(-self.max_len // self.block_size)
+            if kv_blocks is None:
+                kv_blocks = self.max_slots * self._mbs + 1
+            if kv_blocks < self._mbs + 2:
+                raise ValueError(
+                    f"kv_blocks {kv_blocks} cannot back even one full slot "
+                    f"({self._mbs} blocks + trash)")
+            self.kv_blocks = int(kv_blocks)
+            if prefill_chunk is not None and not (
+                    1 <= int(prefill_chunk) <= self.max_len):
+                raise ValueError(f"prefill_chunk must lie in [1, max_len="
+                                 f"{self.max_len}], got {prefill_chunk}")
+            self.prefill_chunk = None if prefill_chunk is None \
+                else int(prefill_chunk)
+            self._pools = [
+                (jnp.zeros((self.kv_blocks, self.block_size,
+                            spec.n_kv_heads, spec.head_dim),
+                           self._cache_dtype),
+                 jnp.zeros((self.kv_blocks, self.block_size,
+                            spec.n_kv_heads, spec.head_dim),
+                           self._cache_dtype))
+                for _ in range(spec.num_layers)]
+            self._pager = BlockPager(self.kv_blocks, self.block_size,
+                                     self.max_slots, self._mbs)
+            self._caches = None
+            # in-flight chunked prefills: slot -> _PrefillState
+            self._prefilling: dict = {}
+            self._admit_seq = itertools.count()   # eviction picks youngest
+            self._slot_seq = [0] * self.max_slots
+            self.preemptions = 0
+        else:
+            if prefill_chunk is not None:
+                raise ValueError("prefill_chunk requires paged=True")
+            self.block_size = self.kv_blocks = None
+            self.prefill_chunk = None
+            self._pools = self._pager = None
+            self._prefilling = {}
+            self.preemptions = 0
+            self._caches = [
+                (jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
+                            spec.head_dim), self._cache_dtype),
+                 jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
+                            spec.head_dim), self._cache_dtype))
+                for _ in range(spec.num_layers)]
         if prefill_buckets is None:
             buckets, b = [], 8
             while b < self.max_len:
@@ -189,13 +281,14 @@ class DecodeEngine:
                                  f"[1, max_len={self.max_len}]: {buckets}")
         self.prefill_buckets = sorted(set(buckets))
         # host-side slot state: cursors/last-token per row; dead rows sit at
-        # pos 0 (their decode writes land on a row the next prefill rewrites)
+        # pos 0 (their decode writes land on a row — or, paged, the trash
+        # block — that the next admission rewrites)
         self._pos = np.zeros(self.max_slots, np.int32)
         self._tok = np.zeros(self.max_slots, np.int32)
         self._live = np.zeros(self.max_slots, bool)
         self._slot_req: List[Optional[Request]] = [None] * self.max_slots
         self._slots = SlotAllocator(self.max_slots)
-        self._queue = AdmissionQueue()
+        self._queue = AdmissionQueue(max_queue)
         self._decode_exe = None
         self._prefill_exes = {}
         self._key = jax.random.PRNGKey(int(seed))
@@ -209,7 +302,10 @@ class DecodeEngine:
         if mon is not None:
             mon.serve_engine(self.max_slots, self.max_len,
                              self.prefill_buckets, quantize,
-                             engine_id=self.engine_id)
+                             engine_id=self.engine_id, paged=self.paged,
+                             block_size=self.block_size,
+                             kv_blocks=self.kv_blocks,
+                             prefill_chunk=self.prefill_chunk)
 
     # ------------------------------------------------------------- tracing
 
@@ -272,25 +368,92 @@ class DecodeEngine:
 
     # --------------------------------------------------------- executables
 
+    @staticmethod
+    def _apply_cow(pools, src, dst):
+        """Fold the pager's pending copy-on-write block copies into the
+        executable: ``pools[l][dst[i]] = pools[l][src[i]]`` before anything
+        reads or writes. Padded entries are (0, 0) trash-to-trash no-ops,
+        so the shape is always [max_slots] and COW never retraces."""
+        return [(pk.at[dst].set(jnp.take(pk, src, axis=0)),
+                 pv.at[dst].set(jnp.take(pv, src, axis=0)))
+                for pk, pv in pools]
+
     def _build_decode(self):
         spec = self.spec
 
-        def fn(leaves, caches, tok, pos, key):
-            def body():
-                hidden, new_caches = spec.backbone(
-                    Tensor(tok[:, None]), kv_caches=caches, start_pos=pos)
-                logits = self._head(hidden.value()[:, -1])
-                nxt = self._pick(logits, key).astype(jnp.int32)
-                return new_caches, nxt
-            return self._traced(leaves, body)
+        if self.paged:
+            def fn(leaves, pools, table, tok, pos, cow_src, cow_dst, key):
+                def body():
+                    pools2 = self._apply_cow(pools, cow_src, cow_dst)
+                    caches = [(pk, pv, table) for pk, pv in pools2]
+                    hidden, new_pools = spec.backbone(
+                        Tensor(tok[:, None]), kv_caches=caches,
+                        start_pos=pos)
+                    logits = self._head(hidden.value()[:, -1])
+                    nxt = self._pick(logits, key).astype(jnp.int32)
+                    return new_pools, nxt
+                return self._traced(leaves, body)
 
-        args = (self._leaf_values(), self._caches,
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                self._greedy_key)
+            pad = jnp.zeros(self.max_slots, jnp.int32)
+            args = (self._leaf_values(), self._pools,
+                    jnp.asarray(self._pager.tables), jnp.asarray(self._tok),
+                    jnp.asarray(self._pos), pad, pad, self._greedy_key)
+        else:
+            def fn(leaves, caches, tok, pos, key):
+                def body():
+                    hidden, new_caches = spec.backbone(
+                        Tensor(tok[:, None]), kv_caches=caches,
+                        start_pos=pos)
+                    logits = self._head(hidden.value()[:, -1])
+                    nxt = self._pick(logits, key).astype(jnp.int32)
+                    return new_caches, nxt
+                return self._traced(leaves, body)
+
+            args = (self._leaf_values(), self._caches,
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    self._greedy_key)
         t0 = time.time()
         exe = self._compile_in_eval(fn, args)
         self._decode_exe = exe
         self._minted("decode", None, time.time() - t0)
+        return exe
+
+    def _build_chunk(self, sc: int):
+        """Paged prefill chunk: run ``sc`` prompt tokens of ONE slot through
+        the backbone at absolute start position ``p0``, reading/writing K/V
+        through the slot's block-table row (any already-cached prefix —
+        earlier chunks or shared blocks — is attended via the table).
+        ``end`` is the absolute end of VALID tokens in this call: the write
+        path trashes the padded tail, and the returned token is picked from
+        the true last position (only the final chunk's pick is used)."""
+        spec = self.spec
+        mbs = self._mbs
+
+        def fn(leaves, pools, table, ids, slot, p0, end, cow_src, cow_dst,
+               key):
+            def body():
+                pools2 = self._apply_cow(pools, cow_src, cow_dst)
+                row = jax.lax.dynamic_slice(table, (slot, jnp.int32(0)),
+                                            (1, mbs))
+                caches = [(pk, pv, row) for pk, pv in pools2]
+                hidden, new_pools = spec.backbone(
+                    Tensor(ids), kv_caches=caches, start_pos=p0,
+                    write_end=end)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    hidden.value(), end - p0 - 1, 1, axis=1)[:, 0]
+                tok0 = self._pick(self._head(h_last), key).astype(jnp.int32)
+                return new_pools, tok0[0]
+            return self._traced(leaves, body)
+
+        pad = jnp.zeros(self.max_slots, jnp.int32)
+        args = (self._leaf_values(), self._pools,
+                jnp.asarray(self._pager.tables),
+                jnp.zeros((1, sc), jnp.int32), jnp.int32(0), jnp.int32(0),
+                jnp.int32(1), pad, pad, self._greedy_key)
+        t0 = time.time()
+        exe = self._compile_in_eval(fn, args)
+        self._prefill_exes[sc] = exe
+        self._minted("prefill", sc, time.time() - t0)
         return exe
 
     def _build_prefill(self, sb: int):
@@ -342,7 +505,10 @@ class DecodeEngine:
                ) -> Request:
         """Validate + enqueue one request. A malformed request comes back
         ``failed`` with ``error`` set and is never admitted — the live
-        batch cannot be poisoned by one bad input."""
+        batch cannot be poisoned by one bad input. A well-formed request
+        hitting a FULL admission queue comes back ``rejected_overload``
+        (saturation is the caller's signal to back off, not the engine's
+        license to grow host memory without bound)."""
         try:
             req = Request(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id, request_id=request_id)
@@ -365,12 +531,26 @@ class DecodeEngine:
             self._reject(req, f"prompt {n} + max_new_tokens "
                               f"{req.max_new_tokens} exceeds engine "
                               f"max_len {self.max_len}")
-        elif self._bucket_for(n) is None:
+        elif self.paged and self._pager.blocks_for(
+                n + req.max_new_tokens) > self._pager.usable_blocks:
+            self._reject(req, f"request needs "
+                              f"{self._pager.blocks_for(n + req.max_new_tokens)} "
+                              f"KV blocks, pool holds "
+                              f"{self._pager.usable_blocks}")
+        elif (self.prefill_chunk is None
+              and self._bucket_for(n) is None):
             self._reject(req, f"prompt length {n} exceeds the largest "
                               f"prefill bucket "
                               f"({self.prefill_buckets[-1]})")
+        elif not self._queue.push(req):
+            req.status, req.error = "rejected_overload", \
+                f"admission queue full ({self._queue.max_queue})"
+            req.t_done = time.time()
+            mon = _monitor._active
+            if mon is not None:
+                mon.serve_request(queued=False, error=req.error,
+                                  overload=True)
         else:
-            self._queue.push(req)
             mon = _monitor._active
             if mon is not None:
                 mon.serve_request(queued=True)
@@ -389,16 +569,33 @@ class DecodeEngine:
         return int(self._live.sum())
 
     @property
+    def active_count(self) -> int:
+        """Admitted concurrent requests: decoding + mid-prefill. The figure
+        the paged-vs-row concurrency microbench gates on."""
+        return self.live_count + len(self._prefilling)
+
+    @property
     def queue_depth(self) -> int:
         return len(self._queue)
 
     def step(self) -> List[Request]:
         """ONE iteration of continuous batching: fold queued prompts into
-        free slots (prefill), then decode every live slot one token.
+        free slots, advance every in-flight chunked prefill by at most
+        ``prefill_chunk`` tokens, then decode every live slot one token.
         Returns the requests that finished during this step."""
         finished: List[Request] = []
         while self._queue and self._slots.n_free:
-            self._admit(self._queue.pop(), self._slots.alloc(), finished)
+            if self.paged:
+                if not self._try_admit_paged(self._queue.peek()):
+                    break          # head-of-line waits for blocks, FIFO kept
+                self._queue.pop()
+            else:
+                self._admit(self._queue.pop(), self._slots.alloc(), finished)
+        if self._prefilling:
+            for slot in sorted(self._prefilling,
+                               key=lambda s: self._slot_seq[s]):
+                if slot in self._prefilling:   # an earlier ensure may evict
+                    self._advance_prefill(slot, finished)
         if self._live.any():
             self._decode(finished)
         return finished
@@ -409,7 +606,7 @@ class DecodeEngine:
         undrained engine raises."""
         out: List[Request] = []
         steps = 0
-        while self._queue or self._live.any():
+        while self._queue or self._live.any() or self._prefilling:
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(
                     f"run() exceeded max_steps={max_steps} with "
@@ -417,6 +614,151 @@ class DecodeEngine:
             out.extend(self.step())
             steps += 1
         return out
+
+    # ------------------------------------------------- paged scheduling
+
+    def _chunk_len(self, n: int) -> int:
+        """Shape of the chunk executable serving a length-n prompt: the
+        fixed ``prefill_chunk``, else the monolithic bucket for n (sized as
+        if unshared, so prefix sharing never changes which executable runs
+        — sharing must not mint in steady state)."""
+        return self.prefill_chunk or self._bucket_for(n)
+
+    def _cow_args(self, copies):
+        """(src, dst) block-copy pairs -> fixed-shape [max_slots] int32
+        executable arguments, padded with (0, 0) trash no-ops."""
+        src = np.zeros(self.max_slots, np.int32)
+        dst = np.zeros(self.max_slots, np.int32)
+        for i, (s, d) in enumerate(copies):
+            src[i], dst[i] = s, d
+        return jnp.asarray(src), jnp.asarray(dst)
+
+    def _try_admit_paged(self, req: Request) -> bool:
+        """Assign a slot, adopt any shared prompt prefix, and reserve the
+        first chunk's blocks. False = the pool cannot host the first chunk
+        right now; the request stays at the head of the queue (the emitted
+        ``serve_page_reject`` event carries free-vs-needed so a refusal
+        with free >= needed — an allocator bug, not saturation — is
+        flaggable downstream)."""
+        n = len(req.prompt)
+        slot = self._slots.alloc()
+        cov = self._pager.share_prefix(slot, req.prompt)
+        end = min(cov + self._chunk_len(n), n)
+        copies = self._pager.ensure_writable(slot, cov, end)
+        if copies is None:
+            needed = self._pager.blocks_needed(slot, cov, end)
+            free = self._pager.free_blocks
+            self._pager.release_slot(slot)
+            self._slots.release(slot)
+            mon = _monitor._active
+            if mon is not None:
+                mon.serve_page_reject(free, needed)
+            return False
+        self._slot_seq[slot] = next(self._admit_seq)
+        self._prefilling[slot] = _PrefillState(req, cov, copies)
+        req.slot, req.status = slot, "prefilling"
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_queue_wait(time.time() - req.t_submit)
+        return True
+
+    def _advance_prefill(self, slot: int, finished: List[Request]):
+        """Run ONE chunk of ``slot``'s pending prefill (at most
+        ``prefill_chunk`` prompt tokens) through the chunk executable; on
+        the final chunk, emit the first generated token and promote the
+        slot to the decode batch."""
+        st = self._prefilling[slot]
+        p0 = st.done
+        sc = self._chunk_len(st.n)
+        end = min(p0 + sc, st.n)
+        copies, st.pending_copies = st.pending_copies, []
+        more = self._ensure_or_evict(slot, p0, end)
+        if more is None or slot not in self._prefilling:
+            return                         # this very slot was preempted
+        copies += more
+        exe = self._prefill_exes.get(sc)
+        if exe is None:
+            exe = self._build_chunk(sc)
+        ids = np.zeros((1, sc), np.int32)
+        ids[0, :end - p0] = st.prompt[p0:end]
+        src, dst = self._cow_args(copies)
+        t0 = time.time()
+        self._pools, tok0 = exe(
+            self._leaf_values(), self._pools,
+            jnp.asarray(self._pager.tables), jnp.asarray(ids),
+            jnp.int32(slot), jnp.int32(p0), jnp.int32(end), src, dst,
+            self._next_key())
+        st.prefill_s += time.time() - t0
+        st.done = end
+        st.chunks += 1
+        if end < st.n:
+            return                         # more chunks next iteration
+        req = st.req
+        self._pager.register_prompt(slot, st.prompt)
+        del self._prefilling[slot]
+        t = int(tok0)
+        req.status = "running"
+        req.t_first_token = time.time()
+        req.tokens.append(t)
+        self.tokens_generated += 1
+        self._pos[slot] = st.n
+        self._tok[slot] = t
+        self._live[slot] = True
+        self._slot_req[slot] = req
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_admitted(req.t_first_token - req.t_submit, sc,
+                               st.prefill_s)
+        if req._stop_hit():
+            self._finish(req, finished)
+
+    def _youngest_victim(self, requester: int) -> Optional[int]:
+        """Pool-pressure victim: the YOUNGEST tenant, the requester
+        included — a newly admitted request must never starve an older one
+        off its blocks (the oldest tenant is therefore never evicted and
+        always progresses, which is what makes eviction churn terminate).
+        """
+        cands = [s for s in range(self.max_slots)
+                 if s == requester or self._live[s]
+                 or s in self._prefilling]
+        return max(cands, key=lambda s: self._slot_seq[s], default=None)
+
+    def _preempt(self, slot: int):
+        """Pool pressure: evict the tenant of ``slot`` back to the FRONT of
+        the queue (its blocks free immediately; its compute is redone on
+        re-admission — vLLM's recompute-style preemption)."""
+        st = self._prefilling.pop(slot, None)
+        req = st.req if st is not None else self._slot_req[slot]
+        self._pager.release_slot(slot)
+        self._slots.release(slot)
+        self._live[slot] = False
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._slot_req[slot] = None
+        req.status, req.slot = "queued", None
+        req.tokens = []
+        req.t_first_token = None
+        req.preemptions += 1
+        self._queue.push_front(req)
+        self.preemptions += 1
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_preempted(req.preemptions)
+
+    def _ensure_or_evict(self, slot: int, start: int, end: int):
+        """ensure_writable with pool-pressure eviction: preempt youngest
+        tenants until the range fits. Returns the COW copies, or None when
+        ``slot`` was itself the youngest and got preempted (its request is
+        back at the head of the queue)."""
+        while True:
+            copies = self._pager.ensure_writable(slot, start, end)
+            if copies is not None:
+                return copies
+            victim = self._youngest_victim(slot)
+            assert victim is not None
+            self._preempt(victim)
+            if victim == slot:
+                return None
 
     def _admit(self, req: Request, slot: int, finished: List[Request]):
         n = len(req.prompt)
@@ -442,6 +784,7 @@ class DecodeEngine:
         self._slot_req[slot] = req
         mon = _monitor._active
         if mon is not None:
+            mon.serve_queue_wait(req.t_first_token - req.t_submit - dt)
             mon.serve_admitted(req.t_first_token - req.t_submit, sb, dt)
         if req._stop_hit():
             self._finish(req, finished)
@@ -450,10 +793,41 @@ class DecodeEngine:
         exe = self._decode_exe
         if exe is None:
             exe = self._build_decode()
-        t0 = time.time()
-        self._caches, nxt = exe(
-            self._leaf_values(), self._caches, jnp.asarray(self._tok),
-            jnp.asarray(self._pos), self._next_key())
+        if self.paged:
+            # make every live slot's write target private + present. A
+            # preempted victim's pending copies are DROPPED with it — its
+            # freed blocks may be re-handed to the very slot being ensured
+            copies_by_slot = {}
+            slot = 0
+            while slot < self.max_slots:
+                if not self._live[slot]:
+                    slot += 1
+                    continue
+                p = int(self._pos[slot])
+                c = self._pager.ensure_writable(slot, p, p + 1)
+                if c is None:
+                    victim = self._youngest_victim(slot)
+                    self._preempt(victim)
+                    copies_by_slot.pop(victim, None)
+                    if victim == slot:     # self-preempted: skip this row
+                        slot += 1
+                    continue               # else retry the same slot
+                copies_by_slot[slot] = c
+                slot += 1
+            if not self._live.any():       # everyone self-preempted
+                return
+            src, dst = self._cow_args(
+                [p for c in copies_by_slot.values() for p in c])
+            t0 = time.time()
+            self._pools, nxt = exe(
+                self._leaf_values(), self._pools,
+                jnp.asarray(self._pager.tables), jnp.asarray(self._tok),
+                jnp.asarray(self._pos), src, dst, self._next_key())
+        else:
+            t0 = time.time()
+            self._caches, nxt = exe(
+                self._leaf_values(), self._caches, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), self._next_key())
         nxt = np.asarray(nxt)
         dt = time.time() - t0
         live = 0
@@ -473,6 +847,9 @@ class DecodeEngine:
         mon = _monitor._active
         if mon is not None:
             mon.serve_step(dt, live, len(self._queue))
+            if self.paged:
+                mon.serve_paged(self._pager.stats(), self.kv_util(),
+                                self.preemptions)
 
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot
@@ -480,6 +857,8 @@ class DecodeEngine:
         self._pos[slot] = 0
         self._tok[slot] = 0
         self._slot_req[slot] = None
+        if self.paged:
+            self._pager.release_slot(slot)
         self._slots.release(slot)
         req.status, req.t_done = "done", time.time()
         finished.append(req)
@@ -490,8 +869,20 @@ class DecodeEngine:
 
     # ------------------------------------------------------------- insight
 
+    def kv_util(self) -> float:
+        """Live cached tokens / pooled token capacity — the paged memory
+        headroom figure bench.py reports. (Row cache: capacity is the full
+        slot grid, which is exactly what paging exists to beat.)"""
+        cached = int(self._pos[self._live].sum()) \
+            + sum(st.done for st in self._prefilling.values())
+        if self.paged:
+            cap = self._pager.usable_blocks * self.block_size
+        else:
+            cap = self.max_slots * self.max_len
+        return cached / cap if cap else 0.0
+
     def stats(self) -> dict:
-        return {
+        out = {
             "compile_count": self.compile_count,
             "executables": 1 + len(self._prefill_exes)
             if self._decode_exe is not None else len(self._prefill_exes),
@@ -499,7 +890,14 @@ class DecodeEngine:
             "tokens_generated": self.tokens_generated,
             "live_slots": self.live_count,
             "queue_depth": self.queue_depth,
+            "kv_util": round(self.kv_util(), 4),
         }
+        if self.paged:
+            out["paged"] = dict(self._pager.stats().as_dict(),
+                                block_size=self.block_size,
+                                preemptions=self.preemptions,
+                                prefilling=len(self._prefilling))
+        return out
 
 
 def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
@@ -508,12 +906,19 @@ def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
                         max_length=None):
     """`model.generate(use_engine=True)` backend: run the batch through a
     DecodeEngine and reassemble the eager ``generate()`` output contract
-    (``[B, s0 + max_new_tokens]``, finished rows padded with eos). Engines
-    are cached on the model per (horizon, slots, sampling config) — repeat
-    calls reuse the compiled prefill/decode executables; a reused sampling
-    engine just restarts its host key stream from ``seed`` (the PRNG key is
-    an executable ARGUMENT, not baked in). A cached engine whose leaf list
-    no longer matches the model (an in-place int8 swap happened since) is
+    (``[B, s0 + max_new_tokens]``, finished rows padded with eos).
+
+    ONE engine per model geometry: the cache key is ``(max_slots, max_len,
+    quantize, sampling config)`` where max_len is the caller's horizon
+    rounded UP to a power-of-two bucket and max_slots is a constant 8 —
+    mixed-horizon callers land on the same engine instead of minting a
+    fresh executable set per exact (prompt, max_new) pair, and the paged
+    engine's chunked prefill serves ANY prompt length through one chunk
+    executable (prompt-length buckets are gone). Repeat calls reuse the
+    compiled chunk/decode executables; a reused sampling engine just
+    restarts its host key stream from ``seed`` (the PRNG key is an
+    executable ARGUMENT, not baked in). A cached engine whose leaf list no
+    longer matches the model (an in-place int8 swap happened since) is
     dropped rather than served with detached weights."""
     ids_arr = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                          else input_ids).astype(np.int32)
@@ -525,9 +930,15 @@ def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
                                       spec.max_pos, seed, do_sample)
     if max_new_tokens == 0:
         return Tensor(jnp.asarray(ids_arr))
-    slots = min(b, 8)
+    slots = 8
+    ml = 16
+    while ml < m:
+        ml *= 2
+    ml = max(min(ml, spec.max_pos), m)
+    quant = any(str(bf.value().dtype) == "int8"
+                for _, bf in lm.named_buffers())
     engines = lm.__dict__.setdefault("_serving_engines", {})
-    key = (m, slots, do_sample,
+    key = (slots, ml, quant, do_sample,
            (float(temperature), int(top_k)) if do_sample else None)
     engine = engines.get(key)
     if engine is not None:
@@ -544,7 +955,8 @@ def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
     if engine is None:
         if len(engines) >= 4:
             engines.pop(next(iter(engines)))
-        engine = DecodeEngine(lm, max_slots=slots, max_len=m,
+        engine = DecodeEngine(lm, max_slots=slots, max_len=ml, paged=True,
+                              prefill_chunk=min(32, ml),
                               do_sample=do_sample, temperature=temperature,
                               top_k=top_k, seed=seed)
         engines[key] = engine

@@ -1,0 +1,254 @@
+"""Host-side block pager for the paged KV cache (vLLM-style, Kwon et al.).
+
+The device side (engine.py) holds per-layer ``[num_blocks, block_size,
+n_kv, hd]`` K/V pools and a fixed-shape ``[max_slots, max_blocks_per_slot]``
+int32 block-index table; THIS file owns every allocation decision — which
+physical block backs which logical position of which slot — as pure host
+bookkeeping over numpy arrays. Admissions, evictions, prefix sharing and
+copy-on-write all mutate table *data*, never executable *shapes*, which is
+how the engine's zero-steady-state-recompile contract survives paging.
+
+Mechanics:
+
+* **free list** — physical blocks are fungible; block 0 is reserved as the
+  TRASH block (dead slots' decode writes and padded chunk-tail writes are
+  redirected there by the executables, so the allocator never hands it out).
+* **refcounts** — a block may back several slots at once (shared prompt
+  prefix). A slot finishing decrements; at zero the block returns to the
+  free list and its prefix registration is dropped (sharing is therefore
+  scoped to CONCURRENT requests — there is no persistent prefix cache).
+* **prefix registry** — when a slot's prefill completes, each of its prompt
+  blocks is registered under the exact token prefix it covers
+  (``tuple(tokens[:k*bs])`` per full block, ``tuple(tokens[:n])`` for the
+  partial tail). A later admission walks the chain and adopts the longest
+  match, capped at ``n-1`` tokens — the last prompt token is always
+  recomputed because the FIRST GENERATED token needs its hidden state,
+  which is not cached (only K/V is).
+* **copy-on-write** — writes only ever land at a slot's cursor, so shared
+  FULL blocks are naturally read-only; the one writable shared case is the
+  partial tail block (or a fully-shared final block under the n-1 cap).
+  ``ensure_writable`` detects refcount > 1 at the write target, moves the
+  slot onto a fresh block and reports the (src, dst) pair — the engine
+  folds the device-side block copy into the next executable call as data
+  arguments (no dedicated copy executable, no extra dispatch).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["BlockPager", "PagerStats"]
+
+TRASH_BLOCK = 0
+
+
+class PagerStats:
+    """Point-in-time allocator view (engine surfaces it via stats())."""
+
+    __slots__ = ("blocks_total", "blocks_free", "blocks_used",
+                 "blocks_shared", "block_refs", "cow_copies", "shared_hits",
+                 "shared_tokens")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class BlockPager:
+    """Free-list + refcount + prefix-hash allocator over one block pool.
+
+    ``tables`` is the authoritative host copy of the device block table:
+    ``[max_slots, max_blocks_per_slot]`` int32, row zeroed for free slots
+    (entry 0 == TRASH_BLOCK, never a real allocation).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, max_slots: int,
+                 blocks_per_slot: int):
+        if num_blocks < 2:
+            raise ValueError(f"kv_blocks must be >= 2 (block 0 is the trash "
+                             f"block), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_slots = int(max_slots)
+        self.blocks_per_slot = int(blocks_per_slot)
+        self.tables = np.zeros((max_slots, blocks_per_slot), np.int32)
+        # LIFO free list: recently freed blocks are re-handed first
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref = np.zeros(num_blocks, np.int32)
+        # exact-prefix registry: tuple(prompt_tokens[:k]) -> physical block
+        self._registry: Dict[tuple, int] = {}
+        self._block_key: Dict[int, tuple] = {}
+        # cumulative telemetry (monitor gauges/counters read these)
+        self.cow_copies = 0
+        self.shared_hits = 0          # admissions that adopted >= 1 block
+        self.shared_tokens = 0        # prompt tokens served from shared blocks
+
+    # ------------------------------------------------------------ accounting
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1          # minus the trash block
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_used(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    def stats(self) -> PagerStats:
+        used = self._ref > 0
+        return PagerStats(
+            blocks_total=self.usable_blocks, blocks_free=self.free_blocks,
+            blocks_used=self.blocks_used,
+            blocks_shared=int((self._ref > 1).sum()),
+            block_refs=int(self._ref[used].sum()),
+            cow_copies=self.cow_copies, shared_hits=self.shared_hits,
+            shared_tokens=self.shared_tokens)
+
+    # ------------------------------------------------------------ allocation
+
+    def _alloc_block(self) -> Optional[int]:
+        if not self._free:
+            return None
+        blk = self._free.pop()
+        self._ref[blk] = 1
+        return blk
+
+    def _decref(self, blk: int):
+        assert blk != TRASH_BLOCK and self._ref[blk] > 0
+        self._ref[blk] -= 1
+        if self._ref[blk] == 0:
+            key = self._block_key.pop(blk, None)
+            if key is not None and self._registry.get(key) == blk:
+                del self._registry[key]
+            self._free.append(blk)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks covering ``n_tokens`` cached positions."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def blocks_needed(self, slot: int, start_pos: int, end_pos: int) -> int:
+        """How many FRESH blocks a write of [start_pos, end_pos) would
+        allocate for ``slot`` (COW targets count too — a copy needs a new
+        block)."""
+        need = 0
+        for lidx in range(start_pos // self.block_size,
+                          self.blocks_for(end_pos)):
+            blk = int(self.tables[slot, lidx])
+            if blk == TRASH_BLOCK or self._ref[blk] > 1:
+                need += 1
+        return need
+
+    def ensure_writable(self, slot: int, start_pos: int, end_pos: int
+                        ) -> Optional[List[Tuple[int, int]]]:
+        """Make every block covering positions [start_pos, end_pos) of
+        ``slot`` privately owned and present: allocate missing blocks,
+        copy-on-write shared ones. Returns the (src, dst) device copies the
+        caller must fold into its next executable call, or None when the
+        pool cannot satisfy the request (caller evicts or defers — the
+        table is left exactly as it was)."""
+        copies: List[Tuple[int, int]] = []
+        taken: List[Tuple[int, Optional[int]]] = []   # (lidx, old) rollback
+        for lidx in range(start_pos // self.block_size,
+                          self.blocks_for(end_pos)):
+            blk = int(self.tables[slot, lidx])
+            if blk != TRASH_BLOCK and self._ref[blk] == 1:
+                continue                              # already private
+            fresh = self._alloc_block()
+            if fresh is None:
+                # roll back this call's allocations; the table must not be
+                # half-mutated when the caller goes off to evict
+                for l2, old in reversed(taken):
+                    self._decref(int(self.tables[slot, l2]))
+                    if old is not None:
+                        self._ref[old] += 1
+                        self.tables[slot, l2] = old
+                    else:
+                        self.tables[slot, l2] = TRASH_BLOCK
+                return None
+            if blk != TRASH_BLOCK:                    # shared -> COW
+                copies.append((blk, fresh))
+                self.cow_copies += 1
+                self._decref(blk)
+                taken.append((lidx, blk))
+            else:
+                taken.append((lidx, None))
+            self.tables[slot, lidx] = fresh
+        return copies
+
+    # -------------------------------------------------------- prefix sharing
+
+    def share_prefix(self, slot: int, tokens: Sequence[int]) -> int:
+        """Adopt the longest registered prefix of ``tokens`` into ``slot``'s
+        table (increments refcounts) and return how many prompt positions
+        are now served from shared blocks. Capped at ``len(tokens) - 1``:
+        the final prompt token is always recomputed (its hidden state feeds
+        the first generated token and only K/V is cached)."""
+        toks = tuple(int(t) for t in tokens)
+        n = len(toks)
+        bs = self.block_size
+        chain: List[int] = []
+        cov = 0
+        i = 1
+        while i * bs < n:                 # strictly < n: keep >= 1 to process
+            blk = self._registry.get(toks[:i * bs])
+            if blk is None:
+                break
+            chain.append(blk)
+            cov = i * bs
+            i += 1
+        # exact-prompt tail block (partial, or the final full block of an
+        # aligned prompt): adopt it too — the n-1 cap below forces at least
+        # the last token through the chunk executable, whose first write
+        # copy-on-writes this block
+        if cov < n - 1 and len(chain) == (n - 1) // bs:
+            blk = self._registry.get(toks)
+            if blk is not None and blk not in chain:
+                chain.append(blk)
+                cov = n - 1
+        cov = min(cov, n - 1)
+        for lidx, blk in enumerate(chain):
+            self._ref[blk] += 1
+            self.tables[slot, lidx] = blk
+        if chain:
+            self.shared_hits += 1
+            self.shared_tokens += cov
+        return cov
+
+    def register_prompt(self, slot: int, tokens: Sequence[int]):
+        """Publish ``slot``'s freshly prefilled prompt blocks for future
+        sharing. Called when the prefill COMPLETES — a half-written block
+        must never be adoptable. First registration wins; a block carries
+        at most one key."""
+        toks = tuple(int(t) for t in tokens)
+        n = len(toks)
+        bs = self.block_size
+        bounds = [k * bs for k in range(1, n // bs + 1)]
+        if n % bs:
+            bounds.append(n)
+        for b in bounds:
+            blk = int(self.tables[slot, (b - 1) // bs])
+            if blk == TRASH_BLOCK or blk in self._block_key:
+                continue
+            key = toks[:b]
+            if key in self._registry:
+                continue
+            self._registry[key] = blk
+            self._block_key[blk] = key
+
+    # --------------------------------------------------------------- release
+
+    def release_slot(self, slot: int):
+        """Return every block ``slot`` references (finish or eviction);
+        shared blocks survive while other slots still hold them."""
+        for lidx in range(self.blocks_per_slot):
+            blk = int(self.tables[slot, lidx])
+            if blk != TRASH_BLOCK:
+                self._decref(blk)
+        self.tables[slot, :] = TRASH_BLOCK

@@ -37,9 +37,11 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
         self.tokens: List[int] = []      # generated tokens (eos inclusive)
-        self.status = "queued"           # queued|running|done|failed
+        # queued|prefilling|running|done|failed|rejected_overload
+        self.status = "queued"
         self.error: Optional[str] = None
         self.slot: Optional[int] = None
+        self.preemptions = 0             # pool-pressure evictions survived
         self.t_submit = time.time()
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
@@ -85,16 +87,37 @@ class SlotAllocator:
 
 
 class AdmissionQueue:
-    """FIFO of validated requests waiting for a free slot."""
+    """FIFO of validated requests waiting for a free slot.
 
-    def __init__(self):
+    ``max_queue`` bounds it: a full queue refuses ``push`` (the engine
+    rejects the request at the door with ``status="rejected_overload"``)
+    so saturation is visible instead of silently growing host memory.
+    ``push_front`` re-queues a preempted request ahead of the line — it
+    already spent compute and FIFO fairness says it goes next; preemption
+    re-queues bypass the bound (the request was already admitted once)."""
+
+    def __init__(self, max_queue: Optional[int] = None):
         self._q = deque()
+        self.max_queue = None if max_queue is None else int(max_queue)
 
-    def push(self, req: Request):
+    @property
+    def full(self) -> bool:
+        return self.max_queue is not None and len(self._q) >= self.max_queue
+
+    def push(self, req: Request) -> bool:
+        if self.full:
+            return False
         self._q.append(req)
+        return True
+
+    def push_front(self, req: Request):
+        self._q.appendleft(req)
 
     def pop(self) -> Request:
         return self._q.popleft()
+
+    def peek(self) -> Request:
+        return self._q[0]
 
     def __len__(self):
         return len(self._q)

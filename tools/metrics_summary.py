@@ -310,9 +310,19 @@ def summarize(paths, show_events=False, out=sys.stdout):
         eng = serves[-1] if serves else {}
         if eng:
             q = f"  quantize={eng['quantize']}" if eng.get("quantize") else ""
-            print(f"  engine: {int(eng.get('max_slots', 0))} slots x "
-                  f"{int(eng.get('max_len', 0))} positions  prefill buckets "
-                  f"{eng.get('prefill_buckets')}{q}", file=out)
+            if eng.get("kv_blocks"):
+                chunk = eng.get("prefill_chunk")
+                pre = (f"chunked prefill ({int(chunk)} tok/iter)" if chunk
+                       else f"prefill buckets {eng.get('prefill_buckets')}")
+                print(f"  engine: {int(eng.get('max_slots', 0))} slots x "
+                      f"{int(eng.get('max_len', 0))} positions  paged "
+                      f"{int(eng['kv_blocks'])} blocks x "
+                      f"{int(eng.get('block_size', 0))} tok  {pre}{q}",
+                      file=out)
+            else:
+                print(f"  engine: {int(eng.get('max_slots', 0))} slots x "
+                      f"{int(eng.get('max_len', 0))} positions  prefill "
+                      f"buckets {eng.get('prefill_buckets')}{q}", file=out)
         reqs = counters_m.get("serve/requests", 0)
         comps = counters_m.get("serve/completions", 0)
         rej = counters_m.get("serve/rejected", 0)
@@ -330,6 +340,7 @@ def summarize(paths, show_events=False, out=sys.stdout):
                     f"{toks / span_s:.1f} tok/s)"
         print(line, file=out)
         for label, h in (("ttft", hists_m.get("serve/ttft_s")),
+                         ("queue", hists_m.get("serve/queue_wait_s")),
                          ("prefill", hists_m.get("serve/prefill_s")),
                          ("per-token", hists_m.get("serve/step_s"))):
             if h and h.get("count"):
@@ -338,6 +349,35 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       f"max {h['max'] * 1e3:8.2f}ms  "
                       f"p99 {h['p99'] * 1e3:8.2f}ms  (n={h['count']})",
                       file=out)
+        # paged pool health: occupancy / sharing / preemption pressure, and
+        # the fragmentation alarm — an admission refused while free blocks
+        # covered the slot's need is an ALLOCATOR bug, not saturation
+        if gauges_m.get("serve/kv_blocks", 0):
+            occ = gauges_m.get("serve/page_occupancy", 0)
+            share = gauges_m.get("serve/sharing_ratio", 0)
+            print(f"  pages: occupancy {occ:.0%}  kv util "
+                  f"{gauges_m.get('serve/kv_util', 0):.0%}  sharing ratio "
+                  f"{share:.2f}x  shared blocks "
+                  f"{int(gauges_m.get('serve/blocks_shared', 0))}  cow "
+                  f"copies {int(gauges_m.get('serve/cow_copies', 0))}  "
+                  f"preemptions "
+                  f"{int(counters_m.get('serve/preemptions', 0))}",
+                  file=out)
+            overload = counters_m.get("serve/rejected_overload", 0)
+            if overload:
+                print(f"  queue overload rejections {int(overload)} "
+                      f"(admission queue saturated — callers should back "
+                      f"off or the pool should grow)", file=out)
+        frag = [r for r in by_kind.get("serve_page_reject", [])
+                if r.get("free_blocks", 0) >= r.get("needed_blocks", 1)]
+        if frag:
+            worst = max(frag, key=lambda r: r.get("free_blocks", 0))
+            print(f"  WARNING: {len(frag)} paged admission(s) rejected "
+                  f"with free blocks >= the slot's need (e.g. free "
+                  f"{int(worst['free_blocks'])} vs needed "
+                  f"{int(worst['needed_blocks'])}) — allocator "
+                  f"fragmentation/logic bug, not pool saturation",
+                  file=out)
         steps_n = counters_m.get("serve/decode_steps", 0)
         slots_max = max((int(e.get("max_slots", 0)) for e in serves),
                         default=int(eng.get("max_slots", 0) or 0))
