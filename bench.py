@@ -48,6 +48,25 @@ def _cli_flag(argv, name):
     return None
 
 
+def _fleet_fields():
+    """step_skew/ranks for the best-so-far line, SOURCED from the telemetry
+    collector (monitor/collector.py aggregates them on rank 0 when bench
+    runs under the launcher with PADDLE_MONITOR + PADDLE_MONITOR_FLEET set)
+    — bench measures nothing new here. Empty off the multichip path."""
+    try:
+        from paddle_tpu import monitor
+        st = monitor.fleet_state()
+    except Exception:
+        return {}
+    if not st:
+        return {}
+    d = st.get("derived") or {}
+    out = {"ranks": len(st.get("ranks") or [])}
+    if d.get("fleet/step_skew") is not None:
+        out["step_skew"] = round(float(d["fleet/step_skew"]), 3)
+    return out
+
+
 def main(argv=()):
     import jax
     # persistent compile cache: XLA compiles through the tunnel are slow (~2min);
@@ -129,7 +148,7 @@ def main(argv=()):
         # unknown chip: report mfu null rather than a confidently wrong number
         mfu = (round(model_tflops * 1e12 / peak_flops, 3)
                if peak_flops else None)
-        print(json.dumps({
+        payload = {
             "metric": "gpt_medium_train_tokens_per_sec_per_chip",
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/s",
@@ -140,7 +159,9 @@ def main(argv=()):
             "batch": batch,
             "device_kind": kind,
             "window": window,
-        }))
+        }
+        payload.update(_fleet_fields())
+        print(json.dumps(payload))
         sys.stdout.flush()
 
     # measure in short windows, print the best-so-far after each one: the
@@ -256,7 +277,7 @@ def main_decode(argv=()):
         drain_ttfts()
         best = max(best, (engine.tokens_generated - tok0) / dt)
         q = (lambda v, p: float(np.percentile(v, p)) if v else None)
-        print(json.dumps({
+        print(json.dumps(dict(_fleet_fields(), **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best, 1),
             "unit": "tokens/s (decode)",
@@ -271,7 +292,7 @@ def main_decode(argv=()):
             "steady_state_recompiles": engine.compile_count - warm_compiles,
             "device_kind": kind,
             "window": w,
-        }))
+        })))
         sys.stdout.flush()
 
 

@@ -63,6 +63,15 @@ class ElasticManager:
     def register(self, on_change: Optional[Callable] = None):
         """Start heartbeating + watching (reference manager.start)."""
         self._on_change = on_change
+        # hand the fleet-telemetry aggregator this membership view: the
+        # collector cross-checks its liveness (stale publishers) against the
+        # elastic peer set so the two can't silently disagree (a WARN in the
+        # fleet stream names the split)
+        try:
+            from ...monitor import collector as _collector
+            _collector.attach_elastic(self)
+        except Exception:
+            pass
         hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
         watch = threading.Thread(target=self._watch_loop, daemon=True)
         self._threads = [hb, watch]
@@ -143,6 +152,14 @@ class ElasticManager:
         scale file configured, the controller's own liveness watch still
         scales in on worker death — this wire just makes scale-out and
         multi-node membership changes restart-driven too."""
+        # the announcement also lands in the telemetry plane so a restart
+        # decision is visible next to the stale-rank gauges it should match
+        try:
+            from ... import monitor
+            monitor.emit("elastic_scale", np=int(np_new),
+                         scale_file=self._scale_file or None)
+        except Exception:
+            pass
         if not self._scale_file or np_new < 1:
             return
         try:

@@ -71,6 +71,39 @@ class PodController:
         self._master: Optional[Master] = None
         self._token: str = ""
         self._stop_signum: Optional[int] = None
+        self._telemetry_srv = None          # controller-hosted KVServer
+        self._telemetry_ep: Optional[str] = None
+
+    # ------------------------------------------------------------- telemetry
+
+    def _ensure_telemetry_master(self):
+        """The fleet-telemetry plane (monitor/collector.py) needs ONE KV
+        endpoint every rank can reach. Multi-node jobs already have it (the
+        rendezvous master, exported as PADDLE_CKPT_MASTER); a single-node
+        multi-process pod gets a controller-hosted KVServer on a free port,
+        exported as PADDLE_MONITOR_MASTER. Best-effort: a bind failure
+        degrades to no online aggregation, never to a failed launch."""
+        if self.ctx.master or self.ctx.nproc_per_node <= 1 \
+                or self._telemetry_ep is not None:
+            return
+        from .master import KVServer
+        try:
+            port = free_port()
+            srv = KVServer(port, host="127.0.0.1")
+            srv.start()
+        except OSError:
+            return
+        self._telemetry_srv = srv
+        self._telemetry_ep = f"127.0.0.1:{port}"
+
+    def _stop_telemetry_master(self):
+        if self._telemetry_srv is not None:
+            try:
+                self._telemetry_srv.stop()
+            except Exception:
+                pass
+            self._telemetry_srv = None
+            self._telemetry_ep = None
 
     # -------------------------------------------------------------- preempt
 
@@ -233,8 +266,15 @@ class PodController:
         if ctx.master:
             # the KV master doubles as the pod-wide checkpoint-commit
             # coordinator (distributed/reshard/commit.py): rank 0 stamps a
-            # snapshot's COMMIT only after every rank acked its payload
+            # snapshot's COMMIT only after every rank acked its payload —
+            # and as the fleet-telemetry transport (monitor/collector.py
+            # falls back to PADDLE_CKPT_MASTER when no dedicated telemetry
+            # endpoint is exported)
             env["PADDLE_CKPT_MASTER"] = ctx.master
+        if self._telemetry_ep:
+            # single-node pods have no rendezvous master; the controller-
+            # hosted KVServer carries the /<job>/telemetry/<rank> namespace
+            env["PADDLE_MONITOR_MASTER"] = self._telemetry_ep
         if ctx.elastic_level > 0 and ctx.log_dir:
             # ElasticManager's restart wire: a worker that observes a
             # membership change writes the surviving np here and this
@@ -401,6 +441,9 @@ class PodController:
         # --max_restart unset, elastic still stops after a default budget
         budget = ctx.max_restart if ctx.max_restart > 0 else 10
         fail_streak = 0
+        # one telemetry endpoint across incarnations: a restarted rank's new
+        # incarnation lands in the same fleet stream
+        self._ensure_telemetry_master()
 
         def desired_np():
             if ctl:
@@ -471,6 +514,7 @@ class PodController:
                 continue
         finally:
             self._terminate()
+            self._stop_telemetry_master()
 
     def run(self) -> int:
         # the controller IS a preemption relay: hosted controllers run() on
@@ -495,6 +539,7 @@ class PodController:
                              "jobs (nnodes == 1)")
         node_rank, coordinator = self._rendezvous()
         self._token = self._bus_token(node_rank)
+        self._ensure_telemetry_master()
         restarts = 0
         fail_streak = 0
         try:
@@ -520,5 +565,6 @@ class PodController:
                     return self._drain_after_stop()
         finally:
             self._terminate()
+            self._stop_telemetry_master()
             if self._master is not None:
                 self._master.stop()

@@ -35,7 +35,7 @@ from .registry import Counter, Gauge, Histogram, Registry
 from .sink import SCHEMA_VERSION, JsonlSink, resolve_sink_path
 
 __all__ = ["enable", "disable", "enabled", "get", "emit", "dump",
-           "counter", "gauge", "histogram", "snapshot",
+           "counter", "gauge", "histogram", "snapshot", "fleet_state",
            "live_array_census", "executable_memory_stats",
            "Monitor", "Registry", "Counter", "Gauge", "Histogram",
            "SCHEMA_VERSION"]
@@ -488,7 +488,16 @@ class Monitor:
             path = root + ".flight.json"
         snap = self._emit_counters()
         self.flush()
-        return self.flight.dump(path, registry_snapshot=snap, exc=exc)
+        # rank 0 with the fleet plane up: the crash report says what the
+        # FLEET looked like, not just the dying rank
+        fleet = None
+        try:
+            from . import collector as _collector
+            fleet = _collector.fleet_state()
+        except Exception:
+            pass
+        return self.flight.dump(path, registry_snapshot=snap, exc=exc,
+                                fleet=fleet)
 
     def on_crash(self, exc: BaseException):
         # one dump per exception object: TrainStep.__call__ raising inside
@@ -513,11 +522,17 @@ class Monitor:
 
 
 def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
-           flush_every: int = 64, ring: int = 256) -> Monitor:
+           flush_every: int = 64, ring: int = 256,
+           fleet=None) -> Monitor:
     """Turn the monitor on. ``path`` is the JSONL sink file (None: flight
     recorder only); in multi-process runs each process writes
     ``path.procN`` (see sink.resolve_sink_path). Idempotent-safe: enabling
-    while enabled closes the previous session first."""
+    while enabled closes the previous session first.
+
+    ``fleet`` starts the online fleet-telemetry plane (monitor/collector.py):
+    True derives the rank-0 stream path from ``path`` (``run.jsonl`` ->
+    ``run.fleet.jsonl``), a string is the explicit stream path. Default None
+    follows the ``PADDLE_MONITOR_FLEET`` env."""
     global _active
     with _lock:
         if _active is not None:
@@ -526,7 +541,19 @@ def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
                       ring=ring)
         _install_hooks(mon)
         _active = mon
-        return mon
+    if fleet is None:
+        v = os.environ.get("PADDLE_MONITOR_FLEET")
+        # explicit falsy values DISABLE (an operator's FLEET=0 must not
+        # start the plane with a stream file literally named "0")
+        fleet = None if not v or v.lower() in ("0", "false", "no", "off") \
+            else v
+    if fleet:
+        from . import collector as _collector
+        _collector.start(
+            registry=mon.registry, emit=mon.emit,
+            fleet_path=_collector.resolve_fleet_path(
+                fleet if isinstance(fleet, str) else None, path))
+    return mon
 
 
 def _install_hooks(mon: Monitor):
@@ -539,6 +566,11 @@ def _teardown_locked():
     mon, _active = _active, None
     from ..core import dispatch
     dispatch.set_monitor_hooks(None, None)
+    from . import collector as _collector
+    if mon is not None and _collector.get_active() is not None:
+        # only the plane over THIS session's registry dies with it
+        if _collector.get_active().publisher.registry is mon.registry:
+            _collector.stop()
     if mon is not None:
         mon.close()
 
@@ -589,6 +621,13 @@ def histogram(name: str) -> Optional[Histogram]:
 def snapshot() -> Optional[dict]:
     mon = _active
     return mon.registry.snapshot() if mon is not None else None
+
+
+def fleet_state() -> Optional[dict]:
+    """Rank 0's latest aggregated fleet record when the collector plane is
+    up (monitor/collector.py); None on other ranks or when inactive."""
+    from . import collector as _collector
+    return _collector.fleet_state()
 
 
 def on_crash(exc: BaseException):

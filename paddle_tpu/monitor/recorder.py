@@ -35,7 +35,8 @@ class FlightRecorder:
         return list(self._ring)
 
     def dump(self, path: str, registry_snapshot: Optional[dict] = None,
-             exc: Optional[BaseException] = None) -> str:
+             exc: Optional[BaseException] = None,
+             fleet: Optional[dict] = None) -> str:
         payload = {
             "v": SCHEMA_VERSION,
             "kind": "flight_dump",
@@ -46,6 +47,10 @@ class FlightRecorder:
             "events": list(self._ring),
             "metrics": registry_snapshot or {},
         }
+        if fleet is not None:
+            # rank 0's last aggregated fleet snapshot (monitor/collector.py):
+            # the post-mortem shows the whole fleet, not just this rank
+            payload["fleet"] = fleet
         if exc is not None:
             payload["exception"] = {
                 "type": type(exc).__name__,

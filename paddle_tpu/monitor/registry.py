@@ -139,7 +139,8 @@ class Histogram:
         return {"count": self._n, "sum": self._sum, "avg": self.avg,
                 "min": self._min if self._n else 0.0,
                 "max": self._max if self._n else 0.0,
-                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
+                "p50": self.quantile(0.5), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
 
 
 class Registry:
@@ -198,6 +199,30 @@ class Registry:
                 out["gauges"][name] = m.snapshot()
             else:
                 out["histograms"][name] = m.snapshot()
+        return out
+
+    @staticmethod
+    def delta(prev: Optional[dict], cur: dict) -> dict:
+        """Changed-metrics view of ``cur`` vs a previous ``snapshot()``.
+
+        Values stay CUMULATIVE (the fleet collector's loss-tolerant wire
+        format: a missed blob costs nothing because the next one carries
+        absolute values again); only UNCHANGED keys are dropped. Histograms
+        compare on observation count — a summary whose count moved is
+        re-sent whole. ``prev=None`` returns ``cur`` unchanged (the full
+        first publish of an incarnation)."""
+        if prev is None:
+            return cur
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for kind in ("counters", "gauges"):
+            pk = prev.get(kind) or {}
+            for name, v in (cur.get(kind) or {}).items():
+                if pk.get(name) != v:
+                    out[kind][name] = v
+        ph = prev.get("histograms") or {}
+        for name, h in (cur.get("histograms") or {}).items():
+            if (ph.get(name) or {}).get("count") != h.get("count"):
+                out["histograms"][name] = h
         return out
 
     def remove_prefix(self, prefix: str):

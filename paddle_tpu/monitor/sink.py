@@ -54,8 +54,11 @@ def _default(o):
 class JsonlSink:
     """Append-only buffered JSONL writer (thread-safe)."""
 
-    def __init__(self, path: str, flush_every: int = 64):
-        self.path = resolve_sink_path(path)
+    def __init__(self, path: str, flush_every: int = 64,
+                 resolve: bool = True):
+        # resolve=False: single-writer streams that are already rank-scoped
+        # (the collector's rank-0 fleet stream) must not grow a .procN suffix
+        self.path = resolve_sink_path(path) if resolve else path
         self.flush_every = max(int(flush_every), 1)
         self._lock = threading.Lock()
         self._buf = []

@@ -1,0 +1,110 @@
+"""Rank worker for the fleet-telemetry e2e (tests/test_fleet_e2e.py).
+
+Each rank trains a small model independently (no collectives — the telemetry
+plane is the system under test, and it must work without jax.distributed):
+the monitor auto-enables from PADDLE_MONITOR at import, PADDLE_MONITOR_FLEET
+brings the collector up, and the launch controller's exported
+PADDLE_MONITOR_MASTER carries the blobs.
+
+Fault-injection knobs (env):
+  FLEET_TEST_SLOW_RANK   rank that sleeps per step (the planted straggler)
+  FLEET_TEST_DIE_AFTER_S non-zero ranks SIGKILL themselves after this long
+  FLEET_TEST_RUN_S       soft run budget for rank 0 when nothing is planted
+
+Rank 0 traps SIGTERM (the controller forwards it when a sibling dies) and
+keeps training until it has OBSERVED the planted failures in its own
+aggregated fleet state — that observation loop is exactly the "aggregator
+not wedged by a dead publisher" acceptance check.
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+
+def main(out_dir):
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    import numpy as np
+
+    import paddle_tpu as paddle  # monitor auto-enables from env here
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import collector
+
+    stop = {"sig": None}
+
+    def on_term(signum, frame):
+        stop["sig"] = signum  # keep running: rank 0 still has observing to do
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    slow_rank = int(os.environ.get("FLEET_TEST_SLOW_RANK", "-1") or -1)
+    die_after = float(os.environ.get("FLEET_TEST_DIE_AFTER_S", "0") or 0)
+    run_s = float(os.environ.get("FLEET_TEST_RUN_S", "6") or 6)
+
+    paddle.seed(rank)
+    nn, F = paddle.nn, paddle.nn.functional
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 64)
+            self.fc2 = nn.Linear(64, 4)
+
+        def forward(self, x, y):
+            return F.mse_loss(self.fc2(F.relu(self.fc1(x))), y)
+
+    model = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt)
+    rng = np.random.RandomState(rank)
+    x = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+
+    observed = {"straggler": False, "stale": False, "both_ranks": False}
+    t0 = time.time()
+    deadline = t0 + run_s + 25.0  # hard stop: the test must never hang
+    while True:
+        float(step(x, y))
+        if rank == slow_rank:
+            time.sleep(0.08)  # the planted straggler
+        now = time.time()
+        if die_after and rank != 0 and now - t0 >= die_after:
+            os.kill(os.getpid(), signal.SIGKILL)  # publisher death, no exit
+        if rank != 0:
+            if stop["sig"] is not None or now >= deadline:
+                break
+            continue
+        st = monitor.fleet_state()
+        if st:
+            d = st.get("derived") or {}
+            if len(st.get("ranks") or []) >= 2:
+                observed["both_ranks"] = True
+            if d.get("fleet/ranks_stale", 0) >= 1:
+                observed["stale"] = True
+            if d.get("fleet/step_skew", 1.0) > 1.5:
+                observed["straggler"] = True
+        want_stale = bool(die_after)
+        done = observed["both_ranks"] \
+            and (observed["stale"] or not want_stale) \
+            and (observed["straggler"] or slow_rank < 0) \
+            and now - t0 >= run_s
+        if done or now >= deadline:
+            break
+
+    if rank == 0:
+        dump = monitor.dump()  # flight dump carries the fleet snapshot
+        col = collector.get_active()
+        with open(os.path.join(out_dir, "rank0_done.json"), "w") as f:
+            json.dump({"observed": observed, "dump": dump,
+                       "fleet_path": col.fleet_path if col else None,
+                       "wall_s": time.time() - t0}, f)
+    monitor.disable()  # final flush of sink + fleet stream
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main(sys.argv[1])

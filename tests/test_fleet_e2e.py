@@ -1,0 +1,146 @@
+"""Fleet-telemetry 2-process launcher e2e (ISSUE 11 acceptance, slow lane).
+
+One launcher invocation, two rank processes, one KV master (the controller-
+hosted telemetry KVServer), one ``run.fleet.jsonl`` on rank 0. Gates:
+
+* aggregated counters/gauges from BOTH ranks land in one stream;
+* the deliberately-slowed rank trips the ``fleet/step_skew`` WARN naming it;
+* a SIGKILLed rank flips ``fleet/ranks_stale`` within two publish intervals
+  — and neither crashes the aggregator nor wedges rank 0's training loop
+  (rank 0 keeps stepping and exits 0 on its own observations).
+
+The protocol itself (delta encoding, incarnation discipline, tripwires) is
+unit-gated in tier-1's tests/test_fleet_collector.py; this file proves the
+wiring through the real controller env contract.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process spawn/join; ~30s
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fleet_worker.py")
+
+PUBLISH_S = 0.25
+
+
+def _read_jsonl(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail from the killed writer
+    return out
+
+
+def _launch(tmp_path, extra_env):
+    out = str(tmp_path)
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_MONITOR": os.path.join(out, "run.jsonl"),
+        "PADDLE_MONITOR_FLEET": "1",
+        "PADDLE_MONITOR_PUBLISH_S": str(PUBLISH_S),
+    })
+    env.update(extra_env)
+    subprocess.call(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--job_id", "fleet_e2e",
+         "--log_dir", os.path.join(out, "logs"), WORKER, out],
+        cwd=REPO, env=env, timeout=300)
+    done_path = os.path.join(out, "rank0_done.json")
+    assert os.path.exists(done_path), _logs(os.path.join(out, "logs"))
+    with open(done_path) as f:
+        done = json.load(f)
+    fleet_path = os.path.join(out, "run.fleet.jsonl")
+    assert os.path.exists(fleet_path), done
+    return done, _read_jsonl(fleet_path)
+
+
+def _logs(log_dir):
+    chunks = []
+    if os.path.isdir(log_dir):
+        for name in sorted(os.listdir(log_dir)):
+            with open(os.path.join(log_dir, name), "rb") as f:
+                chunks.append(f"--- {name} ---\n"
+                              f"{f.read().decode(errors='replace')[-4000:]}")
+    return "\n".join(chunks) or "(no logs)"
+
+
+def test_two_rank_stream_straggler_and_kill(tmp_path):
+    done, recs = _launch(tmp_path, {
+        "FLEET_TEST_SLOW_RANK": "1",
+        "FLEET_TEST_DIE_AFTER_S": "4",
+        "FLEET_TEST_RUN_S": "3",
+        "PADDLE_MONITOR_SKEW_WARN": "1.5",  # planted 80ms sleep >> noise
+    })
+    fleets = [r for r in recs if r.get("kind") == "fleet"]
+    warns = [r for r in recs if r.get("kind") == "fleet_warn"]
+    assert fleets, recs[:3]
+
+    # ONE stream carries BOTH ranks' aggregated metrics
+    both = [r for r in fleets
+            if set((r["metrics"]["counters"].get("train_step/steps") or {})
+                   .get("per_rank", {})) >= {"0", "1"}]
+    assert both, "no fleet record aggregated steps from both ranks"
+    c = both[-1]["metrics"]["counters"]["train_step/steps"]
+    assert c["sum"] == c["per_rank"]["0"] + c["per_rank"]["1"]
+    assert done["observed"]["both_ranks"]
+
+    # straggler: the planted slow rank is NAMED
+    stragglers = [w for w in warns if w.get("warn") == "straggler"]
+    assert stragglers, warns
+    assert stragglers[0]["rank"] == 1
+    assert done["observed"]["straggler"]
+
+    # liveness: the SIGKILLed rank goes stale within two publish intervals
+    # of its last blob (stale_after defaults to 2x the publish interval;
+    # detection lands at the next aggregator poll)
+    stale_recs = [r for r in fleets
+                  if r.get("derived", {}).get("fleet/ranks_stale", 0) >= 1]
+    assert stale_recs, "rank death never surfaced in the fleet stream"
+    assert 1 in stale_recs[0].get("stale", []), stale_recs[0]
+    last_live = max((r["ts"] for r in fleets
+                     if 1 in (r.get("live") or [])), default=None)
+    assert last_live is not None
+    lag = stale_recs[0]["ts"] - last_live
+    # 2 publish intervals of silence + at most ~2 poll periods of skew on a
+    # loaded CI host
+    assert lag <= 4 * PUBLISH_S + 1.0, f"stale detection took {lag:.2f}s"
+    assert [w for w in warns
+            if w.get("warn") == "stale" and w.get("rank") == 1]
+    assert done["observed"]["stale"]
+
+    # the aggregator survived its publisher dying: rank 0 kept training and
+    # polling after the kill (fleet rounds continued past the stale record)
+    assert fleets[-1]["round"] >= stale_recs[0]["round"]
+
+    # satellite: rank 0's flight dump carries the fleet snapshot
+    with open(done["dump"]) as f:
+        doc = json.load(f)
+    assert doc.get("fleet", {}).get("kind") == "fleet"
+
+
+def test_two_rank_clean_run_fleet_stream(tmp_path):
+    """No faults planted: a clean 2-rank run produces a healthy stream (no
+    WARNs, no stale ranks) and per-rank sink files NEXT to the fleet file —
+    the offline and online halves coexist."""
+    # sub-ms steps see ~2x scheduler jitter on a 2-CPU CI host — the clean
+    # gate raises the skew threshold far past noise (nothing legitimate
+    # approaches 25x without a planted fault)
+    done, recs = _launch(tmp_path, {"FLEET_TEST_RUN_S": "3",
+                                    "PADDLE_MONITOR_SKEW_WARN": "25"})
+    fleets = [r for r in recs if r.get("kind") == "fleet"]
+    assert fleets and done["observed"]["both_ranks"]
+    assert not [r for r in recs if r.get("kind") == "fleet_warn"]
+    assert fleets[-1]["derived"]["fleet/ranks_stale"] == 0
+    for rank in (0, 1):
+        assert os.path.exists(
+            os.path.join(str(tmp_path), f"run.proc{rank}.jsonl"))

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""fleet_top — live one-screen dashboard over a run.fleet.jsonl stream.
+
+Tails the fleet stream rank 0's telemetry aggregator writes
+(paddle_tpu/monitor/collector.py) and renders a refreshing dashboard:
+fleet header (live/stale ranks, step skew, straggler), a per-rank table
+(steps/s, step-time p50/p95, recompiles, skipped updates, ckpt/reshard
+activity, serving tokens/s + kv_util + queue depth when present) and the
+most recent WARN events. Stdlib only — it runs wherever the stream file
+is visible (rank 0's host, or anywhere the log dir is mounted).
+
+Usage:
+    python tools/fleet_top.py run.fleet.jsonl            # live, 2s refresh
+    python tools/fleet_top.py run.fleet.jsonl --interval 0.5
+    python tools/fleet_top.py run.fleet.jsonl --once     # one frame, exit
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def load_stream(path):
+    """Parse the whole stream -> (meta, fleet_records, warns). Small files
+    (one record per publish interval) make a full re-parse per frame the
+    simple, torn-tail-tolerant choice."""
+    meta, fleets, warns = {}, [], []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return meta, fleets, warns
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail from the live writer
+        kind = r.get("kind")
+        if kind == "fleet_meta":
+            meta = r
+        elif kind == "fleet":
+            fleets.append(r)
+        elif kind == "fleet_warn":
+            warns.append(r)
+    return meta, fleets, warns
+
+
+def _pick(rec, kind, name, rank):
+    """per-rank value of one metric from a fleet record (None if absent)."""
+    m = ((rec.get("metrics") or {}).get(kind) or {}).get(name)
+    if not m:
+        return None
+    return (m.get("per_rank") or {}).get(str(rank))
+
+
+def _rate(cur, prev, kind, name, rank):
+    """per-second delta of a per-rank cumulative counter between the two
+    newest fleet records. None without a basis — including a counter that
+    went BACKWARDS (an incarnation restart reset the rank's cumulative
+    state; a negative steps/s row would be garbage exactly when an
+    operator is watching the restart)."""
+    if prev is None:
+        return None
+    a, b = _pick(prev, kind, name, rank), _pick(cur, kind, name, rank)
+    dt = cur.get("ts", 0) - prev.get("ts", 0)
+    if a is None or b is None or dt <= 0 or b < a:
+        return None
+    return (b - a) / dt
+
+
+def _fmt(v, spec="{:.1f}", none="-"):
+    return none if v is None else spec.format(v)
+
+
+def render(meta, fleets, warns, now=None, width=100):
+    """One dashboard frame as a string (the testable unit)."""
+    now = time.time() if now is None else now
+    out = []
+    if not fleets:
+        out.append("fleet_top: no fleet records yet "
+                   "(aggregator publishes every "
+                   f"{meta.get('publish_s', '?')}s)" if meta else
+                   "fleet_top: waiting for fleet stream ...")
+        return "\n".join(out)
+    cur = fleets[-1]
+    prev = fleets[-2] if len(fleets) > 1 else None
+    d = cur.get("derived") or {}
+    age = now - cur.get("ts", now)
+    live, stale = cur.get("live") or [], cur.get("stale") or []
+    skew = d.get("fleet/step_skew")
+    head = (f"fleet_top  job={meta.get('job', '?')}  world="
+            f"{meta.get('world', len(cur.get('ranks') or []))}  "
+            f"round={cur.get('round', '?')}  age={age:.1f}s")
+    out.append(head)
+    line = (f"ranks: {len(live)} live"
+            + (f", {len(stale)} STALE {stale}" if stale else "")
+            + f"   step skew {_fmt(skew, '{:.2f}x')}")
+    if d.get("fleet/slowest_rank") is not None and skew and skew > 1.05:
+        line += f" (slowest: rank {d['fleet/slowest_rank']})"
+    if d.get("fleet/elastic_peers") is not None:
+        line += f"   elastic peers {d['fleet/elastic_peers']}"
+    out.append(line)
+
+    # fleet-wide rates from the newest window
+    tok = _total_rate(cur, prev, "serve/tokens")
+    if tok is not None:
+        out.append(f"serving: {tok:.1f} tokens/s fleet-wide")
+    out.append("-" * min(width, 100))
+
+    hdr = (f"{'rank':>4} {'steps':>9} {'steps/s':>8} {'step p50':>10} "
+           f"{'step p95':>10} {'recomp':>7} {'skip':>5} {'ckpt':>5} "
+           f"{'reshard':>8} {'tok/s':>8} {'kv_util':>8} {'queue':>6}")
+    out.append(hdr)
+    for r in cur.get("ranks") or []:
+        h = _pick(cur, "histograms", "train_step/dispatch_s", r) or {}
+        srv_h = _pick(cur, "gauges", "serve/kv_util", r)
+        row = (f"{r:>4}"
+               f" {_fmt(_pick(cur, 'counters', 'train_step/steps', r), '{:.0f}'):>9}"
+               f" {_fmt(_rate(cur, prev, 'counters', 'train_step/steps', r)):>8}"
+               f" {_fmt(h.get('p50'), '{:.4f}s'):>10}"
+               f" {_fmt(h.get('p95'), '{:.4f}s'):>10}"
+               f" {_fmt(_pick(cur, 'counters', 'train_step/recompiles', r), '{:.0f}'):>7}"
+               f" {_fmt(_pick(cur, 'counters', 'train_step/skipped_updates', r), '{:.0f}'):>5}"
+               f" {_fmt(_pick(cur, 'counters', 'ckpt/saves', r), '{:.0f}'):>5}"
+               f" {_fmt(_pick(cur, 'counters', 'reshard/loads', r), '{:.0f}'):>8}"
+               f" {_fmt(_rate(cur, prev, 'counters', 'serve/tokens', r)):>8}"
+               f" {_fmt(srv_h, '{:.0%}'):>8}"
+               f" {_fmt(_pick(cur, 'gauges', 'serve/queue_depth', r), '{:.0f}'):>6}")
+        if r in stale:
+            row += "   << STALE"
+        out.append(row)
+
+    if warns:
+        out.append("-" * min(width, 100))
+        out.append("recent warnings:")
+        t0 = meta.get("ts", fleets[0].get("ts", 0))
+        for w in warns[-5:]:
+            out.append(f"  +{w.get('ts', t0) - t0:8.1f}s  "
+                       f"[{w.get('warn', '?'):<12}] {w.get('msg', '')}")
+    return "\n".join(out)
+
+
+def _total_rate(cur, prev, name):
+    if prev is None:
+        return None
+    a = ((prev.get("metrics") or {}).get("counters") or {}).get(name)
+    b = ((cur.get("metrics") or {}).get("counters") or {}).get(name)
+    dt = cur.get("ts", 0) - prev.get("ts", 0)
+    if not a or not b or dt <= 0 or b.get("sum", 0) < a.get("sum", 0):
+        return None  # backwards sum = incarnation reset, not a rate
+    return (b.get("sum", 0) - a.get("sum", 0)) / dt
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run.fleet.jsonl written by rank 0")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clear)")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of clearing the screen")
+    args = ap.parse_args(argv)
+    if args.once:
+        meta, fleets, warns = load_stream(args.path)
+        print(render(meta, fleets, warns))
+        return 0 if fleets else 1
+    try:
+        while True:
+            meta, fleets, warns = load_stream(args.path)
+            frame = render(meta, fleets, warns)
+            if not args.no_clear:
+                sys.stdout.write(CLEAR)
+            print(frame)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,8 +12,14 @@ with a per-rank breakdown, timeline entries carry their rank, and recompile
 signatures are correlated across ranks (the same divergent signature on all
 ranks points at data skew; on one rank, at a placement bug).
 
+The online fleet stream (``run.fleet.jsonl``, written by rank 0's telemetry
+aggregator — monitor/collector.py) is accepted alongside the per-process
+files and renders its own section (rounds, stale ranks, peak step skew,
+WARN roll-up).
+
 Usage:
     python tools/metrics_summary.py run.jsonl [run.proc1.jsonl ...]
+    python tools/metrics_summary.py run.jsonl run.fleet.jsonl
     python tools/metrics_summary.py run.flight.json --events
 """
 from __future__ import annotations
@@ -103,7 +109,14 @@ def _merge_metrics(per_proc):
             m["count"] = tot
             m["min"] = min(m.get("min", 0), h.get("min", 0))
             m["max"] = max(m.get("max", 0), h.get("max", 0))
-            m["p99"] = max(m.get("p99", 0), h.get("p99", 0))
+            # quantiles can't be pooled from summaries; the max across ranks
+            # is the conservative (never-understates-latency) merge. Only
+            # merge keys that EXIST — fabricating p95=0 for pre-p95 (v1)
+            # snapshots would defeat the render layer's degrade-to-p99
+            for q in ("p50", "p95", "p99"):
+                vals = [d[q] for d in (m, h) if q in d]
+                if vals:
+                    m[q] = max(vals)
     return merged, breakdown
 
 
@@ -204,12 +217,16 @@ def summarize(paths, show_events=False, out=sys.stdout):
         hists = metrics.get("histograms", {})
         if hists:
             print("\n== histograms ==", file=out)
-            print(f"  {'name':<34}{'count':>8}{'avg':>12}{'min':>12}"
-                  f"{'max':>12}{'p99':>12}", file=out)
+            print(f"  {'name':<34}{'count':>8}{'avg':>12}{'p50':>12}"
+                  f"{'p95':>12}{'p99':>12}{'max':>12}", file=out)
             for name, h in sorted(hists.items()):
+                # pre-p95 snapshots (schema v1 before this tool's upgrade)
+                # degrade to the p99 column value rather than a fake 0
+                p95 = h.get("p95", h.get("p99", 0))
                 print(f"  {name:<34}{h.get('count', 0):>8}"
-                      f"{h.get('avg', 0):>12.6f}{h.get('min', 0):>12.6f}"
-                      f"{h.get('max', 0):>12.6f}{h.get('p99', 0):>12.6f}",
+                      f"{h.get('avg', 0):>12.6f}{h.get('p50', 0):>12.6f}"
+                      f"{p95:>12.6f}{h.get('p99', 0):>12.6f}"
+                      f"{h.get('max', 0):>12.6f}",
                       file=out)
 
     gauges_m = (metrics or {}).get("gauges", {})
@@ -417,6 +434,44 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       f"{len(remints)}x — the zero-recompile steady-state "
                       f"contract is broken (a shape depends on the "
                       f"live-slot set)", file=out)
+
+    # fleet stream (run.fleet.jsonl — monitor/collector.py's online
+    # aggregation): the same tool reads the live plane's output post-mortem
+    fleet_recs = by_kind.get("fleet", [])
+    fleet_meta = (by_kind.get("fleet_meta") or [{}])[-1]
+    fleet_warns = by_kind.get("fleet_warn", [])
+    if fleet_recs or fleet_warns:
+        print(f"\n== fleet (online aggregation) ==", file=out)
+        last = fleet_recs[-1] if fleet_recs else {}
+        d = last.get("derived") or {}
+        print(f"  world {fleet_meta.get('world', '?')}  publish every "
+              f"{fleet_meta.get('publish_s', '?')}s  rounds "
+              f"{len(fleet_recs)}  ranks seen "
+              f"{len(last.get('ranks') or [])}", file=out)
+        if last:
+            stale = last.get("stale") or []
+            # attribute the PEAK skew to the rank of the round that
+            # produced it — the final round's slowest rank may be an
+            # innocent bystander of a long-recovered episode
+            peak = max(fleet_recs, key=lambda f: f.get("derived", {})
+                       .get("fleet/step_skew", 1.0))
+            pd = peak.get("derived", {})
+            line = (f"  final: {len(last.get('live') or [])} live"
+                    + (f", {len(stale)} STALE {stale}" if stale else "")
+                    + f"  peak step skew "
+                    f"{pd.get('fleet/step_skew', 1.0):.2f}x")
+            if pd.get("fleet/slowest_rank") is not None:
+                line += f" (slowest rank {pd['fleet/slowest_rank']})"
+            print(line, file=out)
+        if fleet_warns:
+            by_warn = {}
+            for w in fleet_warns:
+                by_warn.setdefault(w.get("warn", "?"), []).append(w)
+            print(f"  warnings ({len(fleet_warns)}):", file=out)
+            for warn, ws in sorted(by_warn.items()):
+                last_w = ws[-1]
+                print(f"    {warn} x{len(ws)}: {last_w.get('msg', '')}",
+                      file=out)
 
     recompiles = by_kind.get("recompile", [])
     print(f"\n== recompile timeline ({len(recompiles)}) ==", file=out)
