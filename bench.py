@@ -48,6 +48,22 @@ def _cli_flag(argv, name):
     return None
 
 
+def _trace_fields():
+    """Span-tracer context for the best-so-far line (tracer comes up via
+    PADDLE_MONITOR + PADDLE_TRACE env): how many traces landed and where —
+    the line then names the file trace_view opens to decompose this
+    round's outliers. Empty when tracing is off."""
+    try:
+        from paddle_tpu.monitor import trace as _trace
+        t = _trace.get()
+    except Exception:
+        return {}
+    if t is None:
+        return {}
+    t.flush()
+    return {"traces": t.traces_sampled, "trace_path": t.path}
+
+
 def _fleet_fields():
     """step_skew/ranks for the best-so-far line, SOURCED from the telemetry
     collector (monitor/collector.py aggregates them on rank 0 when bench
@@ -161,6 +177,7 @@ def main(argv=()):
             "window": window,
         }
         payload.update(_fleet_fields())
+        payload.update(_trace_fields())
         print(json.dumps(payload))
         sys.stdout.flush()
 
@@ -277,7 +294,7 @@ def main_decode(argv=()):
         drain_ttfts()
         best = max(best, (engine.tokens_generated - tok0) / dt)
         q = (lambda v, p: float(np.percentile(v, p)) if v else None)
-        print(json.dumps(dict(_fleet_fields(), **{
+        print(json.dumps(dict(_fleet_fields(), **_trace_fields(), **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best, 1),
             "unit": "tokens/s (decode)",

@@ -248,9 +248,20 @@ class AutoCheckpoint(Callback):
         if self._global_step == self._last_saved:
             return  # this exact state is already snapshotted (e.g. a
             # save_secs tick right after resume or a periodic save)
+        t0 = time.perf_counter()
         self._ckptr.save(self._global_step, model=self.model.network,
                          optimizer=self.model._optimizer,
                          grad_scaler=self._scaler(), block=block, _mode=mode)
+        from ..monitor import trace as _trace
+        tracer = _trace._active
+        if tracer is not None:
+            # host time the fit loop spent inside save() (the async host
+            # snapshot, or the whole write when block=True) — lands as a
+            # floating span on the next step's trace, where a periodic
+            # save explains a step-time spike
+            tracer.floating("ckpt/save", t0, time.perf_counter(),
+                            step=self._global_step, block=bool(block),
+                            mode=mode or ("sync" if block else "async"))
         self._last_saved = self._global_step
         self._t_last = time.monotonic()
 

@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor import trace as _trace
 from ..core.tensor import Tensor
 
 __all__ = ["DeviceLoader", "batch_sharding", "stack_microbatches"]
@@ -138,6 +139,13 @@ def _produce(inner, put_fn, q, stop, state):
             t2 = time.perf_counter()
             _emit_stage("device_loader/fetch", t0, t1)
             _emit_stage("device_loader/h2d", t1, t2)
+            tracer = _trace._active
+            if tracer is not None:
+                # producer-side work, recorded as floating spans the NEXT
+                # step trace adopts: the waterfall shows fetch/H2D that ran
+                # (hidden or not) ahead of that step's dispatch
+                tracer.floating("loader/fetch", t0, t1)
+                tracer.floating("loader/h2d", t1, t2)
             # bounded put that notices abandonment (same pattern as
             # DataLoader._PrefetchIterator): a consumer that stopped
             # iterating must not leave this thread blocked forever
@@ -212,6 +220,11 @@ class _DeviceIterator:
             # blocking get means the producer lost the race this step; the
             # terminal END wait above is epoch teardown, not a stall)
             mon.loader_wait(t1 - t0, self._q.qsize())
+        tracer = _trace._active
+        if tracer is not None:
+            # consumer stall ahead of the next step: adopted by that step's
+            # trace, so "slow step" splits into waited-on-feed vs dispatch
+            tracer.floating("loader/wait", t0, t1, qsize=self._q.qsize())
         return item
 
     def close(self):

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import monitor as _monitor
+from ..monitor import trace as _trace
 from ..core import dispatch
 from ..core import random as _random
 from ..core import remat as _remat
@@ -322,6 +323,10 @@ class TrainStep:
         # recompile event can name exactly which leaves diverged (only
         # maintained while the monitor is enabled — zero stores otherwise)
         self._mon_prev_sig = None
+        # span-tracer state: the open per-step trace (monitor/trace.py) and
+        # a step counter for its attrs — None/0 while tracing is off
+        self._cur_trace = None
+        self._trace_n = 0
         self._opt._ensure_all_states()
         # ZeRO / hybrid optimizers place their states on construction paths that
         # run inside step(); trigger placement explicitly when present
@@ -672,13 +677,31 @@ class TrainStep:
     # ------------------------------------------------------------------ call
 
     def __call__(self, *inputs):
+        tracer = _trace._active
+        t = None
+        if tracer is not None:
+            # one head-sampled trace per step; floating spans the loader
+            # recorded since the previous step (wait/fetch/H2D, checkpoint
+            # saves) are adopted as children, so the waterfall shows what
+            # the step waited on before it dispatched
+            self._trace_n += 1
+            t = tracer.start_trace("train_step", kind="step",
+                                   step=self._trace_n)
+            self._cur_trace = t
         try:
             return self._call_impl(inputs)
         except BaseException as e:
             # flight-recorder post-mortem: dump the recent-event ring before
             # the exception unwinds out of the training loop
+            if t is not None:
+                t.event("crash", exc=type(e).__name__)
+                t.escalate("crash")
             _monitor.on_crash(e)
             raise
+        finally:
+            if t is not None:
+                self._cur_trace = None
+                t.end()
 
     def _call_impl(self, inputs):
         input_arrays = tuple(t.value() if isinstance(t, Tensor) else jnp.asarray(t)
@@ -704,6 +727,7 @@ class TrainStep:
         if self._compiled is None:
             self._build(input_arrays)
         mon = _monitor._active
+        step_trace = self._cur_trace
         # jit trace-cache size before the call: a growth across the call IS a
         # recompile (the slow path compiles lazily inside __call__)
         n0 = self._compiled._cache_size() if mon is not None else 0
@@ -712,15 +736,23 @@ class TrainStep:
 
         if mon is not None:
             _remat.reset_trace_stats()  # a cache miss traces inside the call
-        t0 = time.perf_counter() if mon is not None else 0.0
+        t0 = time.perf_counter() if (mon is not None
+                                     or step_trace is not None) else 0.0
         loss_out, new_params, new_masters, new_states, new_buffers = \
             self._compiled(param_arrays, masters, states, buffer_arrays,
                            scalars, input_arrays)
+        t1 = time.perf_counter() if t0 else 0.0
+        if step_trace is not None:
+            step_trace.record("dispatch", t0, t1, path="jit",
+                              microbatches=self._microbatches(input_arrays))
 
         if mon is not None:
             sig = self._input_sig(input_arrays)
             n1 = self._compiled._cache_size()
             if n1 > n0:
+                if step_trace is not None:
+                    # the dispatch above WAS a compile; link the sentinel
+                    step_trace.event("recompile", count=n1, path="jit")
                 mon.train_step_compiled(sig, self._mon_prev_sig,
                                         compile_s=None, count=n1, path="jit")
                 if self._acc_steps > 1:
@@ -731,7 +763,7 @@ class TrainStep:
                 # steady-state dispatch latency; a cache-miss call is compile
                 # time, not dispatch, and is already covered by the recompile
                 # event
-                mon.step_event(time.perf_counter() - t0,
+                mon.step_event(t1 - t0,
                                microbatches=self._microbatches(input_arrays))
             self._mon_prev_sig = sig
 
@@ -896,6 +928,12 @@ class TrainStep:
             # counter so bias correction replays this step number, exactly
             # as the eager path where optimizer.step() never ran
             self._opt._rollback_step()
+            if self._cur_trace is not None:
+                # a skipped update is exactly the kind of step a post-mortem
+                # wants whole: force it past head sampling
+                self._cur_trace.event("skip_update",
+                                      microbatches=self._acc_steps)
+                self._cur_trace.escalate("skip_update")
             mon = _monitor._active
             if mon is not None:
                 mon.update_skipped(self._acc_steps)
@@ -981,6 +1019,11 @@ class TrainStep:
         compile_s = time.perf_counter() - t_c
         sig = self._input_sig(input_arrays)
         self._fast[sig] = exe
+        if self._cur_trace is not None:
+            # the step that paid the compile carries it as its own span,
+            # linked to the recompile-sentinel payload by bucket count
+            self._cur_trace.record("compile", t_c, time.perf_counter(),
+                                   path="aot", bucket=len(self._fast))
         mon = _monitor._active
         if mon is not None:
             # recompile sentinel: new AOT shape bucket — event carries the
@@ -1117,8 +1160,10 @@ class TrainStep:
             self._mon_prev_sig = sig
         st = self._fast_state
 
+        step_trace = self._cur_trace
         t0 = time.perf_counter() if (_prof_recorder.enabled
-                                     or mon is not None) else 0.0
+                                     or mon is not None
+                                     or step_trace is not None) else 0.0
         loss_out, new_params, new_masters, new_states, new_buffers = exe(
             st[0], st[1], st[2], st[3], scalars, input_arrays)
         if t0:
@@ -1128,6 +1173,11 @@ class TrainStep:
             if mon is not None:
                 mon.step_event(t1 - t0,
                                microbatches=self._microbatches(input_arrays))
+            if step_trace is not None:
+                step_trace.record(
+                    "dispatch", t0, t1, path="aot",
+                    bucket=list(self._fast).index(sig) + 1,
+                    microbatches=self._microbatches(input_arrays))
 
         # outputs become next step's inputs verbatim (donation-friendly: the
         # just-invalidated input buffers are replaced wholesale)

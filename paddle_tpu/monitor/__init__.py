@@ -29,6 +29,8 @@ import time
 import warnings
 from typing import Optional
 
+from . import prom as _prom
+from . import trace as _trace_mod
 from .memory import executable_memory_stats, live_array_census
 from .recorder import FlightRecorder
 from .registry import Counter, Gauge, Histogram, Registry
@@ -36,7 +38,7 @@ from .sink import SCHEMA_VERSION, JsonlSink, resolve_sink_path
 
 __all__ = ["enable", "disable", "enabled", "get", "emit", "dump",
            "counter", "gauge", "histogram", "snapshot", "fleet_state",
-           "live_array_census", "executable_memory_stats",
+           "live_array_census", "executable_memory_stats", "prom_render",
            "Monitor", "Registry", "Counter", "Gauge", "Histogram",
            "SCHEMA_VERSION"]
 
@@ -49,6 +51,19 @@ _lock = threading.Lock()
 # consumer-visible stall threshold: a q.get() that returns in under 1ms was
 # not a stall, it was queue bookkeeping
 _STALL_S = 1e-3
+
+# event kinds that embed the active trace_id when the span tracer is up —
+# the WARN/anomaly records an operator follows FROM metrics INTO a trace.
+# ONLY kinds whose emitter runs INSIDE the implicated trace's own live
+# context belong here (the backfill reads "this thread's current / most
+# recent trace"). Excluded on purpose: fleet_warn / serve_preempt /
+# serve_page_reject name a DIFFERENT actor's trace (their emitters attach
+# it explicitly when known), and between-steps emitters (loader_stall,
+# ckpt_save, preemption) would name the PREVIOUS — already ended, possibly
+# unsampled — step while their floating spans land in the NEXT one.
+_TRACED_KINDS = frozenset((
+    "recompile", "skip_update", "fast_state_dropped", "serve_reject",
+    "crash"))
 
 
 def _sig_json(sig):
@@ -106,9 +121,17 @@ class Monitor:
 
     def emit(self, kind: str, **fields):
         """One event record: into the flight-recorder ring always, into the
-        JSONL sink when one is attached."""
+        JSONL sink when one is attached. Anomaly/WARN kinds embed the span
+        tracer's active trace_id when one is up, so a WARN in the metrics
+        stream names the trace to open in tools/trace_view.py."""
         rec = {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind}
         rec.update(fields)
+        if kind in _TRACED_KINDS and "trace" not in rec:
+            tracer = _trace_mod._active
+            if tracer is not None:
+                tid = tracer.current_trace_id()
+                if tid:
+                    rec["trace"] = tid
         self.flight.push(rec)
         if self.sink is not None:
             self.sink.write(rec)
@@ -183,12 +206,20 @@ class Monitor:
         if self.warn_after is not None and count > self.warn_after:
             why = "; ".join(divergent) if divergent \
                 else "first signature unknown"
+            tracer = _trace_mod._active
+            tid = tracer.current_trace_id() if tracer is not None else None
+            if tracer is not None:
+                # always-sample-on-WARN: the step that tripped the sentinel
+                # must survive head sampling
+                tracer.escalate(reason="recompile_warn")
             warnings.warn(
                 f"TrainStep recompiled {count} executables "
                 f"(warn_after={self.warn_after}): {why}. Unplanned shape "
                 f"churn defeats the bucketing contract (io/bucketing.py) — "
                 f"pad inputs to fixed boundaries or add the new shape to the "
-                f"bucket set.", RuntimeWarning, stacklevel=3)
+                f"bucket set."
+                + (f" [trace {tid}]" if tid else ""),
+                RuntimeWarning, stacklevel=3)
 
     def step_event(self, dur_s: float, microbatches: int = 1):
         self.registry.counter("train_step/steps").inc()
@@ -403,22 +434,35 @@ class Monitor:
         measured)."""
         self.registry.histogram("serve/queue_wait_s").observe(wait_s)
 
-    def serve_page_reject(self, free_blocks: int, needed_blocks: int):
+    def serve_page_reject(self, free_blocks: int, needed_blocks: int,
+                          trace_id=None):
         """Paged admission refused for lack of KV blocks. ``free >=
         needed`` in this event is the allocator-bug signature (refusal
-        without real pressure) that metrics_summary WARNs on."""
+        without real pressure) that metrics_summary WARNs on.
+        ``trace_id``: the refused REQUEST's trace (more precise than the
+        generic most-recent-trace tag)."""
         self.registry.counter("serve/page_rejects").inc()
-        self.emit("serve_page_reject", free_blocks=int(free_blocks),
-                  needed_blocks=int(needed_blocks))
+        fields = dict(free_blocks=int(free_blocks),
+                      needed_blocks=int(needed_blocks))
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("serve_page_reject", **fields)
 
-    def serve_preempted(self, nth: int):
+    def serve_preempted(self, nth: int, trace_id=None):
         """Pool pressure evicted a tenant back to the queue (its compute
-        is redone on re-admission)."""
+        is redone on re-admission). ``trace_id``: the VICTIM request's
+        trace."""
         self.registry.counter("serve/preemptions").inc()
-        self.emit("serve_preempt", nth=int(nth))
+        fields = dict(nth=int(nth))
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("serve_preempt", **fields)
 
-    def serve_paged(self, pager_stats, kv_util: float, preemptions: int):
-        """Per-decode-step paged-pool gauges (cheap sets, no event)."""
+    def serve_paged(self, pager_stats, kv_util: float):
+        """Per-decode-step paged-pool gauges (cheap sets, no event). The
+        cumulative preemption count lives in the serve/preemptions COUNTER
+        (serve_preempted), not a gauge here — a same-named gauge tripped
+        the registry's no-silent-shadowing check."""
         g = self.registry.gauge
         g("serve/blocks_free").set(pager_stats.blocks_free)
         g("serve/blocks_used").set(pager_stats.blocks_used)
@@ -432,7 +476,6 @@ class Monitor:
         g("serve/sharing_ratio").set(
             pager_stats.block_refs / pager_stats.blocks_used
             if pager_stats.blocks_used else 1.0)
-        g("serve/preemptions").set(preemptions)
 
     def serve_admitted(self, ttft_s: float, bucket: int, prefill_s: float):
         """A request's prefill folded into a free slot; its first token is
@@ -496,8 +539,17 @@ class Monitor:
             fleet = _collector.fleet_state()
         except Exception:
             pass
+        # span-tracer context: the dump names the trace(s) to open, and a
+        # crash force-samples everything in flight so they exist on disk
+        trace_info = None
+        tracer = _trace_mod._active
+        if tracer is not None:
+            if exc is not None:
+                tracer.escalate(reason="crash")
+            trace_info = tracer.snapshot_info()
+            tracer.flush()
         return self.flight.dump(path, registry_snapshot=snap, exc=exc,
-                                fleet=fleet)
+                                fleet=fleet, trace=trace_info)
 
     def on_crash(self, exc: BaseException):
         # one dump per exception object: TrainStep.__call__ raising inside
@@ -523,7 +575,7 @@ class Monitor:
 
 def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
            flush_every: int = 64, ring: int = 256,
-           fleet=None) -> Monitor:
+           fleet=None, trace=None) -> Monitor:
     """Turn the monitor on. ``path`` is the JSONL sink file (None: flight
     recorder only); in multi-process runs each process writes
     ``path.procN`` (see sink.resolve_sink_path). Idempotent-safe: enabling
@@ -532,7 +584,13 @@ def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
     ``fleet`` starts the online fleet-telemetry plane (monitor/collector.py):
     True derives the rank-0 stream path from ``path`` (``run.jsonl`` ->
     ``run.fleet.jsonl``), a string is the explicit stream path. Default None
-    follows the ``PADDLE_MONITOR_FLEET`` env."""
+    follows the ``PADDLE_MONITOR_FLEET`` env.
+
+    ``trace`` starts the span tracer (monitor/trace.py) the same way: True
+    derives ``run.trace.jsonl`` from ``path`` (per-process suffix applies —
+    every rank traces its own requests/steps), a string is the explicit
+    path; default None follows ``PADDLE_TRACE``; sampling follows
+    ``PADDLE_TRACE_SAMPLE``."""
     global _active
     with _lock:
         if _active is not None:
@@ -553,6 +611,20 @@ def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
             registry=mon.registry, emit=mon.emit,
             fleet_path=_collector.resolve_fleet_path(
                 fleet if isinstance(fleet, str) else None, path))
+    if trace is None:
+        v = os.environ.get("PADDLE_TRACE")
+        trace = None if not v or v.lower() in ("0", "false", "no", "off") \
+            else v
+    if trace:
+        if isinstance(trace, str) and trace.lower() not in ("1", "true",
+                                                            "yes", "on"):
+            tpath = trace
+        else:
+            base = path or f"monitor_{os.getpid()}.jsonl"
+            root, _ = os.path.splitext(base)
+            tpath = root + ".trace.jsonl"
+        tracer = _trace_mod.enable(tpath)
+        tracer._via_monitor = True   # disable() tears it down with us
     return mon
 
 
@@ -571,6 +643,11 @@ def _teardown_locked():
         # only the plane over THIS session's registry dies with it
         if _collector.get_active().publisher.registry is mon.registry:
             _collector.stop()
+    tracer = _trace_mod.get()
+    if mon is not None and tracer is not None \
+            and getattr(tracer, "_via_monitor", False):
+        # a tracer the user enabled directly outlives the monitor session
+        _trace_mod.disable()
     if mon is not None:
         mon.close()
 
@@ -628,6 +705,25 @@ def fleet_state() -> Optional[dict]:
     up (monitor/collector.py); None on other ranks or when inactive."""
     from . import collector as _collector
     return _collector.fleet_state()
+
+
+def prom_render(source=None) -> str:
+    """Prometheus text-format view of monitor metrics (monitor/prom.py).
+
+    ``source=None`` renders the LIVE registry of the enabled monitor (plus
+    the latest fleet record when the collector plane is up — per-rank
+    values gain ``rank`` labels); pass a registry ``snapshot()`` dict or a
+    fleet record to render those instead. Empty string when nothing is
+    enabled."""
+    if source is None:
+        mon = _active
+        fleet = fleet_state()
+        if fleet is not None:
+            return _prom.render(fleet)
+        if mon is None:
+            return ""
+        source = mon.registry.snapshot()
+    return _prom.render(source)
 
 
 def on_crash(exc: BaseException):

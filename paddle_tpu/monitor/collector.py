@@ -49,6 +49,7 @@ import time
 import warnings
 from typing import Dict, List, Optional
 
+from . import trace as _trace_mod
 from .registry import Registry
 from .sink import JsonlSink
 
@@ -193,6 +194,14 @@ class Publisher:
                 "counters": delta.get("counters", {}),
                 "gauges": delta.get("gauges", {}),
                 "hists": delta.get("histograms", {})}
+        tracer = _trace_mod._active
+        if tracer is not None:
+            # this rank's most recent trace id rides the wire: a straggler
+            # WARN on rank 0 can then name BOTH the slow rank and the trace
+            # to open on that rank's run.trace.jsonl
+            tid = tracer.current_trace_id()
+            if tid:
+                blob["trace"] = tid
         payload = json.dumps(blob)
         try:
             # full slot FIRST: a visible delta must imply its anchor full
@@ -240,12 +249,13 @@ class _RankState:
     """Aggregator-side merged view of one rank's cumulative metrics."""
 
     __slots__ = ("inc", "seq", "base_seq", "ts", "rx", "counters", "gauges",
-                 "hists", "prev_step")
+                 "hists", "prev_step", "trace")
 
     def __init__(self, inc: dict):
         self.inc = inc
         self.seq = 0
         self.base_seq = 0  # seq of the last FULL blob folded (replace point)
+        self.trace = None  # the rank's last published span-tracer trace id
         self.ts = 0.0   # publisher's clock at blob creation (display only)
         # AGGREGATOR's clock when a new blob was last accepted: liveness
         # must compare clocks from ONE host — judging the publisher's ts
@@ -376,14 +386,28 @@ class Aggregator:
         if seq > st.seq:
             st.seq = seq
             st.ts = float(b.get("ts", time.time()))
+            if b.get("trace"):
+                st.trace = str(b["trace"])
         st.rx = time.time()
 
     # ------------------------------------------------------------ aggregation
 
+    def _rank_trace(self, rank) -> Optional[str]:
+        st = self._ranks.get(rank)
+        return st.trace if st is not None else None
+
     def _event(self, kind: str, **fields):
         """WARN/lifecycle events go to BOTH sides of the plane: the fleet
         stream (the live dashboard reads it) and rank 0's own monitor sink +
-        flight ring (a crash report keeps the fleet context)."""
+        flight ring (a crash report keeps the fleet context). A WARN also
+        escalates the local span tracer (always-sample-on-WARN): whatever
+        rank 0 had in flight when the fleet went bad survives sampling."""
+        if kind == "fleet_warn":
+            tracer = _trace_mod._active
+            if tracer is not None:
+                tracer.escalate(reason=str(fields.get("warn", "fleet")))
+        if fields.get("trace", "") is None:
+            del fields["trace"]  # no known trace: omit, don't write null
         rec = {"v": FLEET_SCHEMA_VERSION, "ts": time.time(), "kind": kind}
         rec.update(fields)
         if self.sink is not None:
@@ -467,6 +491,7 @@ class Aggregator:
         for r in sorted(stale_now - self._warned_stale):
             self._event("fleet_warn", warn="stale", rank=r,
                         stale_after_s=self.stale_after,
+                        trace=self._rank_trace(r),
                         msg=f"rank {r} missed its heartbeat: no telemetry "
                             f"blob for >= {self.stale_after:.1f}s")
         self._warned_stale = stale_now
@@ -474,14 +499,16 @@ class Aggregator:
         if d["skew"] > self.skew_warn and d["slowest"] is not None:
             r = d["slowest"]
             if r not in self._warned_straggler:
+                tid = self._rank_trace(r)
                 self._event(
                     "fleet_warn", warn="straggler", rank=r,
-                    skew=round(d["skew"], 3),
+                    skew=round(d["skew"], 3), trace=tid,
                     step_s={str(k): v for k, v in d["step_s"].items()},
                     msg=f"rank {r} is the fleet straggler: step time "
                         f"{d['step_s'][r] * 1e3:.1f}ms is "
                         f"{d['skew']:.2f}x the fastest rank "
-                        f"(threshold {self.skew_warn:.2f}x)")
+                        f"(threshold {self.skew_warn:.2f}x)"
+                        + (f" [trace {tid} on rank {r}]" if tid else ""))
                 self._warned_straggler.add(r)
         else:
             self._warned_straggler.clear()
@@ -489,6 +516,7 @@ class Aggregator:
         for div in d["diverged"]:
             self._event("fleet_warn", warn="divergence", rank=div["rank"],
                         counter=div["counter"],
+                        trace=self._rank_trace(div["rank"]),
                         msg=f"rank {div['rank']} advanced "
                             f"{div['counter']} ALONE this window — "
                             f"one-rank divergence (placement/bucketing bug "

@@ -36,7 +36,8 @@ class FlightRecorder:
 
     def dump(self, path: str, registry_snapshot: Optional[dict] = None,
              exc: Optional[BaseException] = None,
-             fleet: Optional[dict] = None) -> str:
+             fleet: Optional[dict] = None,
+             trace: Optional[dict] = None) -> str:
         payload = {
             "v": SCHEMA_VERSION,
             "kind": "flight_dump",
@@ -51,6 +52,10 @@ class FlightRecorder:
             # rank 0's last aggregated fleet snapshot (monitor/collector.py):
             # the post-mortem shows the whole fleet, not just this rank
             payload["fleet"] = fleet
+        if trace is not None:
+            # span-tracer context (monitor/trace.py): the stream path and
+            # the open/recent trace ids — the dump names the trace to open
+            payload["trace"] = trace
         if exc is not None:
             payload["exception"] = {
                 "type": type(exc).__name__,

@@ -339,7 +339,22 @@ class Profiler:
                 f"over {len(self._step_times)} steps")
 
     def _export_chrome(self, path: str):
+        # span-tracer merge: finished spans from the monitor tracer's ring
+        # are timed on the SAME perf_counter clock as host events, so both
+        # land on one timeline — a profiler window around a slow step shows
+        # the step's trace spans (queue/prefill/dispatch) in place
+        trace_spans = []
+        try:
+            from ..monitor import trace as _trace_mod
+            tracer = _trace_mod._active
+            if tracer is not None:
+                trace_spans = list(tracer.ring)
+        except Exception:
+            pass
         t0 = min((e.start for e in _recorder.events), default=0.0)
+        if trace_spans:
+            t0 = min([t0] + [s["_t0"] for s in trace_spans]) \
+                if _recorder.events else min(s["_t0"] for s in trace_spans)
         pid = os.getpid()
         # real thread ids, compacted to stable small ints in order of first
         # appearance, with thread_name metadata rows — the DeviceLoader
@@ -358,6 +373,20 @@ class Profiler:
             events.append({"name": e.name, "ph": "X", "pid": pid, "tid": tid,
                            "ts": (e.start - t0) * 1e6,
                            "dur": (e.end - e.start) * 1e6, "cat": e.kind})
+        for s in trace_spans:
+            key = f"trace:{s.get('trace')}"
+            tid = tid_map.get(key)
+            if tid is None:
+                tid = tid_map[key] = len(tid_map)
+                meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                             "tid": tid, "ts": 0.0, "dur": 0.0,
+                             "args": {"name": key}})
+            events.append({"name": s.get("name", "?"), "ph": "X",
+                           "pid": pid, "tid": tid,
+                           "ts": (s["_t0"] - t0) * 1e6,
+                           "dur": (s["_t1"] - s["_t0"]) * 1e6,
+                           "cat": "trace",
+                           "args": s.get("attrs") or {}})
         with open(path, "w") as f:
             json.dump({"traceEvents": meta + events,
                        "displayTimeUnit": "ms"}, f)

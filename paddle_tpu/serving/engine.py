@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import monitor as _monitor
+from ..monitor import trace as _trace
 from ..core.tensor import Tensor
 from ..models.gpt import (_lm_head_logits, _pick_token,
                           _resolve_decode_horizon)
@@ -518,6 +519,15 @@ class DecodeEngine:
             req = Request([], max_new_tokens=1, request_id=request_id)
             self._reject(req, f"invalid request: {e}")
             return req
+        trc = _trace._active
+        if trc is not None:
+            # one trace per request, head-sampled at the door; phases open
+            # and close across step() iterations so a TTFT decomposes as
+            # queue + prefill (+ requeue episodes) with no gaps
+            req._trace = trc.start_trace(
+                "request", kind="request", current=False, request=req.id,
+                engine=self.engine_id, prompt=len(req.prompt),
+                max_new=req.max_new_tokens)
         n = len(req.prompt)
         if n == 0:
             self._reject(req, "empty prompt")
@@ -550,10 +560,14 @@ class DecodeEngine:
             if mon is not None:
                 mon.serve_request(queued=False, error=req.error,
                                   overload=True)
+            if req._trace is not None:
+                req._trace.end(status="rejected_overload", error=req.error)
         else:
             mon = _monitor._active
             if mon is not None:
                 mon.serve_request(queued=True)
+            if req._trace is not None:
+                req._phase = req._trace.span("queue")
         return req
 
     def _reject(self, req: Request, why: str):
@@ -561,6 +575,8 @@ class DecodeEngine:
         mon = _monitor._active
         if mon is not None:
             mon.serve_request(queued=False, error=why)
+        if req._trace is not None:
+            req._trace.end(status="failed", error=why)
 
     # ---------------------------------------------------------- scheduling
 
@@ -652,14 +668,32 @@ class DecodeEngine:
             self._slots.release(slot)
             mon = _monitor._active
             if mon is not None:
-                mon.serve_page_reject(free, needed)
+                mon.serve_page_reject(
+                    free, needed,
+                    trace_id=req._trace.trace_id
+                    if req._trace is not None else None)
+            if req._trace is not None:
+                req._trace.event("page_reject", free=int(free),
+                                 needed=int(needed))
+                if free >= needed:
+                    # refusal WITHOUT real pressure is the allocator-bug
+                    # signature — this trace must survive head sampling
+                    req._trace.escalate("page_reject")
             return False
         self._slot_seq[slot] = next(self._admit_seq)
         self._prefilling[slot] = _PrefillState(req, cov, copies)
         req.slot, req.status = slot, "prefilling"
         mon = _monitor._active
         if mon is not None:
-            mon.serve_queue_wait(time.time() - req.t_submit)
+            # measured from the LAST enqueue (a preemption re-queue resets
+            # it), so the histogram and the trace's queue phase agree
+            mon.serve_queue_wait(max(time.time() - req.t_enqueue, 0.0))
+        if req._trace is not None:
+            if req._phase is not None:
+                req._phase.set(slot=slot)
+            ph = req._trace_phase("prefill", slot=slot, shared=int(cov))
+            if copies:
+                ph.event("cow", n=len(copies))
         return True
 
     def _advance_prefill(self, slot: int, finished: List[Request]):
@@ -688,9 +722,14 @@ class DecodeEngine:
             jnp.asarray(self._pager.tables), jnp.asarray(ids),
             jnp.int32(slot), jnp.int32(p0), jnp.int32(end), src, dst,
             self._next_key())
-        st.prefill_s += time.time() - t0
+        chunk_s = time.time() - t0
+        st.prefill_s += chunk_s
         st.done = end
         st.chunks += 1
+        if st.req._phase is not None:
+            st.req._phase.event("chunk", p0=int(p0), end=int(end),
+                                dur_s=round(chunk_s, 6),
+                                cow=len(copies))
         if end < st.n:
             return                         # more chunks next iteration
         req = st.req
@@ -709,6 +748,13 @@ class DecodeEngine:
         if mon is not None:
             mon.serve_admitted(req.t_first_token - req.t_submit, sc,
                                st.prefill_s)
+        if req._trace is not None:
+            if req._phase is not None:
+                req._phase.set(chunks=st.chunks,
+                               exe_s=round(st.prefill_s, 6))
+            req._trace_phase("decode")
+            req._trace.root.set(
+                ttft_s=round(req.t_first_token - req.t_submit, 6))
         if req._stop_hit():
             self._finish(req, finished)
 
@@ -739,11 +785,19 @@ class DecodeEngine:
         req.tokens = []
         req.t_first_token = None
         req.preemptions += 1
+        req.t_enqueue = time.time()
         self._queue.push_front(req)
         self.preemptions += 1
+        if req._trace is not None:
+            # requeue episode: whatever phase was running ends and a fresh
+            # queue phase opens at the same instant
+            req._trace.event("preempt", nth=req.preemptions)
+            req._trace_phase("queue", requeue=req.preemptions)
         mon = _monitor._active
         if mon is not None:
-            mon.serve_preempted(req.preemptions)
+            mon.serve_preempted(req.preemptions,
+                                trace_id=req._trace.trace_id
+                                if req._trace is not None else None)
 
     def _ensure_or_evict(self, slot: int, start: int, end: int):
         """ensure_writable with pool-pressure eviction: preempt youngest
@@ -769,6 +823,16 @@ class DecodeEngine:
         if exe is None:
             exe = self._build_prefill(sb)
         t0 = time.time()
+        mono0 = time.perf_counter()
+        # queue wait measured DIRECTLY at slot assignment (was derived as
+        # t_first_token - t_submit - dt, which charges host bookkeeping to
+        # the queue and can go negative when the clocks disagree with the
+        # subtraction); clamped because t_enqueue and t0 are wall-clock
+        wait_s = max(t0 - req.t_enqueue, 0.0)
+        if req._trace is not None:
+            if req._phase is not None:
+                req._phase.set(slot=slot)
+            req._trace_phase("prefill", t0=mono0, slot=slot, bucket=sb)
         self._caches, tok0 = exe(
             self._leaf_values(), self._caches, jnp.asarray(ids),
             jnp.int32(slot), jnp.int32(n), self._next_key())
@@ -784,8 +848,14 @@ class DecodeEngine:
         self._slot_req[slot] = req
         mon = _monitor._active
         if mon is not None:
-            mon.serve_queue_wait(req.t_first_token - req.t_submit - dt)
+            mon.serve_queue_wait(wait_s)
             mon.serve_admitted(req.t_first_token - req.t_submit, sb, dt)
+        if req._trace is not None:
+            if req._phase is not None:
+                req._phase.set(exe_s=round(dt, 6))
+            req._trace_phase("decode")
+            req._trace.root.set(
+                ttft_s=round(req.t_first_token - req.t_submit, 6))
         if req._stop_hit():
             self._finish(req, finished)
 
@@ -816,6 +886,11 @@ class DecodeEngine:
                 slot += 1
             if not self._live.any():       # everyone self-preempted
                 return
+            if _trace._active is not None:
+                for s, c in copies_by_slot.items():
+                    r2 = self._slot_req[s]
+                    if c and r2 is not None and r2._phase is not None:
+                        r2._phase.event("cow", n=len(c))
             src, dst = self._cow_args(
                 [p for c in copies_by_slot.values() for p in c])
             t0 = time.time()
@@ -841,6 +916,8 @@ class DecodeEngine:
             self.tokens_generated += 1
             self._pos[slot] += 1
             self._tok[slot] = t
+            if req._phase is not None:
+                req._phase.event("decode_step", dur_s=round(dt, 6))
             if req._stop_hit():
                 self._finish(req, finished)
         self.decode_steps += 1
@@ -848,8 +925,7 @@ class DecodeEngine:
         if mon is not None:
             mon.serve_step(dt, live, len(self._queue))
             if self.paged:
-                mon.serve_paged(self._pager.stats(), self.kv_util(),
-                                self.preemptions)
+                mon.serve_paged(self._pager.stats(), self.kv_util())
 
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot
@@ -866,6 +942,13 @@ class DecodeEngine:
         if mon is not None:
             mon.serve_done(len(req.tokens), req.t_done - req.t_submit,
                            "done")
+        if req._trace is not None:
+            mono = time.perf_counter()
+            if req._phase is not None:
+                req._phase.set(tokens=len(req.tokens))
+            req._trace_phase(None, t0=mono)
+            req._trace.end(t1=mono, status="done", tokens=len(req.tokens),
+                           preemptions=req.preemptions)
 
     # ------------------------------------------------------------- insight
 

@@ -43,8 +43,34 @@ class Request:
         self.slot: Optional[int] = None
         self.preemptions = 0             # pool-pressure evictions survived
         self.t_submit = time.time()
+        # when the request last entered the queue: t_submit at first, reset
+        # on a preemption re-queue — serve/queue_wait_s measures from HERE,
+        # so a preempted request's second wait doesn't absorb its first run
+        self.t_enqueue = self.t_submit
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # span-tracer state (monitor/trace.py): the request's trace and its
+        # currently open phase span; None when tracing is off
+        self._trace = None
+        self._phase = None
+
+    def _trace_phase(self, name: Optional[str], t0: Optional[float] = None,
+                     **attrs):
+        """Close the open phase span and open ``name`` at the SAME instant
+        — the gap-free chain invariant every engine transition relies on
+        (TTFT must equal the sum of its pre-first-token phases, so a phase
+        may never end before the next begins). ``name=None`` just closes.
+        Returns the new span (None when untraced/closing). Set attrs on
+        the CLOSING span via ``self._phase.set(...)`` before calling."""
+        if self._trace is None:
+            return None
+        if t0 is None:
+            t0 = time.perf_counter()
+        if self._phase is not None:
+            self._phase.end(t0)
+        self._phase = self._trace.span(name, t0=t0, **attrs) \
+            if name is not None else None
+        return self._phase
 
     @property
     def output_tokens(self) -> List[int]:

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""fleet_prom — Prometheus text-format export of monitor telemetry.
+
+Renders the fleet stream rank 0's aggregator writes (``run.fleet.jsonl`` —
+per-rank series gain ``rank`` labels) or a per-process monitor JSONL (the
+last embedded ``counters`` registry snapshot) in the Prometheus exposition
+format, so the telemetry the run already produces can feed a real scrape
+pipeline without new instrumentation.
+
+Stdlib only: the render lives in ``paddle_tpu/monitor/prom.py`` (itself
+pure stdlib) and is loaded by FILE PATH — no ``import paddle_tpu``, no jax,
+so this works on a bastion host that only mounts the log dir.
+
+Usage:
+    python tools/fleet_prom.py run.fleet.jsonl             # print and exit
+    python tools/fleet_prom.py run.jsonl run.proc1.jsonl   # registry mode
+    python tools/fleet_prom.py run.fleet.jsonl --serve 9464   # one-shot HTTP
+    python tools/fleet_prom.py run.fleet.jsonl --serve 9464 --keep  # loop
+
+``--serve`` binds an HTTP endpoint whose ``/metrics`` re-reads the file(s)
+per scrape; by default it answers exactly ONE request and exits (scrape
+testing: `curl localhost:9464/metrics` against a live run without leaving a
+daemon behind). ``--keep`` serves until interrupted.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PROM_PATH = os.path.join(os.path.dirname(_HERE), "paddle_tpu", "monitor",
+                          "prom.py")
+
+
+def _load_prom():
+    spec = importlib.util.spec_from_file_location("paddle_prom", _PROM_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_source(path):
+    """One JSONL file -> the render source: the LAST fleet record when the
+    file is a fleet stream, else the last embedded registry snapshot of a
+    per-process monitor file (with its rank, for labeling)."""
+    fleet = None
+    snap = None
+    proc = None
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"fleet_prom: {e}", file=sys.stderr)
+        return None, None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail from the live writer
+        kind = r.get("kind")
+        if kind == "fleet":
+            fleet = r
+        elif kind == "counters" and isinstance(r.get("metrics"), dict):
+            snap = r["metrics"]
+        elif kind == "meta" and "proc" in r:
+            proc = r["proc"]
+    if fleet is not None:
+        return fleet, None
+    return snap, proc
+
+
+def render_paths(paths):
+    prom = _load_prom()
+    out = []
+    for path in paths:
+        src, proc = load_source(path)
+        if src is None:
+            continue
+        if isinstance(src, dict) and src.get("kind") == "fleet":
+            out.append(prom.render_fleet(src))
+        else:
+            labels = {"rank": str(proc)} if proc is not None \
+                and len(paths) > 1 else {}
+            out.append(prom.render_snapshot(src, labels=labels))
+    return "".join(out)
+
+
+def serve(paths, port, once=True, host="127.0.0.1"):
+    """Tiny scrape endpoint; re-renders per request. ``once`` answers one
+    request then returns (the scrape-test contract)."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = render_paths(paths).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass  # scrape noise stays off stderr
+
+    srv = HTTPServer((host, int(port)), Handler)
+    print(f"fleet_prom: serving /metrics on {host}:{srv.server_port}"
+          + (" (one-shot)" if once else ""), file=sys.stderr)
+    try:
+        if once:
+            srv.handle_request()
+        else:
+            srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="run.fleet.jsonl and/or monitor JSONL file(s)")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="HTTP scrape endpoint instead of stdout "
+                         "(one request, then exit)")
+    ap.add_argument("--keep", action="store_true",
+                    help="with --serve: keep serving until interrupted")
+    args = ap.parse_args(argv)
+    if args.serve is not None:
+        return serve(args.paths, args.serve, once=not args.keep)
+    text = render_paths(args.paths)
+    if not text:
+        print("fleet_prom: no renderable records", file=sys.stderr)
+        return 1
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

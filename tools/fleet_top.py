@@ -24,10 +24,12 @@ import time
 CLEAR = "\x1b[2J\x1b[H"
 
 
-def load_stream(path):
+def load_stream(path, keep=None):
     """Parse the whole stream -> (meta, fleet_records, warns). Small files
     (one record per publish interval) make a full re-parse per frame the
-    simple, torn-tail-tolerant choice."""
+    simple, torn-tail-tolerant choice. ``keep`` bounds the retained fleet
+    records (the newest N+1): a --window view of a long job never holds
+    hours of rounds in memory just to diff the last few."""
     meta, fleets, warns = {}, [], []
     try:
         with open(path) as f:
@@ -47,8 +49,12 @@ def load_stream(path):
             meta = r
         elif kind == "fleet":
             fleets.append(r)
+            if keep is not None and len(fleets) > keep:
+                del fleets[0]
         elif kind == "fleet_warn":
             warns.append(r)
+            if keep is not None and len(warns) > 50:
+                del warns[0]
     return meta, fleets, warns
 
 
@@ -79,8 +85,22 @@ def _fmt(v, spec="{:.1f}", none="-"):
     return none if v is None else spec.format(v)
 
 
-def render(meta, fleets, warns, now=None, width=100):
-    """One dashboard frame as a string (the testable unit)."""
+def _windowed(cur, basis, kind, name, rank):
+    """counter delta over the rolling window (None on restart/backwards —
+    same garbage-guard as _rate)."""
+    a, b = _pick(basis, kind, name, rank), _pick(cur, kind, name, rank)
+    if a is None or b is None or b < a:
+        return None
+    return b - a
+
+
+def render(meta, fleets, warns, now=None, width=100, window=None):
+    """One dashboard frame as a string (the testable unit).
+
+    ``window=N`` switches every rate AND counter column to a rolling view
+    over the last N fleet rounds (long jobs: a counter that has summed for
+    six hours says nothing about the last minute); default keeps rates over
+    the newest round and counters cumulative-since-start."""
     now = time.time() if now is None else now
     out = []
     if not fleets:
@@ -90,7 +110,11 @@ def render(meta, fleets, warns, now=None, width=100):
                    "fleet_top: waiting for fleet stream ...")
         return "\n".join(out)
     cur = fleets[-1]
-    prev = fleets[-2] if len(fleets) > 1 else None
+    if window:
+        basis_i = max(len(fleets) - 1 - int(window), 0)
+        prev = fleets[basis_i] if basis_i < len(fleets) - 1 else None
+    else:
+        prev = fleets[-2] if len(fleets) > 1 else None
     d = cur.get("derived") or {}
     age = now - cur.get("ts", now)
     live, stale = cur.get("live") or [], cur.get("stale") or []
@@ -98,6 +122,9 @@ def render(meta, fleets, warns, now=None, width=100):
     head = (f"fleet_top  job={meta.get('job', '?')}  world="
             f"{meta.get('world', len(cur.get('ranks') or []))}  "
             f"round={cur.get('round', '?')}  age={age:.1f}s")
+    if window:
+        span = cur.get("ts", 0) - (prev or cur).get("ts", 0)
+        head += f"  window={int(window)} rounds ({span:.0f}s)"
     out.append(head)
     line = (f"ranks: {len(live)} live"
             + (f", {len(stale)} STALE {stale}" if stale else "")
@@ -114,22 +141,31 @@ def render(meta, fleets, warns, now=None, width=100):
         out.append(f"serving: {tok:.1f} tokens/s fleet-wide")
     out.append("-" * min(width, 100))
 
-    hdr = (f"{'rank':>4} {'steps':>9} {'steps/s':>8} {'step p50':>10} "
+    steps_col = "steps" if not window else "Δsteps"
+    hdr = (f"{'rank':>4} {steps_col:>9} {'steps/s':>8} {'step p50':>10} "
            f"{'step p95':>10} {'recomp':>7} {'skip':>5} {'ckpt':>5} "
            f"{'reshard':>8} {'tok/s':>8} {'kv_util':>8} {'queue':>6}")
     out.append(hdr)
+
+    def counter(name, rank):
+        # windowed view: the delta over the rolling window, not the
+        # cumulative since-start total
+        if window and prev is not None:
+            return _windowed(cur, prev, "counters", name, rank)
+        return _pick(cur, "counters", name, rank)
+
     for r in cur.get("ranks") or []:
         h = _pick(cur, "histograms", "train_step/dispatch_s", r) or {}
         srv_h = _pick(cur, "gauges", "serve/kv_util", r)
         row = (f"{r:>4}"
-               f" {_fmt(_pick(cur, 'counters', 'train_step/steps', r), '{:.0f}'):>9}"
+               f" {_fmt(counter('train_step/steps', r), '{:.0f}'):>9}"
                f" {_fmt(_rate(cur, prev, 'counters', 'train_step/steps', r)):>8}"
                f" {_fmt(h.get('p50'), '{:.4f}s'):>10}"
                f" {_fmt(h.get('p95'), '{:.4f}s'):>10}"
-               f" {_fmt(_pick(cur, 'counters', 'train_step/recompiles', r), '{:.0f}'):>7}"
-               f" {_fmt(_pick(cur, 'counters', 'train_step/skipped_updates', r), '{:.0f}'):>5}"
-               f" {_fmt(_pick(cur, 'counters', 'ckpt/saves', r), '{:.0f}'):>5}"
-               f" {_fmt(_pick(cur, 'counters', 'reshard/loads', r), '{:.0f}'):>8}"
+               f" {_fmt(counter('train_step/recompiles', r), '{:.0f}'):>7}"
+               f" {_fmt(counter('train_step/skipped_updates', r), '{:.0f}'):>5}"
+               f" {_fmt(counter('ckpt/saves', r), '{:.0f}'):>5}"
+               f" {_fmt(counter('reshard/loads', r), '{:.0f}'):>8}"
                f" {_fmt(_rate(cur, prev, 'counters', 'serve/tokens', r)):>8}"
                f" {_fmt(srv_h, '{:.0%}'):>8}"
                f" {_fmt(_pick(cur, 'gauges', 'serve/queue_depth', r), '{:.0f}'):>6}")
@@ -167,15 +203,21 @@ def main(argv=None):
                     help="render one frame and exit (no screen clear)")
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen")
+    ap.add_argument("--window", type=int, default=None, metavar="N",
+                    help="rolling view: rates and counter deltas over the "
+                         "last N fleet rounds instead of cumulative-since-"
+                         "start (long-job mode; also bounds memory to the "
+                         "newest N+1 rounds)")
     args = ap.parse_args(argv)
+    keep = (args.window + 1) if args.window else None
     if args.once:
-        meta, fleets, warns = load_stream(args.path)
-        print(render(meta, fleets, warns))
+        meta, fleets, warns = load_stream(args.path, keep=keep)
+        print(render(meta, fleets, warns, window=args.window))
         return 0 if fleets else 1
     try:
         while True:
-            meta, fleets, warns = load_stream(args.path)
-            frame = render(meta, fleets, warns)
+            meta, fleets, warns = load_stream(args.path, keep=keep)
+            frame = render(meta, fleets, warns, window=args.window)
             if not args.no_clear:
                 sys.stdout.write(CLEAR)
             print(frame)

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""trace_view — waterfalls and phase attribution over run.trace.jsonl.
+
+Reads the span streams the paddle_tpu.monitor.trace tracer writes (one
+``run.trace.jsonl`` per process; pass several) and answers the causal
+questions the aggregate metrics can't:
+
+* ``--slowest N`` (default view) — the N slowest traces as a table with a
+  per-phase breakdown (queue / prefill / decode / dispatch / compile /
+  loader / other), so a TTFT or step-time outlier names its phase.
+* ``--waterfall [TRACE_ID]`` — an ASCII waterfall of one trace (default:
+  the slowest); ``-n K`` renders the K slowest.
+* ``--slo P`` — percentile attribution: splits traces at the P-th
+  duration percentile and reports which phase grew in the tail vs the
+  median cohort ("p95 is queue-dominated" vs "prefill got slower").
+* ``--chrome out.json`` — Chrome/Perfetto trace export (one row per
+  trace), loadable next to the profiler's export in ui.perfetto.dev.
+* ``--kind request|step`` — filter serving requests vs training steps.
+
+Stdlib only — runs anywhere the files are visible.
+
+Usage:
+    python tools/trace_view.py run.trace.jsonl
+    python tools/trace_view.py run.trace.jsonl --slowest 10 --kind request
+    python tools/trace_view.py run.trace.jsonl --waterfall
+    python tools/trace_view.py run.trace.jsonl --slo 95
+    python tools/trace_view.py run.trace.jsonl --chrome trace_chrome.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# breakdown columns: phase-span names mapped to buckets (anything else
+# lands in "other")
+PHASES = ("queue", "prefill", "decode", "dispatch", "compile", "loader",
+          "ckpt")
+
+
+def load_traces(paths):
+    """-> {trace_id: {"spans": [...], "summary": {...}|None}} keeping file
+    order; torn tail lines from a live writer are skipped."""
+    traces = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"trace_view: {e}", file=sys.stderr)
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = r.get("kind")
+            if kind not in ("span", "trace"):
+                continue
+            t = traces.setdefault(r.get("trace"),
+                                  {"spans": [], "summary": None})
+            if kind == "span":
+                t["spans"].append(r)
+            else:
+                t["summary"] = r
+    return traces
+
+
+def _root(t):
+    for s in t["spans"]:
+        if s.get("parent") is None:
+            return s
+    return None
+
+
+def _tinfo(tid, t):
+    """One trace -> flat info dict (kind, dur, phase breakdown)."""
+    root = _root(t)
+    summary = t["summary"] or {}
+    kind = summary.get("trace_kind") or (root or {}).get("span_kind", "?")
+    dur = summary.get("dur_s", (root or {}).get("dur_s", 0.0))
+    name = summary.get("name", (root or {}).get("name", "?"))
+    attrs = dict((root or {}).get("attrs") or {})
+    attrs.update(summary.get("attrs") or {})
+    phases = dict.fromkeys(PHASES, 0.0)
+    other = 0.0
+    events = 0
+    for s in t["spans"]:
+        if s.get("parent") is None:
+            events += len(s.get("events") or [])
+            continue
+        events += len(s.get("events") or [])
+        n = s.get("name", "")
+        base = n.split("/", 1)[0]
+        if base in phases:
+            phases[base] += s.get("dur_s", 0.0)
+        elif n.startswith("loader"):
+            phases["loader"] += s.get("dur_s", 0.0)
+        else:
+            other += s.get("dur_s", 0.0)
+    return {"trace": tid, "kind": kind, "name": name, "dur_s": dur,
+            "phases": phases, "other": other, "attrs": attrs,
+            "spans": len(t["spans"]), "events": events,
+            "escalated": summary.get("escalated")}
+
+
+def select(traces, kind=None):
+    infos = [_tinfo(tid, t) for tid, t in traces.items() if t["spans"]]
+    if kind:
+        infos = [i for i in infos if i["kind"] == kind]
+    return infos
+
+
+def _fmt_ms(v):
+    return f"{v * 1e3:9.2f}"
+
+
+def slowest_table(infos, n, out=sys.stdout):
+    infos = sorted(infos, key=lambda i: -i["dur_s"])[:n]
+    cols = [p for p in PHASES
+            if any(i["phases"][p] > 0 for i in infos)] or ["queue"]
+    hdr = (f"{'trace':<14}{'kind':<9}{'dur(ms)':>10}"
+           + "".join(f"{c + '(ms)':>12}" for c in cols)
+           + f"{'other':>10}{'spans':>6}  note")
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    for i in infos:
+        note = []
+        if i["attrs"].get("status") not in (None, "done", "ok"):
+            note.append(str(i["attrs"]["status"]))
+        if i["attrs"].get("preemptions"):
+            note.append(f"preempted x{i['attrs']['preemptions']}")
+        if i["escalated"]:
+            note.append(f"escalated:{i['escalated']}")
+        print(f"{i['trace']:<14}{i['kind']:<9}{_fmt_ms(i['dur_s']):>10}"
+              + "".join(f"{_fmt_ms(i['phases'][c]):>12}" for c in cols)
+              + f"{_fmt_ms(i['other']):>10}{i['spans']:>6}  "
+              + " ".join(note), file=out)
+    return 0
+
+
+def waterfall(traces, tid, width=72, out=sys.stdout):
+    t = traces.get(tid)
+    if not t or not t["spans"]:
+        print(f"trace_view: no spans for trace {tid!r}", file=out)
+        return 1
+    spans = sorted(t["spans"], key=lambda s: (s.get("ts", 0),
+                                              s.get("span", 0)))
+    t0 = min(s.get("ts", 0) for s in spans)
+    t1 = max(s.get("ts", 0) + s.get("dur_s", 0) for s in spans)
+    span_total = max(t1 - t0, 1e-9)
+    info = _tinfo(tid, t)
+    print(f"trace {tid}  {info['name']}[{info['kind']}]  "
+          f"{info['dur_s'] * 1e3:.2f}ms  {len(spans)} spans"
+          + (f"  attrs {json.dumps(info['attrs'])}" if info["attrs"] else ""),
+          file=out)
+    depth = {None: -1}
+    by_id = {s.get("span"): s for s in spans}
+    for s in spans:
+        depth[s.get("span")] = depth.get(
+            by_id.get(s.get("parent"), {}).get("span")
+            if s.get("parent") in by_id else None, -1) + 1
+        off = s.get("ts", 0) - t0
+        dur = s.get("dur_s", 0.0)
+        lo = int(off / span_total * width)
+        hi = max(int((off + dur) / span_total * width), lo + 1)
+        bar = " " * lo + "#" * (hi - lo)
+        label = "  " * max(depth[s.get("span")], 0) + s.get("name", "?")
+        evs = len(s.get("events") or [])
+        print(f"  {label:<24}|{bar:<{width}}| {dur * 1e3:9.2f}ms"
+              + (f"  ({evs} ev)" if evs else ""), file=out)
+    return 0
+
+
+def slo_attribution(infos, pct, out=sys.stdout):
+    """Split at the pct-th duration percentile; report phase means of the
+    tail cohort vs the below-median cohort — the "what grew at p95"
+    answer."""
+    if not infos:
+        print("trace_view: no traces", file=out)
+        return 1
+    durs = sorted(i["dur_s"] for i in infos)
+    k = min(int(len(durs) * pct / 100.0), len(durs) - 1)
+    thresh = durs[k]
+    median = durs[len(durs) // 2]
+    tail = [i for i in infos if i["dur_s"] >= thresh]
+    base = [i for i in infos if i["dur_s"] <= median]
+    print(f"== SLO attribution: p{pct:g} over {len(infos)} traces ==",
+          file=out)
+    print(f"  p{pct:g} {thresh * 1e3:.2f}ms  median {median * 1e3:.2f}ms  "
+          f"tail n={len(tail)}  baseline n={len(base)}", file=out)
+
+    def mean_phase(group, p):
+        return (sum(i["phases"][p] for i in group) / len(group)) if group \
+            else 0.0
+
+    rows = []
+    for p in PHASES:
+        mt, mb = mean_phase(tail, p), mean_phase(base, p)
+        if mt == 0 and mb == 0:
+            continue
+        rows.append((p, mb, mt, mt - mb))
+    rows.sort(key=lambda r: -r[3])
+    print(f"  {'phase':<10}{'baseline(ms)':>14}{'tail(ms)':>12}"
+          f"{'delta(ms)':>12}", file=out)
+    for p, mb, mt, d in rows:
+        print(f"  {p:<10}{mb * 1e3:>14.2f}{mt * 1e3:>12.2f}"
+              f"{d * 1e3:>12.2f}", file=out)
+    if rows:
+        top = rows[0]
+        share = top[3] / max(sum(max(r[3], 0) for r in rows), 1e-12)
+        print(f"  tail latency is {top[0]}-dominated "
+              f"({share:.0%} of the phase growth)", file=out)
+    return 0
+
+
+def chrome_export(traces, path):
+    """Chrome trace JSON: one tid per trace (named row), spans as complete
+    events, span events as instants — same event shape as the profiler's
+    exporter so both files merge on one ui.perfetto.dev timeline."""
+    events = []
+    meta = []
+    all_ts = [s.get("ts", 0) for t in traces.values() for s in t["spans"]]
+    t0 = min(all_ts, default=0.0)
+    for tid_i, (tid, t) in enumerate(sorted(
+            traces.items(), key=lambda kv: min(
+                (s.get("ts", 0) for s in kv[1]["spans"]), default=0))):
+        if not t["spans"]:
+            continue
+        info = _tinfo(tid, t)
+        meta.append({"name": "thread_name", "ph": "M", "pid": 0,
+                     "tid": tid_i, "ts": 0.0, "dur": 0.0,
+                     "args": {"name": f"{info['kind']} {tid}"}})
+        for s in t["spans"]:
+            events.append({"name": s.get("name", "?"), "ph": "X", "pid": 0,
+                           "tid": tid_i,
+                           "ts": (s.get("ts", 0) - t0) * 1e6,
+                           "dur": s.get("dur_s", 0.0) * 1e6,
+                           "cat": s.get("span_kind", "span"),
+                           "args": s.get("attrs") or {}})
+            for e in s.get("events") or []:
+                events.append({"name": e.get("name", "?"), "ph": "i",
+                               "pid": 0, "tid": tid_i, "s": "t",
+                               "ts": (e.get("t", s.get("ts", 0)) - t0) * 1e6,
+                               "cat": "event",
+                               "args": {k: v for k, v in e.items()
+                                        if k not in ("name", "t")}})
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + events, "displayTimeUnit": "ms"}, f)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="run.trace.jsonl file(s)")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="slowest-N table with phase breakdown (default 10)")
+    ap.add_argument("--waterfall", nargs="?", const="", default=None,
+                    metavar="TRACE_ID",
+                    help="ASCII waterfall (default: the slowest trace)")
+    ap.add_argument("-n", type=int, default=1,
+                    help="with --waterfall: render the n slowest traces")
+    ap.add_argument("--slo", type=float, default=None, metavar="PCT",
+                    help="percentile attribution (e.g. 95)")
+    ap.add_argument("--kind", choices=("request", "step"), default=None,
+                    help="filter traces by kind")
+    ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                    help="export a Chrome/Perfetto trace JSON")
+    args = ap.parse_args(argv)
+
+    traces = load_traces(args.paths)
+    infos = select(traces, kind=args.kind)
+    if not infos:
+        print("trace_view: no traces found", file=sys.stderr)
+        return 1
+    rc = 0
+    did = False
+    if args.chrome:
+        keep = {i["trace"] for i in infos}
+        rc |= chrome_export({k: v for k, v in traces.items() if k in keep},
+                            args.chrome)
+        print(f"chrome trace -> {args.chrome} ({len(keep)} traces)")
+        did = True
+    if args.waterfall is not None:
+        if args.waterfall:
+            rc |= waterfall(traces, args.waterfall)
+        else:
+            for i in sorted(infos, key=lambda i: -i["dur_s"])[:args.n]:
+                rc |= waterfall(traces, i["trace"])
+                print()
+        did = True
+    if args.slo is not None:
+        rc |= slo_attribution(infos, args.slo)
+        did = True
+    if args.slowest is not None or not did:
+        rc |= slowest_table(infos, args.slowest or 10)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
