@@ -211,7 +211,32 @@ def main_decode(argv=()):
     ``steady_state_recompiles`` must stay 0; a nonzero value means the
     zero-recompile contract broke and the tokens/s number is compile-bound
     garbage. ``BENCH_TINY=1`` shrinks everything to a seconds-scale CI
-    smoke config."""
+    smoke config.
+
+    ``--tp N`` (requires ``--paged``) runs tensor-parallel decode over a
+    "model"-axis mesh of N chips: GPT weights ride shard_gpt_tp's Column/
+    RowParallel placements, the engine shards each KV pool's head axis and
+    keeps the block table replicated. On a CPU host the mesh is virtual
+    (the host-platform device-count flag is set before jax initializes);
+    on a real TPU the first N chips form the mesh. The best-so-far line
+    then carries per-chip tokens/s and the prefix-cache hit rate."""
+    tpf = _cli_flag(argv, "tp")
+    if tpf == "":
+        # space-separated form: --tp N (the = form is --tp=N)
+        argl = list(argv)
+        i = argl.index("--tp")
+        tpf = argl[i + 1] if i + 1 < len(argl) \
+            and argl[i + 1].isdigit() else ""
+        if not tpf:
+            raise SystemExit("--tp needs a degree: --tp N or --tp=N")
+    tp = int(tpf or 0)
+    if tp > 1 and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # virtual CPU mesh: must land before jax initializes its backend.
+        # The flag only affects the host platform — a real TPU ignores it.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" --xla_force_host_platform_device_"
+                                     f"count={tp}")
     import jax
     # same BENCH_TINY guard as main(): the persistent cache corrupts
     # restored CPU executables on this jaxlib (tests/conftest.py)
@@ -221,11 +246,15 @@ def main_decode(argv=()):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
     import paddle_tpu as paddle
-    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, shard_gpt_tp
     from paddle_tpu.serving import DecodeEngine
 
     paged = _cli_flag(argv, "paged") is not None
     tiny = bool(os.environ.get("BENCH_TINY"))
+    if tp > 1 and not paged:
+        print("--tp requires --paged (the row cache is single-chip); "
+              "enabling --paged", file=sys.stderr)
+        paged = True
 
     paddle.seed(0)
     size = (dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -237,6 +266,14 @@ def main_decode(argv=()):
     model = GPTForCausalLM(cfg)
     for _, p in model.named_parameters():
         p._data = p.value().astype("bfloat16")
+    if tp > 1:
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.env import set_mesh
+        devs = np.asarray(jax.devices()[:tp])
+        if len(devs) < tp:
+            raise SystemExit(f"--tp={tp} but only {len(devs)} devices")
+        set_mesh(Mesh(devs.reshape(tp), ("model",)))
+        shard_gpt_tp(model)
 
     slots, horizon = (4, 64) if tiny else (16, 256)
     if paged:
@@ -267,6 +304,7 @@ def main_decode(argv=()):
                               max_new_tokens=int(rng.randint(
                                   horizon // 4, horizon // 2)))
             reqs.append(r)
+            n_submitted[0] += 1
 
     def drain_ttfts():
         done = [r for r in reqs if r.t_first_token is not None]
@@ -274,6 +312,7 @@ def main_decode(argv=()):
         reqs[:] = [r for r in reqs if r.t_first_token is None]
 
     reqs = []
+    n_submitted = [0]
     # warmup: fill all slots and step until the first decode ran — by then
     # every executable (chunk/prefill + decode) is minted
     refill()
@@ -294,13 +333,22 @@ def main_decode(argv=()):
         drain_ttfts()
         best = max(best, (engine.tokens_generated - tok0) / dt)
         q = (lambda v, p: float(np.percentile(v, p)) if v else None)
+        chips = max(tp, 1)
+        pager = engine._pager if paged else None
         print(json.dumps(dict(_fleet_fields(), **_trace_fields(), **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
-            "value": round(best, 1),
+            "value": round(best / chips, 1),
             "unit": "tokens/s (decode)",
-            "vs_baseline": (round(best / REF_DECODE_TOKENS_PER_SEC, 3)
-                            if REF_DECODE_TOKENS_PER_SEC else None),
+            "vs_baseline": (round(best / chips / REF_DECODE_TOKENS_PER_SEC,
+                                  3) if REF_DECODE_TOKENS_PER_SEC else None),
             "paged": paged,
+            "tp": chips,
+            "tokens_per_sec_total": round(best, 1),
+            "prefix_hit_rate": (round(pager.prefix_hits
+                                      / max(n_submitted[0], 1), 3)
+                                if pager is not None else None),
+            "prefix_hit_tokens": (pager.prefix_hit_tokens
+                                  if pager is not None else None),
             "kv_util": round(engine.kv_util(), 3),
             "ttft_p50_ms": (round(q(ttfts, 50) * 1e3, 2) if ttfts else None),
             "ttft_p95_ms": (round(q(ttfts, 95) * 1e3, 2) if ttfts else None),

@@ -5,7 +5,8 @@ variants in incubate; full LM architectures (GPT/BERT/ERNIE) live in PaddleNLP b
 those layers. Here they are first-class since they are the benchmark configs: GPT
 (decoder LM, the north-star config) and BERT (encoder, the to_static config).
 """
-from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_tiny  # noqa: F401
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b,  # noqa: F401
+                  gpt_tiny, shard_gpt_tp)
 from .bert import BertConfig, BertModel, BertForPreTraining, bert_base, bert_tiny  # noqa: F401
 from .ernie import (ErnieConfig, ErnieModel,  # noqa: F401
                     ErnieForSequenceClassification, ErnieForMaskedLM,

@@ -208,6 +208,30 @@ def gpt_tiny(**overrides) -> "GPTConfig":
     return GPTConfig(**cfg)
 
 
+# Tensor-parallel serving context (serving/engine.py sets it around its
+# executable traces): a NamedSharding pinning the KV pools — and, when
+# ``constrain_view`` is on, the gathered per-row views (same rank-4 axis
+# order) — to the device mesh, usually head-sharded P(None, None, "model",
+# None). The constraint keeps the block-axis scatter/gather SHARD-LOCAL on
+# the head axis: block indices are replicated data, so each device
+# scatters and gathers only its own n_kv (or hd) shard and no resharding
+# ever lands inside the decode step. The view constraint is only applied
+# for HEAD-axis sharding: per-head attention consumes it layout-unchanged
+# there, while pinning an hd-sharded view fights GQA attention's preferred
+# layout and forces XLA into full rematerializations.
+_PAGED_KV_SHARD = {"sharding": None, "constrain_view": True}
+
+
+def set_paged_kv_sharding(sharding, constrain_view=True):
+    """Install (or clear, with None) the paged-pool sharding constraint.
+    Returns the previous (sharding, constrain_view) pair so callers can
+    restore it (try/finally)."""
+    prev = (_PAGED_KV_SHARD["sharding"], _PAGED_KV_SHARD["constrain_view"])
+    _PAGED_KV_SHARD["sharding"] = sharding
+    _PAGED_KV_SHARD["constrain_view"] = bool(constrain_view)
+    return prev
+
+
 def _paged_kv_update(kv_cache, k, v):
     """Paged-cache write + gather, shared by GPT and LLaMA cached attention.
 
@@ -222,7 +246,10 @@ def _paged_kv_update(kv_cache, k, v):
     shared block. Reads gather every row's blocks back into a contiguous
     [B, mbs*BS, n_kv, hd] view with ``jnp.take`` on the block axis — the
     caller's causal mask (key position <= query position) hides the stale
-    tail exactly as it does for the contiguous layout.
+    tail exactly as it does for the contiguous layout. Under a tensor-
+    parallel mesh (``set_paged_kv_sharding``) both the updated pools and
+    the gathered views are constrained to the head-sharded placement, so
+    the scatter and the gather stay shard-local on the head axis.
     """
     pool_k, pool_v, table, pos, write_end = kv_cache
     b, s = k.shape[:2]
@@ -241,9 +268,16 @@ def _paged_kv_update(kv_cache, k, v):
     off = wpos % bs_blk
     pool_k = pool_k.at[phys, off].set(k.astype(pool_k.dtype))
     pool_v = pool_v.at[phys, off].set(v.astype(pool_v.dtype))
+    shard = _PAGED_KV_SHARD["sharding"]
+    if shard is not None:
+        pool_k = jax.lax.with_sharding_constraint(pool_k, shard)
+        pool_v = jax.lax.with_sharding_constraint(pool_v, shard)
     nkv, hd = pool_k.shape[2], pool_k.shape[3]
     k_view = jnp.take(pool_k, table, axis=0).reshape(b, mbs * bs_blk, nkv, hd)
     v_view = jnp.take(pool_v, table, axis=0).reshape(b, mbs * bs_blk, nkv, hd)
+    if shard is not None and _PAGED_KV_SHARD["constrain_view"]:
+        k_view = jax.lax.with_sharding_constraint(k_view, shard)
+        v_view = jax.lax.with_sharding_constraint(v_view, shard)
     return k_view, v_view, (pool_k, pool_v)
 
 
@@ -637,6 +671,42 @@ class GPTForCausalLM(nn.Layer):
             temperature=temperature, do_sample=do_sample, top_k=top_k,
             eos_token_id=eos_token_id, seed=seed, max_length=max_length)
 
+
+
+def shard_gpt_tp(model: "GPTForCausalLM", mesh=None, axis: str = "model"):
+    """Tensor-parallel placement for GPT (the Fleet mp_layers recipe as
+    NamedShardings, mirroring ``shard_llama_tp``): column-shard qkv_proj
+    and fc_in (weights ``P(None, axis)``, biases ``P(axis)``), row-shard
+    out_proj and fc_out (``P(axis, None)``, replicated bias — their output
+    is the mp_allreduce psum), vocab-shard the token embedding (the tied
+    LM head reads the same array). LayerNorms and the position table stay
+    replicated. XLA's SPMD partitioner inserts the collectives; a dim not
+    divisible by the axis degree is left replicated rather than refused, so
+    odd geometries degrade instead of erroring."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..distributed.env import get_mesh
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return model
+    tp = mesh.shape[axis]
+
+    def put(p, spec, dim_sizes):
+        if p is None or any(d % tp for d in dim_sizes):
+            return
+        p._data = jax.device_put(p.value(), NamedSharding(mesh, spec))
+
+    for name, p in model.named_parameters():
+        if name.endswith(("qkv_proj.weight", "fc_in.weight")):
+            put(p, P(None, axis), (p.shape[1],))
+        elif name.endswith(("qkv_proj.bias", "fc_in.bias")):
+            put(p, P(axis), (p.shape[0],))
+        elif name.endswith(("out_proj.weight", "fc_out.weight")):
+            put(p, P(axis, None), (p.shape[0],))
+        elif name.endswith("wte.weight"):
+            put(p, P(axis, None), (p.shape[0],))
+        elif name.endswith("lm_head.weight"):
+            put(p, P(None, axis), (p.shape[1],))
+    return model
 
 
 def _lm_head_logits(hidden_last, head_weight, transpose: bool):

@@ -367,7 +367,14 @@ class LlamaForCausalLM(nn.Layer):
 def shard_llama_tp(model: LlamaForCausalLM, mesh=None, axis: str = "model"):
     """Tensor-parallel placement: column-shard q/k/v/gate/up, row-shard
     o/down, vocab-shard the embedding (the Fleet mp_layers recipe as
-    NamedShardings — XLA inserts the TP collectives)."""
+    NamedShardings — XLA inserts the TP collectives).
+
+    Serving: a model sharded here makes ``serving.DecodeEngine`` mint SPMD
+    executables with the paged KV pools head-sharded over ``axis``; when
+    the GQA head count doesn't divide the TP degree (``num_kv_heads % tp
+    != 0``) the engine falls back to sharding head_dim, so grouped-query
+    models still scale past their KV-head count (gated at TP=4 with
+    num_kv_heads=2 in tests/test_tp_serving.py)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..distributed.env import get_mesh
     mesh = mesh if mesh is not None else get_mesh()

@@ -387,19 +387,22 @@ class Monitor:
 
     def serve_engine(self, max_slots: int, max_len: int, buckets, quantize,
                      engine_id=None, paged=None, block_size=None,
-                     kv_blocks=None, prefill_chunk=None):
+                     kv_blocks=None, prefill_chunk=None, tp=1):
         """A DecodeEngine came up: record its static geometry (paged
-        engines add the block pool shape and the prefill chunk size)."""
+        engines add the block pool shape and the prefill chunk size; a
+        mesh-native engine carries its tensor-parallel degree)."""
         g = self.registry.gauge
         g("serve/max_slots").set(max_slots)
         g("serve/max_len").set(max_len)
         if kv_blocks:
             g("serve/kv_blocks").set(kv_blocks)
             g("serve/block_size").set(block_size or 0)
+        if tp and tp > 1:
+            g("serve/tp").set(tp)
         self.emit("serve_engine", max_slots=max_slots, max_len=max_len,
                   prefill_buckets=list(buckets), quantize=quantize,
                   engine=engine_id, paged=paged, block_size=block_size,
-                  kv_blocks=kv_blocks, prefill_chunk=prefill_chunk)
+                  kv_blocks=kv_blocks, prefill_chunk=prefill_chunk, tp=tp)
 
     def serve_compiled(self, kind: str, bucket, compile_s: float, count: int,
                        engine_id=None):
@@ -476,6 +479,14 @@ class Monitor:
         g("serve/sharing_ratio").set(
             pager_stats.block_refs / pager_stats.blocks_used
             if pager_stats.blocks_used else 1.0)
+        # persistent prefix cache: parked-block occupancy + cumulative
+        # cross-request adoption wins (metrics_summary's 0%-hit-with-
+        # repeats WARN reads these alongside shared_hits)
+        g("serve/lru_blocks").set(pager_stats.lru_blocks)
+        g("serve/prefix_hits").set(pager_stats.prefix_hits)
+        g("serve/prefix_hit_tokens").set(pager_stats.prefix_hit_tokens)
+        g("serve/prefix_repeats").set(pager_stats.prefix_repeats)
+        g("serve/shared_hits").set(pager_stats.shared_hits)
 
     def serve_admitted(self, ttft_s: float, bucket: int, prefill_s: float):
         """A request's prefill folded into a free slot; its first token is

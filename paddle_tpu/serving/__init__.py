@@ -8,6 +8,10 @@ decode step over a preallocated slotted KV cache (zero recompiles under
 any admission/eviction pattern) plus bucketed prefill, scheduled at
 iteration granularity (Orca) so short and long requests share the batch
 without padding each other out (vLLM-style slot paging on the batch axis).
+Under a "model"-axis mesh with a sharded model the executables go SPMD
+(tensor-parallel decode: KV pools head-sharded, page table replicated),
+and a persistent LRU prefix cache parks refcount-0 prompt blocks so
+repeated system prompts prefill once per process, not once per burst.
 
     from paddle_tpu.serving import DecodeEngine
     eng = DecodeEngine(model, max_slots=16, max_len=1024)

@@ -34,6 +34,22 @@ executable and no extra dispatch. Executable families:
 writing the K/V block at the slot row) — the control arm the paged
 microbenches gate against.
 
+**Tensor-parallel decode**: when ``distributed.env.get_mesh()`` has a
+"model" axis of degree > 1 AND the model rides it (shard_gpt_tp /
+shard_llama_tp / mp_layers), the same executables mint as SPMD programs —
+each KV pool placed ``NamedSharding(mesh, P(None, None, "model", None))``
+(head-sharded; head_dim fallback when GQA's ``n_kv % tp != 0``), weights
+on their Column/RowParallel placements, and the block table / cursors /
+token ids / COW pairs committed mesh-REPLICATED host data, so the
+``BlockPager`` never learns about the mesh and the zero-recompile
+contract survives block churn on it. ``paged=False`` refuses a sharded
+model (the row cache is single-chip by design).
+
+The pager's **persistent prefix cache** outlives tenants: registered
+prompt blocks park in an LRU at refcount zero and later same-prefix
+requests re-adopt them with zero prefill compute; the free list reclaims
+parked blocks (oldest first) before any live tenant is preempted.
+
 Pools/buffers are donated through every call so XLA updates them in place;
 steady-state decode allocates nothing. Stale K/V from a slot's previous
 tenant is harmless by construction: causal masking only exposes positions
@@ -55,12 +71,14 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import monitor as _monitor
 from ..monitor import trace as _trace
 from ..core.tensor import Tensor
+from ..distributed.env import get_mesh
 from ..models.gpt import (_lm_head_logits, _pick_token,
-                          _resolve_decode_horizon)
+                          _resolve_decode_horizon, set_paged_kv_sharding)
 from .pager import TRASH_BLOCK, BlockPager
 from .scheduler import AdmissionQueue, Request, SlotAllocator
 
@@ -71,6 +89,35 @@ __all__ = ["DecodeEngine", "Request", "generate_via_engine",
 ModelSpec = namedtuple("ModelSpec", [
     "backbone", "num_layers", "n_kv_heads", "head_dim", "max_pos",
     "head_weight", "head_transpose"])
+
+
+def _rides_model_axis(arr) -> bool:
+    """True when ``arr`` carries a NamedSharding partitioned over the
+    "model" mesh axis (the signal that someone ran shard_gpt_tp /
+    shard_llama_tp / the mp_layers on this model)."""
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return False
+    for part in sh.spec:
+        if part == "model" or (isinstance(part, (tuple, list))
+                               and "model" in part):
+            return True
+    return False
+
+
+def serving_mesh(leaves):
+    """The engine's tensor-parallel activation rule: the global mesh has a
+    "model" axis of degree > 1 AND the model actually rides it (at least
+    one param/buffer sharded over that axis). A replicated model on a
+    model-axis mesh stays single-chip — the mesh alone proves nothing
+    about THIS model (another test or tenant may have built it)."""
+    mesh = get_mesh()
+    if mesh is None or "model" not in mesh.axis_names \
+            or mesh.shape["model"] <= 1:
+        return None, 1
+    if not any(_rides_model_axis(t.value()) for t in leaves):
+        return None, 1
+    return mesh, int(mesh.shape["model"])
 
 
 def _model_spec(model) -> ModelSpec:
@@ -221,6 +268,74 @@ class DecodeEngine:
         self._leaves = [p for _, p in model.named_parameters()] \
             + [b for _, b in model.named_buffers()]
         self._cache_dtype = spec.head_weight.value().dtype
+        # ---- tensor-parallel decode over the device mesh: with a "model"
+        # axis of degree > 1 and a model riding it, the executables become
+        # SPMD programs — KV pools shard on the head axis (hd fallback for
+        # GQA counts the axis can't divide), weights keep their Column/
+        # RowParallel placements, and the block table / cursors / COW index
+        # arguments stay replicated host data (the BlockPager is untouched)
+        self._mesh, self._tp = serving_mesh(self._leaves)
+        if self._mesh is None:
+            # loud refusal beats a deep jit crash: a model sharded over a
+            # mesh the engine cannot drive (no "model" axis installed in
+            # distributed.env, or a custom axis name) would otherwise die
+            # at the first mint with "incompatible devices" and no hint
+            for name_t, t in zip(
+                    (n for n, _ in model.named_parameters()), self._leaves):
+                sh = getattr(t.value(), "sharding", None)
+                dset = getattr(sh, "device_set", None)
+                if dset is not None and len(dset) > 1:
+                    raise NotImplementedError(
+                        f"param {name_t!r} is sharded over {len(dset)} "
+                        f"devices but the engine found no usable mesh — "
+                        f"TP serving requires distributed.env.get_mesh() "
+                        f"to carry a \"model\" axis (degree > 1) and the "
+                        f"model to be sharded over THAT axis "
+                        f"(shard_gpt_tp / shard_llama_tp defaults)")
+        self._repl = None
+        self._pool_sh = None
+        self._kv_shard_ctx = None
+        self._kv_view_ctx = True
+        if self._mesh is not None:
+            if not self.paged:
+                raise NotImplementedError(
+                    "tensor-parallel serving requires paged=True (the row "
+                    "cache is single-chip; shard the paged pool's head "
+                    "axis instead)")
+            self._repl = NamedSharding(self._mesh, P())
+            if spec.n_kv_heads % self._tp == 0:
+                pool_spec = P(None, None, "model", None)
+            elif spec.head_dim % self._tp == 0:
+                # GQA fallback: fewer KV heads than chips — shard head_dim
+                pool_spec = P(None, None, None, "model")
+            else:
+                import warnings
+                warnings.warn(
+                    f"n_kv_heads {spec.n_kv_heads} and head_dim "
+                    f"{spec.head_dim} both indivisible by tp={self._tp}; "
+                    f"KV pools stay replicated (correct but each chip "
+                    f"holds the full pool)", RuntimeWarning)
+                pool_spec = P()
+            self._pool_sh = NamedSharding(self._mesh, pool_spec)
+            # mid-graph scatter/gather constraints only under HEAD sharding,
+            # where per-head attention consumes the layout unchanged. In the
+            # hd fallback the projections land nkv-and-hd split, so pinning
+            # the pool mid-graph forces XLA full-remat copies — there the
+            # committed input placement + pinned out_shardings alone keep
+            # the storage hd-sharded and the layout stable across calls
+            if pool_spec == P(None, None, "model", None):
+                self._kv_shard_ctx = self._pool_sh
+            self._kv_view_ctx = pool_spec == P(None, None, "model", None)
+            # commit every leaf that does not already live on THIS mesh to
+            # a mesh-replicated placement: AOT executables refuse inputs
+            # whose shardings drift from the compiled ones, and a single-
+            # device leaf next to mesh-sharded pools is exactly that drift
+            for t in self._leaves:
+                a = t.value()
+                sh = getattr(a, "sharding", None)
+                if isinstance(sh, NamedSharding) and sh.mesh == self._mesh:
+                    continue
+                t._data = jax.device_put(a, self._repl)
         if self.paged:
             if block_size < 1:
                 raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -239,14 +354,14 @@ class DecodeEngine:
                                  f"{self.max_len}], got {prefill_chunk}")
             self.prefill_chunk = None if prefill_chunk is None \
                 else int(prefill_chunk)
-            self._pools = [
-                (jnp.zeros((self.kv_blocks, self.block_size,
-                            spec.n_kv_heads, spec.head_dim),
-                           self._cache_dtype),
-                 jnp.zeros((self.kv_blocks, self.block_size,
-                            spec.n_kv_heads, spec.head_dim),
-                           self._cache_dtype))
-                for _ in range(spec.num_layers)]
+            def _pool():
+                z = jnp.zeros((self.kv_blocks, self.block_size,
+                               spec.n_kv_heads, spec.head_dim),
+                              self._cache_dtype)
+                return z if self._pool_sh is None \
+                    else jax.device_put(z, self._pool_sh)
+            self._pools = [(_pool(), _pool())
+                           for _ in range(spec.num_layers)]
             self._pager = BlockPager(self.kv_blocks, self.block_size,
                                      self.max_slots, self._mbs)
             self._caches = None
@@ -294,6 +409,9 @@ class DecodeEngine:
         self._prefill_exes = {}
         self._key = jax.random.PRNGKey(int(seed))
         self._greedy_key = jax.random.PRNGKey(0)   # unused by greedy pick
+        if self._repl is not None:
+            self._key = jax.device_put(self._key, self._repl)
+            self._greedy_key = jax.device_put(self._greedy_key, self._repl)
         # serving recompile sentinel (monitor-independent; tests gate on it)
         self.compile_count = 0
         self.decode_steps = 0
@@ -306,7 +424,7 @@ class DecodeEngine:
                              engine_id=self.engine_id, paged=self.paged,
                              block_size=self.block_size,
                              kv_blocks=self.kv_blocks,
-                             prefill_chunk=self.prefill_chunk)
+                             prefill_chunk=self.prefill_chunk, tp=self._tp)
 
     # ------------------------------------------------------------- tracing
 
@@ -340,25 +458,61 @@ class DecodeEngine:
     def _leaf_values(self):
         return tuple(t.value() for t in self._leaves)
 
+    def _dev(self, x):
+        """Host data -> device argument. Under a mesh, commit it REPLICATED
+        so the SPMD executables' compiled input shardings always match (the
+        block table, cursors, token ids and COW index pairs are rank-
+        replicated data by design — the pager never learns about the mesh).
+        """
+        a = jnp.asarray(x)
+        return a if self._repl is None else jax.device_put(a, self._repl)
+
     def _next_key(self):
         if not self._do_sample:
             return self._greedy_key
         self._key, sub = jax.random.split(self._key)
+        if self._repl is not None:
+            self._key = jax.device_put(self._key, self._repl)
+            sub = jax.device_put(sub, self._repl)
         return sub
 
-    def _compile_in_eval(self, fn, args):
+    def _compile_in_eval(self, fn, args, out_shardings=None):
         """Trace + AOT-compile with every layer in eval mode (serving
         semantics: dropout off), then restore each layer's OWN flag — an
-        engine must not flip a training model's mode as a side effect."""
+        engine must not flip a training model's mode as a side effect.
+        Under a mesh the paged-pool sharding constraint is installed for
+        the duration of the trace (``_paged_kv_update`` pins its scatter/
+        gather shard-local on the head axis) and ``out_shardings`` pins the
+        donated pools back to their input placement — without the pin,
+        XLA's propagation could hand back differently-laid pools and the
+        NEXT call's input shardings would no longer match the compiled
+        ones."""
         layers = self.model.sublayers(include_self=True)
         saved = [(l, l.training) for l in layers]
         for l in layers:
             l.training = False
+        prev_ctx = set_paged_kv_sharding(self._kv_shard_ctx,
+                                         self._kv_view_ctx) \
+            if self._mesh is not None else None
         try:
-            return jax.jit(fn, donate_argnums=(1,)).lower(*args).compile()
+            kw = dict(donate_argnums=(1,))
+            if out_shardings is not None:
+                kw["out_shardings"] = out_shardings
+            return jax.jit(fn, **kw).lower(*args).compile()
         finally:
+            if self._mesh is not None:
+                set_paged_kv_sharding(*prev_ctx)
             for l, f in saved:
                 l.training = f
+
+    def _pool_out_shardings(self):
+        """out_shardings pytree for (new_pools, picked_token) returns —
+        pools pinned to their (possibly head-sharded) input placement, the
+        token replicated. None off the mesh (single-chip: let jax infer)."""
+        if self._mesh is None:
+            return None
+        return ([(self._pool_sh, self._pool_sh)
+                 for _ in range(self.spec.num_layers)], self._repl)
 
     def _minted(self, kind: str, bucket, compile_s: float):
         self.compile_count += 1
@@ -395,10 +549,10 @@ class DecodeEngine:
                     return new_pools, nxt
                 return self._traced(leaves, body)
 
-            pad = jnp.zeros(self.max_slots, jnp.int32)
+            pad = self._dev(jnp.zeros(self.max_slots, jnp.int32))
             args = (self._leaf_values(), self._pools,
-                    jnp.asarray(self._pager.tables), jnp.asarray(self._tok),
-                    jnp.asarray(self._pos), pad, pad, self._greedy_key)
+                    self._dev(self._pager.tables), self._dev(self._tok),
+                    self._dev(self._pos), pad, pad, self._greedy_key)
         else:
             def fn(leaves, caches, tok, pos, key):
                 def body():
@@ -414,7 +568,9 @@ class DecodeEngine:
                     jnp.asarray(self._tok), jnp.asarray(self._pos),
                     self._greedy_key)
         t0 = time.time()
-        exe = self._compile_in_eval(fn, args)
+        exe = self._compile_in_eval(fn, args,
+                                    out_shardings=self._pool_out_shardings()
+                                    if self.paged else None)
         self._decode_exe = exe
         self._minted("decode", None, time.time() - t0)
         return exe
@@ -446,13 +602,15 @@ class DecodeEngine:
                 return new_pools, tok0[0]
             return self._traced(leaves, body)
 
-        pad = jnp.zeros(self.max_slots, jnp.int32)
+        pad = self._dev(jnp.zeros(self.max_slots, jnp.int32))
         args = (self._leaf_values(), self._pools,
-                jnp.asarray(self._pager.tables),
-                jnp.zeros((1, sc), jnp.int32), jnp.int32(0), jnp.int32(0),
-                jnp.int32(1), pad, pad, self._greedy_key)
+                self._dev(self._pager.tables),
+                self._dev(jnp.zeros((1, sc), jnp.int32)),
+                self._dev(jnp.int32(0)), self._dev(jnp.int32(0)),
+                self._dev(jnp.int32(1)), pad, pad, self._greedy_key)
         t0 = time.time()
-        exe = self._compile_in_eval(fn, args)
+        exe = self._compile_in_eval(fn, args,
+                                    out_shardings=self._pool_out_shardings())
         self._prefill_exes[sc] = exe
         self._minted("prefill", sc, time.time() - t0)
         return exe
@@ -647,7 +805,7 @@ class DecodeEngine:
         dst = np.zeros(self.max_slots, np.int32)
         for i, (s, d) in enumerate(copies):
             src[i], dst[i] = s, d
-        return jnp.asarray(src), jnp.asarray(dst)
+        return self._dev(src), self._dev(dst)
 
     def _try_admit_paged(self, req: Request) -> bool:
         """Assign a slot, adopt any shared prompt prefix, and reserve the
@@ -658,13 +816,23 @@ class DecodeEngine:
         flaggable downstream)."""
         n = len(req.prompt)
         slot = self._slots.alloc()
+        # the head-of-line request retries this path EVERY step while it
+        # waits for blocks: snapshot the pager's sharing counters so a
+        # refused attempt leaves them untouched (a 100-step wait must not
+        # inflate prefix_hits by 100 — bench's hit rate and the summary's
+        # hits/admissions figure read these as per-ADMISSION counts)
+        ctrs = self._pager.sharing_counters()
         cov = self._pager.share_prefix(slot, req.prompt)
         end = min(cov + self._chunk_len(n), n)
         copies = self._pager.ensure_writable(slot, cov, end)
         if copies is None:
             needed = self._pager.blocks_needed(slot, cov, end)
-            free = self._pager.free_blocks
+            # a refusal is only real saturation when free-list AND parked
+            # prefix-cache blocks together could not cover the need — the
+            # allocator reclaims from the LRU before ever refusing
+            free = self._pager.reclaimable_blocks
             self._pager.release_slot(slot)
+            self._pager.restore_sharing_counters(ctrs)
             self._slots.release(slot)
             mon = _monitor._active
             if mon is not None:
@@ -692,6 +860,11 @@ class DecodeEngine:
             if req._phase is not None:
                 req._phase.set(slot=slot)
             ph = req._trace_phase("prefill", slot=slot, shared=int(cov))
+            if self._pager.last_adopt_parked:
+                # blocks revived from the persistent prefix cache: this
+                # admission's prefill compute shrank by lru_hit_tokens
+                ph.set(lru_hit_blocks=self._pager.last_adopt_parked,
+                       lru_hit_tokens=self._pager.last_adopt_parked_tokens)
             if copies:
                 ph.event("cow", n=len(copies))
         return True
@@ -719,9 +892,9 @@ class DecodeEngine:
         t0 = time.time()
         self._pools, tok0 = exe(
             self._leaf_values(), self._pools,
-            jnp.asarray(self._pager.tables), jnp.asarray(ids),
-            jnp.int32(slot), jnp.int32(p0), jnp.int32(end), src, dst,
-            self._next_key())
+            self._dev(self._pager.tables), self._dev(ids),
+            self._dev(jnp.int32(slot)), self._dev(jnp.int32(p0)),
+            self._dev(jnp.int32(end)), src, dst, self._next_key())
         chunk_s = time.time() - t0
         st.prefill_s += chunk_s
         st.done = end
@@ -736,6 +909,7 @@ class DecodeEngine:
         self._pager.register_prompt(slot, st.prompt)
         del self._prefilling[slot]
         t = int(tok0)
+        req.prefill_chunks = st.chunks     # counted by the prefix-cache gate
         req.status = "running"
         req.t_first_token = time.time()
         req.tokens.append(t)
@@ -896,8 +1070,8 @@ class DecodeEngine:
             t0 = time.time()
             self._pools, nxt = exe(
                 self._leaf_values(), self._pools,
-                jnp.asarray(self._pager.tables), jnp.asarray(self._tok),
-                jnp.asarray(self._pos), src, dst, self._next_key())
+                self._dev(self._pager.tables), self._dev(self._tok),
+                self._dev(self._pos), src, dst, self._next_key())
         else:
             t0 = time.time()
             self._caches, nxt = exe(
@@ -1021,12 +1195,22 @@ def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
     quant = any(str(bf.value().dtype) == "int8"
                 for _, bf in lm.named_buffers())
     engines = lm.__dict__.setdefault("_serving_engines", {})
+    # the key carries the EFFECTIVE tensor-parallel degree and the chunk
+    # size: a mesh appearing (or the model being sharded onto it) after
+    # first use must mint a mesh-native engine — the cached single-chip
+    # one rebinds the same leaf OBJECTS, so the leaf-identity check below
+    # cannot catch a placement-only change and would serve executables
+    # whose compiled input shardings no longer match the arrays
+    leaves_now = [p for _, p in lm.named_parameters()] \
+        + [bf for _, bf in lm.named_buffers()]
+    _, tp = serving_mesh(leaves_now)
+    chunk = min(32, ml)
     key = (slots, ml, quant, do_sample,
-           (float(temperature), int(top_k)) if do_sample else None)
+           (float(temperature), int(top_k)) if do_sample else None,
+           tp, chunk)
     engine = engines.get(key)
     if engine is not None:
-        cur = [p for _, p in lm.named_parameters()] \
-            + [bf for _, bf in lm.named_buffers()]
+        cur = leaves_now
         if len(cur) != len(engine._leaves) or any(
                 a is not b for a, b in zip(cur, engine._leaves)):
             # the model's layer structure changed under the cached engine
@@ -1039,7 +1223,7 @@ def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,
         if len(engines) >= 4:
             engines.pop(next(iter(engines)))
         engine = DecodeEngine(lm, max_slots=slots, max_len=ml, paged=True,
-                              prefill_chunk=min(32, ml),
+                              prefill_chunk=chunk,
                               do_sample=do_sample, temperature=temperature,
                               top_k=top_k, seed=seed)
         engines[key] = engine

@@ -14,9 +14,9 @@ Mechanics:
   TRASH block (dead slots' decode writes and padded chunk-tail writes are
   redirected there by the executables, so the allocator never hands it out).
 * **refcounts** — a block may back several slots at once (shared prompt
-  prefix). A slot finishing decrements; at zero the block returns to the
-  free list and its prefix registration is dropped (sharing is therefore
-  scoped to CONCURRENT requests — there is no persistent prefix cache).
+  prefix). A slot finishing decrements; at zero an UNREGISTERED block
+  returns to the free list, while a registered prompt block PARKS in the
+  persistent prefix cache (below) so its K/V outlives the tenant.
 * **prefix registry** — when a slot's prefill completes, each of its prompt
   blocks is registered under the exact token prefix it covers
   (``tuple(tokens[:k*bs])`` per full block, ``tuple(tokens[:n])`` for the
@@ -24,16 +24,29 @@ Mechanics:
   match, capped at ``n-1`` tokens — the last prompt token is always
   recomputed because the FIRST GENERATED token needs its hidden state,
   which is not cached (only K/V is).
+* **persistent prefix cache (LRU)** — registered blocks whose refcount hits
+  zero do NOT free: they park in an LRU keyed by their registry hash, so a
+  later request with the same prefix re-adopts them (refcount 0 -> 1, zero
+  prefill compute — a repeated system prompt prefills once per PROCESS, not
+  once per burst). The free list reclaims from the LRU's least-recently-
+  used end only on exhaustion — so reclamation always beats preempting a
+  live tenant — and a re-adopted block returns to the MRU end when it next
+  parks. Cumulative ``prefix_hits``/``prefix_hit_tokens`` count cross-
+  request adoptions (distinct from ``shared_hits``, which also counts
+  co-resident sharing of live blocks).
 * **copy-on-write** — writes only ever land at a slot's cursor, so shared
   FULL blocks are naturally read-only; the one writable shared case is the
   partial tail block (or a fully-shared final block under the n-1 cap).
   ``ensure_writable`` detects refcount > 1 at the write target, moves the
   slot onto a fresh block and reports the (src, dst) pair — the engine
   folds the device-side block copy into the next executable call as data
-  arguments (no dedicated copy executable, no extra dispatch).
+  arguments (no dedicated copy executable, no extra dispatch). A parked
+  block adopted by TWO tenants is ref >= 2 like any live share, so COW
+  still copies instead of mutating the cached original.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,13 +55,19 @@ __all__ = ["BlockPager", "PagerStats"]
 
 TRASH_BLOCK = 0
 
+# bound on the shadow set share_prefix uses to notice REPEATED prefixes
+# independently of the adoption walk (the 0%-hit-rate-with-repeats WARN in
+# tools/metrics_summary.py needs a signal the bug it flags cannot also break)
+_SEEN_PREFIX_CAP = 4096
+
 
 class PagerStats:
     """Point-in-time allocator view (engine surfaces it via stats())."""
 
     __slots__ = ("blocks_total", "blocks_free", "blocks_used",
                  "blocks_shared", "block_refs", "cow_copies", "shared_hits",
-                 "shared_tokens")
+                 "shared_tokens", "lru_blocks", "prefix_hits",
+                 "prefix_hit_tokens", "prefix_repeats")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -64,10 +83,17 @@ class BlockPager:
     ``tables`` is the authoritative host copy of the device block table:
     ``[max_slots, max_blocks_per_slot]`` int32, row zeroed for free slots
     (entry 0 == TRASH_BLOCK, never a real allocation).
+
+    Every physical block is in exactly ONE of three states: on the free
+    list (ref 0, unregistered), parked in the prefix-cache LRU (ref 0,
+    registered), or owned (ref >= 1, referenced by that many slot-table
+    entries). ``check_invariants`` asserts the partition — the randomized
+    property test drives it through ~1k-op alloc/share/COW/free/preempt/
+    park/adopt sequences.
     """
 
     def __init__(self, num_blocks: int, block_size: int, max_slots: int,
-                 blocks_per_slot: int):
+                 blocks_per_slot: int, persistent_prefixes: bool = True):
         if num_blocks < 2:
             raise ValueError(f"kv_blocks must be >= 2 (block 0 is the trash "
                              f"block), got {num_blocks}")
@@ -75,6 +101,7 @@ class BlockPager:
         self.block_size = int(block_size)
         self.max_slots = int(max_slots)
         self.blocks_per_slot = int(blocks_per_slot)
+        self.persistent_prefixes = bool(persistent_prefixes)
         self.tables = np.zeros((max_slots, blocks_per_slot), np.int32)
         # LIFO free list: recently freed blocks are re-handed first
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
@@ -82,10 +109,22 @@ class BlockPager:
         # exact-prefix registry: tuple(prompt_tokens[:k]) -> physical block
         self._registry: Dict[tuple, int] = {}
         self._block_key: Dict[int, tuple] = {}
+        # persistent prefix cache: parked block -> registry key, insertion
+        # order == recency (left end is the reclamation tail, right is MRU)
+        self._lru: "OrderedDict[int, tuple]" = OrderedDict()
+        # first-block keys ever registered (bounded): the repeat detector
+        self._seen_first: "OrderedDict[tuple, None]" = OrderedDict()
+        # per-admission scratch the engine reads right after share_prefix
+        self.last_adopt_parked = 0
+        self.last_adopt_parked_tokens = 0
         # cumulative telemetry (monitor gauges/counters read these)
         self.cow_copies = 0
         self.shared_hits = 0          # admissions that adopted >= 1 block
         self.shared_tokens = 0        # prompt tokens served from shared blocks
+        self.prefix_hits = 0          # admissions that adopted >= 1 PARKED block
+        self.prefix_hit_tokens = 0    # prompt tokens revived from the LRU
+        self.prefix_repeats = 0       # admissions whose first-block key repeated
+        self.lru_reclaims = 0         # parked blocks cannibalized on exhaustion
 
     # ------------------------------------------------------------ accounting
 
@@ -98,8 +137,18 @@ class BlockPager:
         return len(self._free)
 
     @property
+    def lru_blocks(self) -> int:
+        return len(self._lru)
+
+    @property
+    def reclaimable_blocks(self) -> int:
+        """Blocks an allocation could obtain without preempting anyone:
+        free list + parked prefix-cache blocks (reclaimed tail-first)."""
+        return len(self._free) + len(self._lru)
+
+    @property
     def blocks_used(self) -> int:
-        return self.usable_blocks - len(self._free)
+        return self.usable_blocks - len(self._free) - len(self._lru)
 
     def stats(self) -> PagerStats:
         used = self._ref > 0
@@ -109,25 +158,90 @@ class BlockPager:
             blocks_shared=int((self._ref > 1).sum()),
             block_refs=int(self._ref[used].sum()),
             cow_copies=self.cow_copies, shared_hits=self.shared_hits,
-            shared_tokens=self.shared_tokens)
+            shared_tokens=self.shared_tokens, lru_blocks=self.lru_blocks,
+            prefix_hits=self.prefix_hits,
+            prefix_hit_tokens=self.prefix_hit_tokens,
+            prefix_repeats=self.prefix_repeats)
+
+    def sharing_counters(self) -> tuple:
+        """Snapshot of the per-admission sharing/prefix counters — the
+        engine takes one before a speculative admission attempt and
+        restores it when the pool refuses, so a blocked head-of-line
+        request retried every step cannot inflate hit rates. (The LRU
+        recency touch of a refused adoption is NOT rolled back: a prefix
+        a waiting request keeps reaching for is hot by definition.)"""
+        return (self.shared_hits, self.shared_tokens, self.prefix_hits,
+                self.prefix_hit_tokens, self.prefix_repeats)
+
+    def restore_sharing_counters(self, snap: tuple):
+        (self.shared_hits, self.shared_tokens, self.prefix_hits,
+         self.prefix_hit_tokens, self.prefix_repeats) = snap
+
+    def check_invariants(self):
+        """Assert the three-state partition and refcount/registry health
+        (test harness hook; O(blocks + table))."""
+        free = set(self._free)
+        parked = set(self._lru)
+        owned = {b for b in range(1, self.num_blocks) if self._ref[b] > 0}
+        assert TRASH_BLOCK not in free and TRASH_BLOCK not in parked
+        assert not (free & parked) and not (free & owned) \
+            and not (parked & owned), "block in two states at once"
+        assert len(free) + len(parked) + len(owned) == self.usable_blocks, \
+            "pool blocks leaked or double-counted"
+        # refcounts match the number of table references, exactly
+        counts = np.bincount(self.tables.ravel(),
+                             minlength=self.num_blocks)
+        counts[TRASH_BLOCK] = 0
+        assert (counts == self._ref).all(), \
+            f"refcounts {self._ref.tolist()} != table refs {counts.tolist()}"
+        # free blocks carry no registration; parked blocks carry exactly one
+        for b in free:
+            assert b not in self._block_key, f"free block {b} registered"
+        for b, key in self._lru.items():
+            assert self._block_key.get(b) == key \
+                and self._registry.get(key) == b, f"parked block {b} torn"
+        # registry <-> block_key is a bijection over registered blocks
+        assert len(self._registry) == len(self._block_key)
+        for key, b in self._registry.items():
+            assert self._block_key.get(b) == key
+        assert TRASH_BLOCK not in self._block_key
 
     # ------------------------------------------------------------ allocation
 
     def _alloc_block(self) -> Optional[int]:
-        if not self._free:
+        if self._free:
+            blk = self._free.pop()
+        elif self._lru:
+            # exhaustion: cannibalize the LEAST-recently-used parked prefix
+            # block — reclamation always beats preempting a live tenant
+            blk, key = self._lru.popitem(last=False)
+            self._unregister(blk)
+            self.lru_reclaims += 1
+        else:
             return None
-        blk = self._free.pop()
         self._ref[blk] = 1
         return blk
+
+    def _unregister(self, blk: int):
+        key = self._block_key.pop(blk, None)
+        if key is not None and self._registry.get(key) == blk:
+            del self._registry[key]
 
     def _decref(self, blk: int):
         assert blk != TRASH_BLOCK and self._ref[blk] > 0
         self._ref[blk] -= 1
         if self._ref[blk] == 0:
-            key = self._block_key.pop(blk, None)
-            if key is not None and self._registry.get(key) == blk:
-                del self._registry[key]
-            self._free.append(blk)
+            key = self._block_key.get(blk)
+            if key is not None and self.persistent_prefixes \
+                    and self._registry.get(key) == blk:
+                # park instead of free: the prefix cache holds the K/V for
+                # the next same-prefix request; MRU end (freshest survives
+                # reclamation longest)
+                self._lru[blk] = key
+                self._lru.move_to_end(blk)
+            else:
+                self._unregister(blk)
+                self._free.append(blk)
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks covering ``n_tokens`` cached positions."""
@@ -151,8 +265,9 @@ class BlockPager:
         ``slot`` privately owned and present: allocate missing blocks,
         copy-on-write shared ones. Returns the (src, dst) device copies the
         caller must fold into its next executable call, or None when the
-        pool cannot satisfy the request (caller evicts or defers — the
-        table is left exactly as it was)."""
+        pool cannot satisfy the request EVEN after reclaiming parked
+        prefix-cache blocks (caller evicts or defers — the table is left
+        exactly as it was)."""
         copies: List[Tuple[int, int]] = []
         taken: List[Tuple[int, Optional[int]]] = []   # (lidx, old) rollback
         for lidx in range(start_pos // self.block_size,
@@ -167,6 +282,8 @@ class BlockPager:
                 for l2, old in reversed(taken):
                     self._decref(int(self.tables[slot, l2]))
                     if old is not None:
+                        if self._ref[old] == 0:     # parked mid-call: revive
+                            self._lru.pop(old, None)
                         self._ref[old] += 1
                         self.tables[slot, l2] = old
                     else:
@@ -186,21 +303,26 @@ class BlockPager:
 
     def share_prefix(self, slot: int, tokens: Sequence[int]) -> int:
         """Adopt the longest registered prefix of ``tokens`` into ``slot``'s
-        table (increments refcounts) and return how many prompt positions
-        are now served from shared blocks. Capped at ``len(tokens) - 1``:
-        the final prompt token is always recomputed (its hidden state feeds
-        the first generated token and only K/V is cached)."""
+        table (increments refcounts, revives parked blocks) and return how
+        many prompt positions are now served from shared blocks. Capped at
+        ``len(tokens) - 1``: the final prompt token is always recomputed
+        (its hidden state feeds the first generated token and only K/V is
+        cached). ``last_adopt_parked``/``last_adopt_parked_tokens`` report
+        this call's LRU revivals (the engine reads them for telemetry)."""
         toks = tuple(int(t) for t in tokens)
         n = len(toks)
         bs = self.block_size
-        chain: List[int] = []
+        first_key = toks[:bs] if n > bs else toks
+        if first_key in self._seen_first:
+            self.prefix_repeats += 1
+        chain: List[Tuple[int, int]] = []   # (block, coverage after adopting)
         cov = 0
         i = 1
         while i * bs < n:                 # strictly < n: keep >= 1 to process
             blk = self._registry.get(toks[:i * bs])
             if blk is None:
                 break
-            chain.append(blk)
+            chain.append((blk, i * bs))
             cov = i * bs
             i += 1
         # exact-prompt tail block (partial, or the final full block of an
@@ -209,16 +331,28 @@ class BlockPager:
         # copy-on-writes this block
         if cov < n - 1 and len(chain) == (n - 1) // bs:
             blk = self._registry.get(toks)
-            if blk is not None and blk not in chain:
-                chain.append(blk)
+            if blk is not None and blk not in (b for b, _ in chain):
+                chain.append((blk, n - 1))
                 cov = n - 1
         cov = min(cov, n - 1)
-        for lidx, blk in enumerate(chain):
+        self.last_adopt_parked = 0
+        self.last_adopt_parked_tokens = 0
+        prev_cov = 0
+        for lidx, (blk, cov_after) in enumerate(chain):
+            if self._ref[blk] == 0:       # parked: revive from the LRU
+                self._lru.pop(blk, None)
+                self.last_adopt_parked += 1
+                self.last_adopt_parked_tokens += \
+                    min(cov_after, cov) - prev_cov
             self._ref[blk] += 1
             self.tables[slot, lidx] = blk
+            prev_cov = min(cov_after, cov)
         if chain:
             self.shared_hits += 1
             self.shared_tokens += cov
+        if self.last_adopt_parked:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += self.last_adopt_parked_tokens
         return cov
 
     def register_prompt(self, slot: int, tokens: Sequence[int]):
@@ -229,6 +363,11 @@ class BlockPager:
         toks = tuple(int(t) for t in tokens)
         n = len(toks)
         bs = self.block_size
+        first_key = toks[:bs] if n > bs else toks
+        self._seen_first[first_key] = None
+        self._seen_first.move_to_end(first_key)
+        while len(self._seen_first) > _SEEN_PREFIX_CAP:
+            self._seen_first.popitem(last=False)
         bounds = [k * bs for k in range(1, n // bs + 1)]
         if n % bs:
             bounds.append(n)
@@ -246,9 +385,21 @@ class BlockPager:
 
     def release_slot(self, slot: int):
         """Return every block ``slot`` references (finish or eviction);
-        shared blocks survive while other slots still hold them."""
+        shared blocks survive while other slots still hold them, registered
+        blocks park in the prefix-cache LRU at refcount zero."""
         for lidx in range(self.blocks_per_slot):
             blk = int(self.tables[slot, lidx])
             if blk != TRASH_BLOCK:
                 self._decref(blk)
         self.tables[slot, :] = TRASH_BLOCK
+
+    def drop_prefix_cache(self) -> int:
+        """Flush every parked block back to the free list (operator hook:
+        weight swap / tokenizer change invalidates cached K/V). Returns how
+        many blocks were released."""
+        n = len(self._lru)
+        while self._lru:
+            blk, _ = self._lru.popitem(last=False)
+            self._unregister(blk)
+            self._free.append(blk)
+        return n

@@ -42,6 +42,10 @@ class Request:
         self.error: Optional[str] = None
         self.slot: Optional[int] = None
         self.preemptions = 0             # pool-pressure evictions survived
+        # chunk-executable calls the (final) prefill took: the counted
+        # signal the prefix-cache gate reads — a request whose prompt was
+        # served from parked blocks prefills only the uncovered remainder
+        self.prefill_chunks = 0
         self.t_submit = time.time()
         # when the request last entered the queue: t_submit at first, reset
         # on a preemption re-queue — serve/queue_wait_s measures from HERE,

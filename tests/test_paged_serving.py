@@ -179,8 +179,11 @@ def test_cow_isolation_cross_tenant(engine, tiny):
 
 def test_refcounts_survive_finish_evict_churn(tiny):
     """Interleaved finish/evict churn over a tight pool: refcounts must
-    come back to zero, the free list to full, and the prefix registry to
-    empty — no leaked or double-freed block, ever."""
+    come back to zero and every block must land in exactly one of
+    {free list, prefix-cache LRU} — no leaked or double-freed block, ever.
+    (Registered prompt blocks PARK at refcount zero instead of freeing:
+    the persistent prefix cache. The registry holds exactly the parked
+    blocks once no tenant is live.)"""
     eng = DecodeEngine(tiny, max_slots=4, max_len=48, block_size=8,
                        kv_blocks=9, prefill_chunk=8)   # 8 usable blocks
     rng = np.random.RandomState(4)
@@ -192,9 +195,15 @@ def test_refcounts_survive_finish_evict_churn(tiny):
     assert all(r.status == "done" for r in reqs)
     assert eng.preemptions > 0, "pool was sized to force eviction churn"
     pg = eng._pager
-    assert pg.free_blocks == pg.usable_blocks
+    assert pg.free_blocks + pg.lru_blocks == pg.usable_blocks
     assert (pg._ref == 0).all()
-    assert not pg._registry and not pg._block_key
+    assert set(pg._block_key) == set(pg._lru)   # registry == parked blocks
+    pg.check_invariants()
+    # the operator flush returns every parked block to the free list
+    parked = pg.lru_blocks
+    assert pg.drop_prefix_cache() == parked
+    assert pg.free_blocks == pg.usable_blocks
+    assert not pg._registry and not pg._block_key and not pg._lru
     # parity survived the churn (recompute-style preemption is lossless)
     for r in reqs:
         np.testing.assert_array_equal(
@@ -300,12 +309,16 @@ class TestBlockPager:
         assert len(copies) == 1 and pg.cow_copies == 1
         assert pg.free_blocks == 4                # the COW took a fresh block
         pg.release_slot(0)
-        # slot 0's private tail (COW left it sole owner) freed; the two
-        # full prefix blocks survive on slot 1's refs
-        assert pg.free_blocks == 5
+        # slot 0's tail (COW left it sole owner) PARKS — it is registered
+        # under the exact-prompt key; the two full prefix blocks survive on
+        # slot 1's refs
+        assert pg.free_blocks == 4 and pg.lru_blocks == 1
         pg.release_slot(1)
-        assert pg.free_blocks == 8
-        assert not pg._registry and not pg._block_key
+        # every registered block parks in the prefix cache; slot 1's COW
+        # tail is unregistered (first registration won) so it frees
+        assert pg.free_blocks + pg.lru_blocks == 8
+        assert set(pg._block_key) == set(pg._lru)
+        pg.check_invariants()
 
     def test_ensure_rolls_back_on_exhaustion(self):
         pg = BlockPager(4, 8, 2, 3)               # 3 usable blocks

@@ -1,0 +1,363 @@
+"""Persistent cross-request prefix cache (ISSUE 13): LRU over refcount-0
+registered blocks in the BlockPager.
+
+The contract under test:
+  * Counted tier-1 gate: N bursts of the same system prompt from distinct
+    NON-co-resident requests prefill the shared prefix exactly once — the
+    other N-1 adopt parked blocks (refcount 0 -> 1, no prefill compute),
+    and ``serve/prefix_hits`` accounts for them.
+  * Pool exhaustion reclaims LRU blocks (least-recently-used first) before
+    preempting a live tenant.
+  * Mixed-tenant ordering: tail-first reclamation, an adopted (hit) block
+    re-parks at MRU, and a COW against an LRU-adopted shared block copies
+    instead of mutating the cached original.
+  * Randomized ~1k-op property test: every block is in exactly one of
+    {free, LRU, owned}, refcounts equal table reference counts, the trash
+    block is never registered or parked, and pool blocks are conserved.
+  * tools/metrics_summary.py prints the hit rate / LRU occupancy and WARNs
+    on the 0%-hit-with-repeats adoption-bug signature.
+"""
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import BlockPager, DecodeEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_gpt(seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _eager(m, prompt, n):
+    ids = np.asarray([prompt], np.int32)
+    return m.generate(paddle.to_tensor(ids),
+                      max_new_tokens=n).numpy()[0, len(prompt):]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_gpt()
+
+
+# ---------------------------------------------------- the counted tier-1 gate
+
+
+def test_repeated_system_prompt_prefills_once(tiny, tmp_path):
+    """N=4 bursts of the same 16-token system prompt, each burst a single
+    request run to completion before the next arrives (non-co-resident).
+    Burst 1 prefills the whole prompt (3 chunk calls at chunk=8); bursts
+    2..4 adopt the parked prefix blocks and prefill ONLY the uncovered
+    remainder (1 chunk call each). serve/prefix_hits == 3, and greedy
+    parity with the eager loop holds for every burst."""
+    path = str(tmp_path / "burst.jsonl")
+    monitor.enable(path)
+    try:
+        eng = DecodeEngine(tiny, max_slots=2, max_len=48, block_size=8,
+                           prefill_chunk=8)
+        rng = np.random.RandomState(0)
+        sys_prompt = rng.randint(1, 64, 16).tolist()
+        reqs = []
+        for i in range(4):
+            prompt = sys_prompt + [40 + i, 50 + i, 60 + i]    # 19 tokens
+            r = eng.submit(prompt, max_new_tokens=4)
+            eng.run()                      # burst drains: non-co-resident
+            assert r.status == "done"
+            reqs.append(r)
+        # the shared prefix was prefilled exactly once: burst 1 took
+        # ceil(19/8)=3 chunk calls, every later burst covered 16 of its 19
+        # tokens from parked blocks and took exactly 1
+        assert reqs[0].prefill_chunks == 3
+        assert [r.prefill_chunks for r in reqs[1:]] == [1, 1, 1]
+        st = eng.stats()["paged"]
+        assert st["prefix_hits"] == 3, st
+        assert st["prefix_hit_tokens"] == 3 * 16, st
+        snap = monitor.snapshot()
+        assert snap["gauges"]["serve/prefix_hits"] == 3
+        assert snap["gauges"]["serve/prefix_hit_tokens"] == 48
+        assert snap["gauges"]["serve/lru_blocks"] > 0
+        # parity: adoption changed the compute, never the tokens
+        for i, r in enumerate(reqs):
+            exp = _eager(tiny, sys_prompt + [40 + i, 50 + i, 60 + i], 4)
+            np.testing.assert_array_equal(exp, r.output_tokens)
+    finally:
+        monitor.disable()
+
+
+def test_exhaustion_reclaims_lru_before_preempting(tiny):
+    """Fill the LRU with parked prefixes, then admit a request the free
+    list alone cannot host: the allocator cannibalizes parked blocks
+    (oldest first) and NEVER preempts the live tenant."""
+    eng = DecodeEngine(tiny, max_slots=2, max_len=48, block_size=8,
+                       kv_blocks=9, prefill_chunk=8)    # 8 usable blocks
+    rng = np.random.RandomState(1)
+    for i in range(2):                     # park two 3-block prompts
+        r = eng.submit(rng.randint(1, 64, 19).tolist(), max_new_tokens=2)
+        eng.run()
+        assert r.status == "done"
+    pg = eng._pager
+    parked_before = pg.lru_blocks
+    assert parked_before == 6 and pg.free_blocks == 2
+    # a live tenant plus a 5-block request: needs reclamation, not eviction
+    live = eng.submit(rng.randint(1, 64, 10).tolist(), max_new_tokens=24)
+    while live.status != "running":
+        eng.step()
+    big = eng.submit(rng.randint(1, 64, 30).tolist(), max_new_tokens=8)
+    eng.run(max_steps=200)
+    assert big.status == "done" and live.status == "done"
+    assert eng.preemptions == 0, \
+        "preempted a live tenant while parked LRU blocks were reclaimable"
+    assert pg.lru_reclaims > 0
+    pg.check_invariants()
+
+
+def test_blocked_headofline_retry_does_not_inflate_hit_counters(tiny):
+    """A head-of-line request waiting for blocks retries its admission
+    every step; each attempt adopts the parked prefix and is rolled back.
+    The sharing/prefix counters must count ADMISSIONS, not attempts — a
+    40-step wait must not report 40 prefix hits (regression: bench's
+    prefix_hit_rate and metrics_summary's hits/admissions read these)."""
+    eng = DecodeEngine(tiny, max_slots=2, max_len=48, block_size=8,
+                       kv_blocks=9, prefill_chunk=8)    # 8 usable
+    rng = np.random.RandomState(5)
+    a = rng.randint(1, 64, 16).tolist()    # 2 full blocks, both registered
+    r0 = eng.submit(a, max_new_tokens=2)
+    eng.run()
+    assert r0.status == "done"
+    pg = eng._pager
+    assert pg.lru_blocks == 2 and pg.prefix_hits == 0
+    # simulate a tenant pinning every free block (slot 1 — the engine's
+    # allocator hands out slot 0 first, so no collision)
+    assert pg.ensure_writable(1, 0, 48) is not None
+    assert pg.free_blocks == 0
+    blocked = eng.submit(a + rng.randint(1, 64, 20).tolist(),
+                         max_new_tokens=4)
+    for _ in range(40):
+        eng.step()                 # admit attempt: adopt -> refuse -> undo
+    assert blocked.status == "queued"
+    assert pg.prefix_hits == 0 and pg.shared_hits == 0 and \
+        pg.prefix_repeats == 0, \
+        (pg.prefix_hits, pg.shared_hits, pg.prefix_repeats)
+    pg.check_invariants()
+    pg.release_slot(1)             # the tenant leaves; the wait ends
+    eng.run(max_steps=300)
+    assert blocked.status == "done"
+    assert pg.prefix_hits == 1 and pg.prefix_repeats == 1  # ONE admission
+    pg.check_invariants()
+
+
+# ------------------------------------------------- satellite: LRU ordering
+
+
+class TestLRUOrdering:
+    def _park_prompt(self, pg, slot, toks):
+        """Simulate one tenant's lifecycle: alloc, register, release."""
+        assert pg.ensure_writable(slot, 0, len(toks)) is not None
+        pg.register_prompt(slot, toks)
+        blocks = [int(b) for b in pg.tables[slot] if b]
+        pg.release_slot(slot)
+        return blocks
+
+    def test_tail_first_reclamation_and_mru_adoption(self):
+        """Oldest parked prefix dies first on exhaustion; an adopted (hit)
+        prefix re-parks at MRU and therefore survives a reclamation sweep
+        that eats everything older."""
+        pg = BlockPager(10, 8, 4, 8)       # 9 usable blocks, 8-wide table
+        a = list(range(100, 116))          # 16 tokens -> 2 blocks
+        b = list(range(200, 216))
+        blks_a = self._park_prompt(pg, 0, a)
+        blks_b = self._park_prompt(pg, 1, b)
+        assert pg.lru_blocks == 4 and pg.free_blocks == 5
+        # adopt A (prefix hit) and release: A moves to MRU, order [B, A]
+        cov = pg.share_prefix(2, a)
+        assert cov == 15 and pg.last_adopt_parked == 2
+        assert pg.prefix_hits == 1 and pg.prefix_hit_tokens == 15
+        assert pg.lru_blocks == 2          # A's blocks revived
+        pg.release_slot(2)
+        assert pg.lru_blocks == 4
+        # exhaustion: 7 fresh blocks needed, 5 free -> reclaims exactly the
+        # two OLDEST parked blocks, which are B's (A was touched last)
+        assert pg.ensure_writable(3, 0, 56) is not None
+        assert pg.lru_reclaims == 2
+        for blk in blks_a:
+            assert blk in pg._lru, "MRU (adopted) prefix was cannibalized"
+        for blk in blks_b:
+            assert blk not in pg._lru and blk not in pg._block_key
+        assert pg.share_prefix(0, b) == 0  # B's registration is gone
+        assert pg.share_prefix(0, a) == 15 # A still serves
+        pg.release_slot(0)
+        pg.release_slot(3)
+        pg.check_invariants()
+
+    def test_cow_against_lru_adopted_shared_block(self):
+        """Two tenants adopt the same parked tail block (ref 0 -> 2): the
+        writer must COW onto a fresh block — the cached original stays
+        bitwise intact for the co-adopter (and for the registry)."""
+        pg = BlockPager(10, 8, 4, 6)
+        a = list(range(100, 113))          # 13 tokens: full block + 5-tail
+        self._park_prompt(pg, 0, a)
+        assert pg.lru_blocks == 2
+        cov1 = pg.share_prefix(1, a)       # revives both blocks
+        cov2 = pg.share_prefix(2, a)       # live-shares them (ref 2)
+        assert cov1 == cov2 == 12
+        tail = int(pg.tables[1][1])
+        assert pg._ref[tail] == 2 and tail in pg._block_key
+        copies = pg.ensure_writable(1, cov1, 13)
+        assert len(copies) == 1 and copies[0][0] == tail, \
+            "write into an LRU-adopted shared block must copy, not mutate"
+        assert int(pg.tables[1][1]) != tail      # writer moved off
+        assert int(pg.tables[2][1]) == tail      # co-adopter keeps original
+        assert pg._block_key.get(tail) == tuple(a)   # registration intact
+        pg.release_slot(1)
+        pg.release_slot(2)
+        pg.check_invariants()
+
+
+# --------------------------------------------- satellite: randomized property
+
+
+def test_pager_invariants_random_ops():
+    """~1k-op randomized sequences of alloc / share / COW / free / preempt
+    / LRU-park / adopt, asserting after every op that each block is in
+    exactly one of {free, LRU, owned}, refcounts match table references,
+    the trash block is never registered or parked, and the pool conserves
+    its blocks (all via BlockPager.check_invariants)."""
+    rng = np.random.RandomState(0)
+    for round_ in range(4):
+        bs = int(rng.choice([2, 4, 8]))
+        max_slots = 4
+        mbs = 6
+        pg = BlockPager(int(rng.randint(6, 20)), bs, max_slots, mbs)
+        # slot -> (tokens, cached_end) for live tenants; a small family of
+        # prompts so repeats/sharing/adoption happen constantly
+        family = [tuple(rng.randint(1, 50, rng.randint(2, mbs * bs))
+                        .tolist()) for _ in range(6)]
+        live = {}
+        for _ in range(250):
+            op = rng.randint(0, 10)
+            if op < 4 and len(live) < max_slots:        # admit
+                slot = next(s for s in range(max_slots) if s not in live)
+                toks = list(family[rng.randint(len(family))])
+                cov = pg.share_prefix(slot, toks)
+                end = min(cov + bs, len(toks))
+                if pg.ensure_writable(slot, cov, end) is None:
+                    pg.release_slot(slot)               # reject: no blocks
+                else:
+                    live[slot] = (toks, end)
+            elif op < 7 and live:                       # advance one chunk
+                slot = list(live)[rng.randint(len(live))]
+                toks, end = live[slot]
+                if end >= len(toks):
+                    pg.register_prompt(slot, toks)      # prefill complete
+                    nxt = end + rng.randint(1, 2 * bs)  # decode writes
+                    if pg.ensure_writable(slot, end, min(nxt, mbs * bs)) \
+                            is None:
+                        pg.release_slot(slot)           # preempted
+                        del live[slot]
+                    else:
+                        live[slot] = (toks, min(nxt, mbs * bs))
+                else:
+                    nxt = min(end + bs, len(toks))
+                    if pg.ensure_writable(slot, end, nxt) is None:
+                        pg.release_slot(slot)           # preempted
+                        del live[slot]
+                    else:
+                        live[slot] = (toks, nxt)
+            elif live:                                  # finish / evict
+                slot = list(live)[rng.randint(len(live))]
+                if rng.randint(2):
+                    pg.register_prompt(slot, live[slot][0])
+                pg.release_slot(slot)
+                del live[slot]
+            pg.check_invariants()
+        for slot in list(live):
+            pg.release_slot(slot)
+        pg.check_invariants()
+        assert pg.free_blocks + pg.lru_blocks == pg.usable_blocks
+
+
+# ------------------------------------------------ satellite: metrics summary
+
+
+def _load_metrics_summary():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "metrics_summary", os.path.join(REPO, "tools", "metrics_summary.py"))
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+    return ms
+
+
+def test_summary_prefix_cache_section(tiny, tmp_path):
+    """A healthy repeated-prefix run renders the hit rate + LRU occupancy
+    WITHOUT the adoption-bug WARN."""
+    path = str(tmp_path / "lru.jsonl")
+    monitor.enable(path)
+    try:
+        eng = DecodeEngine(tiny, max_slots=2, max_len=32, block_size=8,
+                           prefill_chunk=8)
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(1, 64, 12).tolist()
+        for _ in range(2):
+            eng.submit(prompt, max_new_tokens=3)
+            eng.run()
+    finally:
+        monitor.disable()
+    ms = _load_metrics_summary()
+    out = io.StringIO()
+    assert ms.summarize([path], out=out) == 0
+    text = out.getvalue()
+    assert "prefix cache: hits 1/2 admissions (50%)" in text
+    assert "lru " in text
+    assert "WARNING" not in text
+
+
+def test_summary_warns_on_dead_adoption_path(tmp_path):
+    """The adoption-path-bug signature: repeated prefixes arrived, parked
+    blocks sit in the LRU, and the hit rate is 0% with no live sharing
+    either — metrics_summary must WARN (mirror of the free>=needed WARN).
+    A run whose repeats were served (hits > 0) must stay quiet."""
+    ms = _load_metrics_summary()
+
+    def sink(name, repeats, hits, shared, lru):
+        eng = {"kind": "serve_engine", "ts": 0.5, "max_slots": 2,
+               "max_len": 32, "prefill_buckets": [8], "quantize": None,
+               "engine": 0, "kv_blocks": 9, "block_size": 8,
+               "prefill_chunk": 8, "tp": 1}
+        metrics = {"kind": "counters", "ts": 2.0, "metrics": {
+            "counters": {"serve/admissions": 4},
+            "gauges": {"serve/kv_blocks": 9,
+                       "serve/prefix_repeats": repeats,
+                       "serve/prefix_hits": hits,
+                       "serve/shared_hits": shared,
+                       "serve/lru_blocks": lru,
+                       "serve/prefix_hit_tokens": hits * 8},
+            "histograms": {}}}
+        p = tmp_path / name
+        p.write_text("\n".join(json.dumps(r)
+                               for r in (eng, metrics)) + "\n")
+        return str(p)
+
+    buggy = sink("dead.jsonl", repeats=3, hits=0, shared=0, lru=4)
+    out = io.StringIO()
+    assert ms.summarize([buggy], out=out) == 0
+    assert "WARNING" in out.getvalue()
+    assert "adoption-path bug signature" in out.getvalue()
+
+    healthy = sink("ok.jsonl", repeats=3, hits=3, shared=3, lru=4)
+    out = io.StringIO()
+    assert ms.summarize([healthy], out=out) == 0
+    assert "WARNING" not in out.getvalue()

@@ -192,7 +192,8 @@ def test_sampled_engine_reuse_and_reseed(tiny):
     ids = np.random.RandomState(5).randint(1, 64, (2, 5)).astype("int32")
     a = tiny.generate(paddle.to_tensor(ids), max_new_tokens=5,
                       do_sample=True, seed=3, use_engine=True).numpy()
-    # cache key: (slots, max_len_bucket, quant, do_sample, sampling cfg)
+    # cache key: (slots, max_len_bucket, quant, do_sample, sampling cfg,
+    # tp degree, prefill_chunk)
     key = next(k for k in tiny._serving_engines if k[3])
     eng = tiny._serving_engines[key]
     n = eng.compile_count

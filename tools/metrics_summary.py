@@ -380,6 +380,43 @@ def summarize(paths, show_events=False, out=sys.stdout):
                   f"preemptions "
                   f"{int(counters_m.get('serve/preemptions', 0))}",
                   file=out)
+            # persistent prefix cache: cross-request hit rate + LRU
+            # occupancy (parked refcount-0 blocks waiting for the next
+            # same-prefix request)
+            hits = gauges_m.get("serve/prefix_hits", 0)
+            adm = counters_m.get("serve/admissions", 0)
+            lru = gauges_m.get("serve/lru_blocks", 0)
+            repeats = gauges_m.get("serve/prefix_repeats", 0)
+            total_blocks = gauges_m.get("serve/kv_blocks", 1) - 1
+            if hits or lru or repeats:
+                rate = hits / adm if adm else 0.0
+                print(f"  prefix cache: hits {int(hits)}/{int(adm)} "
+                      f"admissions ({rate:.0%})  hit tokens "
+                      f"{int(gauges_m.get('serve/prefix_hit_tokens', 0))}  "
+                      f"lru {int(lru)}/{int(total_blocks)} blocks "
+                      f"({lru / total_blocks if total_blocks else 0:.0%})",
+                      file=out)
+            # adoption-path-bug signature (mirror of the free>=needed WARN
+            # below): prompts with REPEATED prefixes arrived, parked blocks
+            # are sitting in the LRU, and yet no admission ever adopted a
+            # block — live-shared or parked. Real saturation cannot produce
+            # this shape; a broken share_prefix/registry walk can.
+            if repeats and lru and not hits \
+                    and not gauges_m.get("serve/shared_hits", 0):
+                print(f"  WARNING: {int(repeats)} admission(s) repeated an "
+                      f"already-registered prefix and {int(lru)} parked "
+                      f"block(s) sit in the LRU, but the prefix-cache hit "
+                      f"rate is 0% — adoption-path bug signature (the "
+                      f"share_prefix walk is not matching what "
+                      f"register_prompt published)", file=out)
+            tp = gauges_m.get("serve/tp", 0)
+            if tp and tp > 1:
+                # the engine shards the pool's head axis when it divides,
+                # head_dim for GQA fallback, replicated otherwise — this
+                # line only knows the degree, so it stays layout-neutral
+                print(f"  tensor-parallel decode: tp={int(tp)} (KV pool "
+                      f"sharded over the mesh; table/cursors replicated)",
+                      file=out)
             overload = counters_m.get("serve/rejected_overload", 0)
             if overload:
                 print(f"  queue overload rejections {int(overload)} "
