@@ -146,24 +146,61 @@ def main(argv=()):
     assert np.isfinite(final), f"loss diverged in warmup: {final}"
 
     # ---- MFU accounting (absolute FLOPs vs hardware peak)
-    # matmul params only: 12*L*d^2 block weights + the tied lm-head
-    # projection (embedding GATHERS are not matmul FLOPs and stay out)
+    # the analytic FORMULA (6 FLOPs/param/token + 12*L*d*S attention dots)
+    # is shared with the goodput plane's ledger; bench feeds it matmul
+    # params only — 12*L*d^2 block weights + the tied lm-head projection
+    # (embedding GATHERS are not matmul FLOPs and stay out). Kept as
+    # `mfu_analytic`, the cross-check against the measured number below.
+    # Peak table + PADDLE_PEAK_FLOPS override also live in
+    # monitor/goodput.py (the accounting plane's source of truth): an
+    # unknown device kind no longer pins mfu to null.
+    from paddle_tpu.monitor.goodput import (analytic_train_flops_per_token,
+                                            device_peak_flops,
+                                            executable_cost_stats)
     n_block = 12 * cfg.num_layers * cfg.hidden_size ** 2
-    # fwd+bwd = 6 FLOPs/param/token on matmul params (incl. the tied lm-head
-    # projection = vocab*d) + attention dots 12*L*d*S per token
-    flops_per_token = 6.0 * (n_block + cfg.vocab_size * cfg.hidden_size) \
-        + 12.0 * cfg.num_layers * cfg.hidden_size * seq
-    peak = {"TPU v5 lite": 197e12, "TPU v4": 275e12,
-            "TPU v5p": 459e12, "TPU v6 lite": 918e12}
+    flops_per_token = analytic_train_flops_per_token(
+        n_block + cfg.vocab_size * cfg.hidden_size,
+        cfg.num_layers, cfg.hidden_size, seq)
     kind = jax.devices()[0].device_kind
-    peak_flops = next((v for k, v in peak.items() if kind.startswith(k)),
-                      None)
+    peak_flops = device_peak_flops(kind)
+
+    # measured FLOPs: the warmup minted the (single) shape bucket's AOT
+    # executable — its cost_analysis() counts what XLA actually scheduled,
+    # recompute replays and all. With --recompute the measured count is the
+    # HARDWARE number (HFU); the model's own FLOPs stay the analytic 6ND.
+    measured_fpt = None
+    if step._fast:
+        stats = executable_cost_stats(next(iter(step._fast.values())))
+        if stats:
+            measured_fpt = stats["flops"] / (batch * seq)
+    if measured_fpt is not None and not recompute:
+        drift = measured_fpt / flops_per_token - 1.0
+        if abs(drift) > 0.10:
+            # one of the two FLOP models is wrong — say so rather than
+            # letting the rounds silently track a broken constant
+            print(f"WARNING: measured cost_analysis FLOPs/token "
+                  f"({measured_fpt:.3e}) diverges {drift:+.0%} from the "
+                  f"analytic 6ND model ({flops_per_token:.3e}); mfu is "
+                  f"measured-sourced, check the analytic constant",
+                  file=sys.stderr)
 
     def report(tokens_per_sec, window):
         model_tflops = tokens_per_sec * flops_per_token / 1e12
-        # unknown chip: report mfu null rather than a confidently wrong number
-        mfu = (round(model_tflops * 1e12 / peak_flops, 3)
-               if peak_flops else None)
+        mfu_analytic = (round(model_tflops * 1e12 / peak_flops, 3)
+                        if peak_flops else None)
+        mfu = mfu_analytic
+        hfu = None
+        if measured_fpt is not None and peak_flops:
+            measured_util = round(
+                tokens_per_sec * measured_fpt / peak_flops, 3)
+            if recompute:
+                # measured includes recompute replays: that is HFU; MFU
+                # (model FLOPs only) stays the analytic number — the old
+                # single figure silently conflated them under --recompute
+                hfu = measured_util
+            else:
+                mfu = measured_util
+                hfu = measured_util
         payload = {
             "metric": "gpt_medium_train_tokens_per_sec_per_chip",
             "value": round(tokens_per_sec, 1),
@@ -171,6 +208,10 @@ def main(argv=()):
             "vs_baseline": round(tokens_per_sec / REF_TOKENS_PER_SEC, 3),
             "model_tflops": round(model_tflops, 1),
             "mfu": mfu,
+            "mfu_analytic": mfu_analytic,
+            "hfu": hfu,
+            "mfu_source": ("measured" if measured_fpt is not None
+                           and not recompute else "analytic"),
             "recompute": recompute or None,
             "batch": batch,
             "device_kind": kind,

@@ -252,7 +252,15 @@ class AutoCheckpoint(Callback):
         self._ckptr.save(self._global_step, model=self.model.network,
                          optimizer=self.model._optimizer,
                          grad_scaler=self._scaler(), block=block, _mode=mode)
+        from .. import monitor as _monitor
         from ..monitor import trace as _trace
+        mon = _monitor._active
+        if mon is not None:
+            # goodput: this bracket is what the FIT LOOP lost to the save
+            # (async: the host snapshot; blocking: the whole write) — the
+            # background write itself reports separately as hidden ckpt
+            # time through ckpt_saved(mode="async")
+            mon.ckpt_blocked(t0, time.perf_counter())
         tracer = _trace._active
         if tracer is not None:
             # host time the fit loop spent inside save() (the async host

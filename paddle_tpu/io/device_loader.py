@@ -219,7 +219,7 @@ class _DeviceIterator:
             # feed-health telemetry: queue depth gauge + stall counter (a
             # blocking get means the producer lost the race this step; the
             # terminal END wait above is epoch teardown, not a stall)
-            mon.loader_wait(t1 - t0, self._q.qsize())
+            mon.loader_wait(t1 - t0, self._q.qsize(), span=(t0, t1))
         tracer = _trace._active
         if tracer is not None:
             # consumer stall ahead of the next step: adopted by that step's

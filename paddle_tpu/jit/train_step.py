@@ -31,6 +31,7 @@ state machine on the flag.
 """
 from __future__ import annotations
 
+import itertools
 import math
 import time
 from typing import Callable, List, Optional, Sequence
@@ -269,6 +270,12 @@ class TrainStep:
     on-device skip-update, host-side scale adjustment).
     """
 
+    # per-instance id for the goodput FLOP ledger: two TrainSteps sharing
+    # one monitor session (hapi's + a hand-built one, a GAN-style pair)
+    # must never bill each other's dispatches — the DecodeEngine keys per
+    # engine_id for the same reason
+    _ids = itertools.count()
+
     def __init__(self, model: Layer, optimizer, loss_fn: Optional[Callable] = None,
                  donate_params: bool = True, fast_path: bool = True,
                  accumulate_steps: Optional[int] = None,
@@ -310,6 +317,11 @@ class TrainStep:
         self._donate = donate_params
         self._params: List[Parameter] = [p for _, p in
                                          self._model.named_parameters()]
+        # trainable param count for the goodput plane's analytic 6ND FLOP
+        # model (fallback + cross-check next to cost_analysis at each mint)
+        self._n_train_params = sum(
+            int(math.prod(p.shape)) if p.ndim else 1
+            for p in self._params if p.trainable)
         self._buffers = [b for _, b in self._model.named_buffers()]
         self._buffers.append(_random.rng_state_tensor())
         self._compiled = None
@@ -321,8 +333,12 @@ class TrainStep:
         self._fast_meta = None
         # recompile-sentinel state: the previous step's input signature, so a
         # recompile event can name exactly which leaves diverged (only
-        # maintained while the monitor is enabled — zero stores otherwise)
+        # maintained while the monitor is enabled — zero stores otherwise);
+        # _mon_sig_bucket maps slow-path signatures to their mint count so
+        # steady-state jit dispatches FLOP-attribute to the RIGHT bucket
         self._mon_prev_sig = None
+        self._mon_sig_bucket = {}
+        self._gp_id = next(TrainStep._ids)
         # span-tracer state: the open per-step trace (monitor/trace.py) and
         # a step counter for its attrs — None/0 while tracing is off
         self._cur_trace = None
@@ -753,8 +769,14 @@ class TrainStep:
                 if step_trace is not None:
                     # the dispatch above WAS a compile; link the sentinel
                     step_trace.event("recompile", count=n1, path="jit")
-                mon.train_step_compiled(sig, self._mon_prev_sig,
-                                        compile_s=None, count=n1, path="jit")
+                # the jit path compiles INSIDE the dispatch call — no
+                # separate compile wall exists, so the dispatch span itself
+                # classifies as compile time in the goodput ledger
+                self._mon_sig_bucket[sig] = n1
+                mon.train_step_compiled(
+                    sig, self._mon_prev_sig, compile_s=None, count=n1,
+                    path="jit", span=(t0, t1), **self._flop_kwargs(
+                        input_arrays))
                 if self._acc_steps > 1:
                     mon.accum_config(self._acc_steps, self._grad_acc_bytes())
                 self._emit_shard_gauges(mon)
@@ -764,7 +786,9 @@ class TrainStep:
                 # time, not dispatch, and is already covered by the recompile
                 # event
                 mon.step_event(t1 - t0,
-                               microbatches=self._microbatches(input_arrays))
+                               microbatches=self._microbatches(input_arrays),
+                               bucket=self._mon_sig_bucket.get(sig),
+                               span=(t0, t1), step_id=self._gp_id)
             self._mon_prev_sig = sig
 
         opt = self._opt
@@ -808,6 +832,45 @@ class TrainStep:
             scalars["loss_scale"] = scalar_const(
                 float(self._scaler._scale)).astype(jnp.float32)
         return scalars
+
+    def _flop_kwargs(self, input_arrays) -> dict:
+        """Per-mint FLOP-ledger context: tokens one call consumes (every
+        element of the first input — [B, S] ids, [K, B, S] stacked), the
+        analytic 6ND model over the trainable params, and whether the trace
+        rematerializes (measured FLOPs then include recompute replays, so
+        MFU must source from the analytic model while HFU stays measured).
+        For a transformer whose config exposes num_layers/hidden_size, the
+        attention-dot term (12·L·d·S per token, fwd+bwd — the bench.py
+        constant) is added: without it the ledger's analytic would sit
+        ~10% under bench's on the GPT config, and under recompute — where
+        the analytic is the sole MFU source — the two figures would
+        disagree by pure constant skew.
+        """
+        from ..monitor.goodput import analytic_train_flops_per_token
+        tokens = 1
+        seq = 0
+        if input_arrays and getattr(input_arrays[0], "ndim", 0):
+            shape = input_arrays[0].shape
+            tokens = int(math.prod(shape))
+            if len(shape) >= 2:
+                seq = int(shape[-1])
+        cfg = getattr(self._model, "config", None)
+        fpt = analytic_train_flops_per_token(
+            self._n_train_params, getattr(cfg, "num_layers", None),
+            getattr(cfg, "hidden_size", None), seq or None)
+        # SPMD span: cost_analysis reports the PER-DEVICE module, so the
+        # global analytic must divide by the device count for the
+        # cross-check (and the MFU ratios) to stay per-chip figures
+        devices = 1
+        for p in self._params:
+            try:
+                devices = max(devices, len(p._data.sharding.device_set))
+            except Exception:
+                pass
+        return dict(tokens=tokens, analytic_flops=fpt * tokens,
+                    devices=devices, step_id=self._gp_id,
+                    recompute=bool(getattr(self._model, "_recompute_wanted",
+                                           False)))
 
     def _microbatches(self, input_arrays) -> int:
         if self._acc_steps > 1 and input_arrays \
@@ -1030,7 +1093,8 @@ class TrainStep:
             # offending signature, compile wall-time, running executable
             # count, and the executable's memory_analysis() as HBM gauges
             mon.train_step_compiled(sig, self._mon_prev_sig, compile_s,
-                                    len(self._fast), "aot", compiled=exe)
+                                    len(self._fast), "aot", compiled=exe,
+                                    **self._flop_kwargs(input_arrays))
             if self._acc_steps > 1:
                 mon.accum_config(self._acc_steps, self._grad_acc_bytes())
             self._emit_shard_gauges(mon)
@@ -1081,7 +1145,7 @@ class TrainStep:
         self._compiled = None
         mon = _monitor._active
         if mon is not None:
-            mon.fast_state_dropped(why, n)
+            mon.fast_state_dropped(why, n, step_id=self._gp_id)
 
     def _refresh_fast_state(self) -> bool:
         """Re-adopt any array a user replaced between steps (set_state_dict,
@@ -1141,6 +1205,9 @@ class TrainStep:
     def _fast_call(self, input_arrays):
         opt = self._opt
         mon = _monitor._active
+        # step-entry instant: the goodput ledger books the pre-dispatch
+        # host work (state refresh, scalars, arg handling) as overhead
+        tc0 = time.perf_counter() if mon is not None else None
         sig = self._input_sig(input_arrays)
         exe = self._fast.get(sig)
         if exe is None:
@@ -1170,13 +1237,16 @@ class TrainStep:
             t1 = time.perf_counter()
             if _prof_recorder.enabled:
                 record_stage("train_step/dispatch", t0, t1)
+            if mon is not None or step_trace is not None:
+                bucket = list(self._fast).index(sig) + 1
             if mon is not None:
                 mon.step_event(t1 - t0,
-                               microbatches=self._microbatches(input_arrays))
+                               microbatches=self._microbatches(input_arrays),
+                               bucket=bucket, span=(t0, t1), host_t0=tc0,
+                               step_id=self._gp_id)
             if step_trace is not None:
                 step_trace.record(
-                    "dispatch", t0, t1, path="aot",
-                    bucket=list(self._fast).index(sig) + 1,
+                    "dispatch", t0, t1, path="aot", bucket=bucket,
                     microbatches=self._microbatches(input_arrays))
 
         # outputs become next step's inputs verbatim (donation-friendly: the

@@ -29,8 +29,10 @@ import time
 import warnings
 from typing import Optional
 
+from . import goodput as _goodput_mod
 from . import prom as _prom
 from . import trace as _trace_mod
+from .goodput import GOODPUT_STATES, GoodputLedger
 from .memory import executable_memory_stats, live_array_census
 from .recorder import FlightRecorder
 from .registry import Counter, Gauge, Histogram, Registry
@@ -40,7 +42,7 @@ __all__ = ["enable", "disable", "enabled", "get", "emit", "dump",
            "counter", "gauge", "histogram", "snapshot", "fleet_state",
            "live_array_census", "executable_memory_stats", "prom_render",
            "Monitor", "Registry", "Counter", "Gauge", "Histogram",
-           "SCHEMA_VERSION"]
+           "GoodputLedger", "GOODPUT_STATES", "SCHEMA_VERSION"]
 
 # THE hot-path flag: integration points read this one module global and do
 # nothing when it is None. Everything else in this file is cold path.
@@ -109,6 +111,9 @@ class Monitor:
         self.registry = Registry()
         self.sink = JsonlSink(path, flush_every) if path else None
         self.flight = FlightRecorder(ring)
+        # goodput/MFU accounting plane (monitor/goodput.py): consumes the
+        # hooks below, costs nothing new on the disabled path
+        self.goodput = GoodputLedger(self.registry, emit=self.emit)
         self.warn_after = warn_after
         self._op_counts = {}
         self._op_compiles = 0
@@ -138,6 +143,12 @@ class Monitor:
         return rec
 
     def _emit_counters(self):
+        # freshen the goodput/mfu gauges first: the counters record is the
+        # snapshot offline tooling reads, its idle/fraction must be current
+        try:
+            self.goodput.refresh()
+        except Exception:
+            pass
         snap = self.registry.snapshot()
         # copy first: op_hook inserts first-seen op names from other threads,
         # and iterating the live dict would raise mid-dump
@@ -173,13 +184,35 @@ class Monitor:
     # ------------------------------------------------ integration: train step
 
     def train_step_compiled(self, sig, prev_sig, compile_s: Optional[float],
-                            count: int, path: str, compiled=None):
+                            count: int, path: str, compiled=None,
+                            tokens=None, analytic_flops=None,
+                            recompute: bool = False, span=None,
+                            devices: int = 1, step_id=None):
         """Recompile-sentinel entry: a TrainStep minted a new executable.
 
         path: "aot" (fast-path shape bucket) | "jit" (slow-path trace-cache
         miss). Emits the recompile event, memory gauges for the new
-        executable, and the warn_after diagnostic.
+        executable, and the warn_after diagnostic. ``tokens`` /
+        ``analytic_flops`` / ``recompute`` feed the goodput plane's
+        per-bucket FLOP ledger (``compiled.cost_analysis()`` measured,
+        analytic 6ND as fallback + cross-check); ``span`` is the dispatch
+        interval of a jit-path mint, whose compile wall is not separately
+        measurable — the whole dispatch classifies as compile time.
         """
+        gp = self.goodput
+        # keyed per TrainStep instance (the engine_id pattern): two train
+        # steps in one session never bill each other's dispatches; the
+        # flat per-bucket gauges stay last-writer
+        gp.record_executable("train", (step_id, count), compiled,
+                             tokens_per_call=tokens,
+                             analytic_flops=analytic_flops,
+                             recompute=recompute, devices=devices,
+                             label=f"train_bucket{count}")
+        if compile_s is not None:
+            now = time.perf_counter()
+            gp.add("compile", now - compile_s, now)
+        elif span is not None:
+            gp.add("compile", span[0], span[1])
         self.registry.counter("train_step/recompiles").inc()
         self.registry.gauge("train_step/executables").set(count)
         if compile_s is not None:
@@ -221,11 +254,20 @@ class Monitor:
                 + (f" [trace {tid}]" if tid else ""),
                 RuntimeWarning, stacklevel=3)
 
-    def step_event(self, dur_s: float, microbatches: int = 1):
+    def step_event(self, dur_s: float, microbatches: int = 1, bucket=None,
+                   span=None, host_t0=None, step_id=None):
         self.registry.counter("train_step/steps").inc()
         if microbatches > 1:
             self.registry.counter("train_step/microbatches").inc(microbatches)
         self.registry.histogram("train_step/dispatch_s").observe(dur_s)
+        # goodput: the dispatch is productive time attributed to its shape
+        # bucket's FLOP entry; host_t0 (the step's entry instant) books the
+        # pre-dispatch host work as overhead
+        if span is None:
+            t1 = time.perf_counter()
+            span = (t1 - dur_s, t1)
+        self.goodput.dispatch("train", (step_id, bucket), span[0], span[1],
+                              host_t0=host_t0)
         self.emit("step", dur_s=dur_s)
 
     # ------------------------------------------- integration: grad accumulation
@@ -293,21 +335,32 @@ class Monitor:
         placement during fast-state refresh (cheaper than a recompile)."""
         self.registry.counter("train_step/placement_restores").inc()
 
-    def fast_state_dropped(self, why: str, executables: int):
+    def fast_state_dropped(self, why: str, executables: int, step_id=None):
         """Fast-path executables dropped due to an unrestorable placement
         change; the next step re-lowers (recompile sentinel will fire)."""
         self.registry.counter("train_step/fast_state_drops").inc()
         # the rebuilt executables re-number from bucket 1: stale per-bucket
-        # memory gauges would misattribute HBM to dead executables
+        # memory gauges would misattribute HBM to dead executables (same
+        # rule for the goodput plane's per-bucket FLOP entries — dropped
+        # for THIS TrainStep only, a sibling's entries stay live)
         self.registry.remove_prefix("train_step/bucket")
+        self.registry.remove_prefix("mfu/train_bucket")
+        self.goodput.drop_kind("train", owner=step_id)
         self.emit("fast_state_dropped", reason=why, executables=executables)
 
     # ---------------------------------------------------- integration: loader
 
-    def loader_wait(self, wait_s: float, qsize: int):
+    def loader_wait(self, wait_s: float, qsize: int, span=None):
         self.registry.counter("loader/batches").inc()
         self.registry.gauge("loader/queue_depth").set(qsize)
         self.registry.histogram("loader/wait_s").observe(wait_s)
+        # goodput: consumer-visible feed wait is data_wait — the producer's
+        # hidden fetch/H2D never reaches the ledger (hidden work is not
+        # lost time)
+        if span is None:
+            t1 = time.perf_counter()
+            span = (t1 - wait_s, t1)
+        self.goodput.add("data_wait", span[0], span[1])
         if wait_s > _STALL_S:
             self.registry.counter("loader/stalls").inc()
             self.emit("loader_stall", wait_s=wait_s, qsize=qsize)
@@ -331,8 +384,20 @@ class Monitor:
         self.registry.gauge("ckpt/last_step").set(step)
         self.registry.gauge("ckpt/last_bytes").set(nbytes)
         self.registry.histogram("ckpt/save_s").observe(dur_s)
+        # goodput: a sync/emergency save blocks the loop (ckpt time); an
+        # async write runs under live steps and may only claim time nothing
+        # foreground owns — the interval ledger's priorities encode that
+        now = time.perf_counter()
+        self.goodput.add("ckpt_bg" if mode == "async" else "ckpt",
+                         now - dur_s, now)
         self.emit("ckpt_save", step=step, bytes=nbytes, dur_s=dur_s,
                   mode=mode, attempts=attempts)
+
+    def ckpt_blocked(self, t0: float, t1: float):
+        """Host time the fit loop spent inside save() (the async path's
+        host snapshot; the whole write when blocking) — foreground
+        checkpoint time for the goodput ledger, perf_counter interval."""
+        self.goodput.add("ckpt", t0, t1)
 
     def ckpt_retry(self, step: int, attempt: int):
         """A snapshot write attempt failed transiently and is being retried."""
@@ -374,6 +439,8 @@ class Monitor:
         g("reshard/arrays_gathered").set(gathered)
         g("reshard/bytes_read").set(bytes_read)
         self.registry.counter("reshard/loads").inc()
+        now = time.perf_counter()
+        self.goodput.add("reshard", now - wall_s, now)
         if nestable_gather:
             self.registry.counter("reshard/nestable_gather_fallbacks").inc(
                 nestable_gather)
@@ -399,18 +466,41 @@ class Monitor:
             g("serve/block_size").set(block_size or 0)
         if tp and tp > 1:
             g("serve/tp").set(tp)
+        self.goodput.set_tp(tp or 1)   # tokens/s/chip divides by the mesh
         self.emit("serve_engine", max_slots=max_slots, max_len=max_len,
                   prefill_buckets=list(buckets), quantize=quantize,
                   engine=engine_id, paged=paged, block_size=block_size,
                   kv_blocks=kv_blocks, prefill_chunk=prefill_chunk, tp=tp)
 
     def serve_compiled(self, kind: str, bucket, compile_s: float, count: int,
-                       engine_id=None):
+                       engine_id=None, compiled=None, tokens=None,
+                       analytic_flops=None, devices: int = 1):
         """Serving recompile sentinel: the engine minted an executable.
         kind: "prefill" (one per prompt-length bucket) | "decode" (exactly
         one per ENGINE, ever — a second decode mint from the same engine in
         steady state is a bug; `engine_id` lets a sink with several engines
-        tell re-mints from a sibling engine's first mint)."""
+        tell re-mints from a sibling engine's first mint). ``compiled`` /
+        ``tokens`` / ``analytic_flops`` / ``devices`` (the engine's TP
+        span) feed the goodput FLOP ledger, keyed per ENGINE so two live
+        engines in one session never bill each other's dispatches (the
+        flat per-bucket gauges stay last-writer, like the serve/* geometry
+        gauges)."""
+        label = f"serve_{kind}" + (str(bucket) if bucket else "")
+        gp = self.goodput
+        rec = gp.record_executable("serve", (engine_id, kind, bucket),
+                                   compiled, tokens_per_call=tokens,
+                                   analytic_flops=analytic_flops,
+                                   devices=devices, label=label)
+        if kind == "decode" and rec.tokens:
+            # per-token serving cost (model-FLOPs/token next to TTFT in
+            # the reports) is a DECODE figure: a prefill bucket minting
+            # later must not overwrite it with its own per-token cost
+            mf = rec.model_flops_per_call()
+            if mf is not None:
+                self.registry.gauge("serve/model_flops_per_token").set(
+                    mf / rec.tokens)
+        now = time.perf_counter()
+        gp.add("compile", now - compile_s, now)
         self.registry.counter("serve/compiles").inc()
         self.registry.counter(f"serve/compiles_{kind}").inc()
         self.registry.gauge("serve/executables").set(count)
@@ -497,7 +587,8 @@ class Monitor:
         self.emit("serve_admit", ttft_s=ttft_s, bucket=bucket,
                   prefill_s=prefill_s)
 
-    def serve_step(self, dur_s: float, live: int, queue_depth: int):
+    def serve_step(self, dur_s: float, live: int, queue_depth: int,
+                   engine_id=None):
         """One decode step over all live slots: per-token latency is
         dur_s (the whole batch advances one token per step)."""
         self.registry.counter("serve/decode_steps").inc()
@@ -505,6 +596,31 @@ class Monitor:
         self.registry.gauge("serve/live_slots").set(live)
         self.registry.gauge("serve/queue_depth").set(queue_depth)
         self.registry.histogram("serve/step_s").observe(dur_s)
+        # goodput: the decode executable ran full-shape over max_slots rows
+        # (HFU) while only `live` of them carried requests (MFU) — the
+        # ledger scales model FLOPs by the live fraction; decode tokens are
+        # GENERATED tokens, the serving-throughput figure
+        now = time.perf_counter()
+        self.goodput.dispatch("serve", (engine_id, "decode", None),
+                              now - dur_s, now, tokens=live,
+                              generated=True)
+
+    def serve_prefill_step(self, dur_s: float, bucket, tokens: int,
+                           engine_id=None):
+        """One prefill execution (a chunk iteration, or a monolithic
+        bucketed prefill): productive time + FLOPs for the goodput ledger;
+        ``tokens`` is the VALID token count this call carried (a padded
+        chunk tail is hardware work but not model work)."""
+        now = time.perf_counter()
+        self.goodput.dispatch("serve", (engine_id, "prefill", bucket),
+                              now - dur_s, now, tokens=tokens)
+
+    def serve_sched(self, t0: float, t1: float):
+        """One whole scheduler iteration (``DecodeEngine.step()``) as a
+        perf_counter bracket: the executable calls inside it classify as
+        productive/compile, the remainder is engine host overhead — which
+        makes a serving burst's timeline gap-free."""
+        self.goodput.add("overhead", t0, t1)
 
     def serve_done(self, n_tokens: int, total_s: float, status: str):
         """A request left its slot (stop condition hit)."""
@@ -609,6 +725,7 @@ def enable(path: Optional[str] = None, *, warn_after: Optional[int] = None,
         mon = Monitor(path, warn_after=warn_after, flush_every=flush_every,
                       ring=ring)
         _install_hooks(mon)
+        _goodput_mod._set_active(mon.goodput)
         _active = mon
     if fleet is None:
         v = os.environ.get("PADDLE_MONITOR_FLEET")
@@ -647,6 +764,7 @@ def _install_hooks(mon: Monitor):
 def _teardown_locked():
     global _active
     mon, _active = _active, None
+    _goodput_mod._set_active(None)
     from ..core import dispatch
     dispatch.set_monitor_hooks(None, None)
     from . import collector as _collector
@@ -708,7 +826,13 @@ def histogram(name: str) -> Optional[Histogram]:
 
 def snapshot() -> Optional[dict]:
     mon = _active
-    return mon.registry.snapshot() if mon is not None else None
+    if mon is None:
+        return None
+    try:
+        mon.goodput.refresh()   # idle/fraction current as of THIS snapshot
+    except Exception:
+        pass
+    return mon.registry.snapshot()
 
 
 def fleet_state() -> Optional[dict]:
@@ -733,6 +857,12 @@ def prom_render(source=None) -> str:
             return _prom.render(fleet)
         if mon is None:
             return ""
+        # a scrape must see current goodput/idle figures, not the state as
+        # of the last hook event
+        try:
+            mon.goodput.refresh()
+        except Exception:
+            pass
         source = mon.registry.snapshot()
     return _prom.render(source)
 

@@ -49,6 +49,7 @@ import time
 import warnings
 from typing import Dict, List, Optional
 
+from . import goodput as _goodput_mod
 from . import trace as _trace_mod
 from .registry import Registry
 from .sink import JsonlSink
@@ -177,6 +178,10 @@ class Publisher:
         """Snapshot -> delta -> publish. The snapshot is the only work under
         the registry lock (bounded by metric count); its cost is measured
         into fleet/publish_s so the overhead claim is a gauge, not a hope."""
+        # freshen the goodput/idle gauges first: the wire must carry the
+        # state as of THIS publish, not as of the last hook event (idle is
+        # the one state that grows between events)
+        _goodput_mod.refresh_active()
         t0 = time.perf_counter()
         snap = self.registry.snapshot()
         snap_s = time.perf_counter() - t0
@@ -480,6 +485,21 @@ class Aggregator:
                    "fleet/step_skew": skew}
         if slowest is not None:
             derived["fleet/slowest_rank"] = slowest
+
+        # pod goodput (monitor/goodput.py accounting plane): a pod moves at
+        # its slowest rank's pace, so pod goodput is the MIN over ranks —
+        # and the lost fraction is ATTRIBUTED to the named rank (its own
+        # goodput/idle_s gauge says how much of the loss is straggler idle)
+        gp = {r: float(self._ranks[r].gauges["goodput/fraction"])
+              for r in live
+              if "goodput/fraction" in self._ranks[r].gauges}
+        if gp:
+            worst = min(gp, key=gp.get)
+            derived["fleet/goodput"] = gp[worst]
+            derived["fleet/goodput_min_rank"] = worst
+            idle = self._ranks[worst].gauges.get("goodput/idle_s")
+            if idle is not None:
+                derived["fleet/goodput_min_rank_idle_s"] = float(idle)
         return {"live": live, "stale": stale, "step_s": step_s,
                 "skew": skew, "slowest": slowest, "diverged": diverged,
                 "derived": derived}

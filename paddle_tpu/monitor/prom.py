@@ -10,6 +10,13 @@ plain dicts:
 * a fleet record (``kind == "fleet"`` from ``run.fleet.jsonl``) — per-rank
   values become ``rank="<r>"`` labels, fleet-derived gauges render plain.
 
+The goodput/MFU accounting plane (monitor/goodput.py) exports through the
+same paths: ``goodput/fraction`` -> ``paddle_goodput_fraction``,
+``mfu/hfu`` -> ``paddle_mfu_hfu`` and the per-rank fleet view carries
+``paddle_fleet_goodput`` (pod goodput = min over ranks) — the live
+registry render freshens the ledger first, so a scrape never reads a
+stale idle figure.
+
 Naming follows the Prometheus conventions the exposition format expects:
 metric paths are sanitized (``train_step/dispatch_s`` ->
 ``paddle_train_step_dispatch_s``), counters gain ``_total``, histogram

@@ -267,6 +267,11 @@ class DecodeEngine:
         # without retracing
         self._leaves = [p for _, p in model.named_parameters()] \
             + [b for _, b in model.named_buffers()]
+        # param count for the goodput plane's analytic 2ND inference FLOP
+        # model (fallback + cross-check next to each mint's cost_analysis)
+        self._n_params = sum(
+            int(np.prod(p.shape)) if p.ndim else 1
+            for _, p in model.named_parameters())
         self._cache_dtype = spec.head_weight.value().dtype
         # ---- tensor-parallel decode over the device mesh: with a "model"
         # axis of degree > 1 and a model riding it, the executables become
@@ -514,12 +519,17 @@ class DecodeEngine:
         return ([(self._pool_sh, self._pool_sh)
                  for _ in range(self.spec.num_layers)], self._repl)
 
-    def _minted(self, kind: str, bucket, compile_s: float):
+    def _minted(self, kind: str, bucket, compile_s: float, exe=None,
+                tokens=None):
         self.compile_count += 1
         mon = _monitor._active
         if mon is not None:
-            mon.serve_compiled(kind, bucket, compile_s, self.compile_count,
-                               engine_id=self.engine_id)
+            mon.serve_compiled(
+                kind, bucket, compile_s, self.compile_count,
+                engine_id=self.engine_id, compiled=exe, tokens=tokens,
+                analytic_flops=(2.0 * self._n_params * tokens
+                                if tokens else None),
+                devices=self._tp)
 
     # --------------------------------------------------------- executables
 
@@ -572,7 +582,9 @@ class DecodeEngine:
                                     out_shardings=self._pool_out_shardings()
                                     if self.paged else None)
         self._decode_exe = exe
-        self._minted("decode", None, time.time() - t0)
+        # the decode step advances one token per SLOT per call
+        self._minted("decode", None, time.time() - t0, exe=exe,
+                     tokens=self.max_slots)
         return exe
 
     def _build_chunk(self, sc: int):
@@ -612,7 +624,7 @@ class DecodeEngine:
         exe = self._compile_in_eval(fn, args,
                                     out_shardings=self._pool_out_shardings())
         self._prefill_exes[sc] = exe
-        self._minted("prefill", sc, time.time() - t0)
+        self._minted("prefill", sc, time.time() - t0, exe=exe, tokens=sc)
         return exe
 
     def _build_prefill(self, sb: int):
@@ -648,7 +660,7 @@ class DecodeEngine:
         t0 = time.time()
         exe = self._compile_in_eval(fn, args)
         self._prefill_exes[sb] = exe
-        self._minted("prefill", sb, time.time() - t0)
+        self._minted("prefill", sb, time.time() - t0, exe=exe, tokens=sb)
         return exe
 
     # ----------------------------------------------------------- requests
@@ -757,6 +769,11 @@ class DecodeEngine:
         free slots, advance every in-flight chunked prefill by at most
         ``prefill_chunk`` tokens, then decode every live slot one token.
         Returns the requests that finished during this step."""
+        mon = _monitor._active
+        # goodput bracket: the whole scheduler iteration; the executable
+        # calls inside classify as productive/compile, the remainder is
+        # engine host overhead — the serving timeline stays gap-free
+        sched_t0 = time.perf_counter() if mon is not None else None
         finished: List[Request] = []
         while self._queue and self._slots.n_free:
             if self.paged:
@@ -772,6 +789,8 @@ class DecodeEngine:
                     self._advance_prefill(slot, finished)
         if self._live.any():
             self._decode(finished)
+        if sched_t0 is not None and mon is _monitor._active:
+            mon.serve_sched(sched_t0, time.perf_counter())
         return finished
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
@@ -897,6 +916,10 @@ class DecodeEngine:
             self._dev(jnp.int32(end)), src, dst, self._next_key())
         chunk_s = time.time() - t0
         st.prefill_s += chunk_s
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_prefill_step(chunk_s, sc, tokens=end - p0,
+                                   engine_id=self.engine_id)
         st.done = end
         st.chunks += 1
         if st.req._phase is not None:
@@ -1023,6 +1046,8 @@ class DecodeEngine:
         mon = _monitor._active
         if mon is not None:
             mon.serve_queue_wait(wait_s)
+            mon.serve_prefill_step(dt, sb, tokens=n,
+                                   engine_id=self.engine_id)
             mon.serve_admitted(req.t_first_token - req.t_submit, sb, dt)
         if req._trace is not None:
             if req._phase is not None:
@@ -1097,7 +1122,8 @@ class DecodeEngine:
         self.decode_steps += 1
         mon = _monitor._active
         if mon is not None:
-            mon.serve_step(dt, live, len(self._queue))
+            mon.serve_step(dt, live, len(self._queue),
+                           engine_id=self.engine_id)
             if self.paged:
                 mon.serve_paged(self._pager.stats(), self.kv_util())
 

@@ -131,6 +131,11 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
             + f"   step skew {_fmt(skew, '{:.2f}x')}")
     if d.get("fleet/slowest_rank") is not None and skew and skew > 1.05:
         line += f" (slowest: rank {d['fleet/slowest_rank']})"
+    if d.get("fleet/goodput") is not None:
+        # pod goodput = min over ranks (the pod moves at its floor)
+        line += f"   pod goodput {d['fleet/goodput']:.0%}"
+        if d.get("fleet/goodput_min_rank") is not None:
+            line += f" (floor: rank {d['fleet/goodput_min_rank']})"
     if d.get("fleet/elastic_peers") is not None:
         line += f"   elastic peers {d['fleet/elastic_peers']}"
     out.append(line)
@@ -143,8 +148,9 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
 
     steps_col = "steps" if not window else "Δsteps"
     hdr = (f"{'rank':>4} {steps_col:>9} {'steps/s':>8} {'step p50':>10} "
-           f"{'step p95':>10} {'recomp':>7} {'skip':>5} {'ckpt':>5} "
-           f"{'reshard':>8} {'tok/s':>8} {'kv_util':>8} {'queue':>6}")
+           f"{'step p95':>10} {'goodput':>8} {'recomp':>7} {'skip':>5} "
+           f"{'ckpt':>5} {'reshard':>8} {'tok/s':>8} {'kv_util':>8} "
+           f"{'queue':>6}")
     out.append(hdr)
 
     def counter(name, rank):
@@ -162,6 +168,7 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
                f" {_fmt(_rate(cur, prev, 'counters', 'train_step/steps', r)):>8}"
                f" {_fmt(h.get('p50'), '{:.4f}s'):>10}"
                f" {_fmt(h.get('p95'), '{:.4f}s'):>10}"
+               f" {_fmt(_pick(cur, 'gauges', 'goodput/fraction', r), '{:.0%}'):>8}"
                f" {_fmt(counter('train_step/recompiles', r), '{:.0f}'):>7}"
                f" {_fmt(counter('train_step/skipped_updates', r), '{:.0f}'):>5}"
                f" {_fmt(counter('ckpt/saves', r), '{:.0f}'):>5}"

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""goodput_report — where every second and every FLOP of a run went.
+
+Reads one or more monitor JSONL files (``monitor.enable(path)`` output —
+``run.jsonl``, ``run.proc1.jsonl``, ...; flight dumps work too) and renders
+the goodput/MFU accounting plane (paddle_tpu/monitor/goodput.py):
+
+* a **time-breakdown table per rank** — the gap-free state timeline
+  (productive / compile / data_wait / ckpt / reshard / overhead / idle) as
+  seconds and % of wall, plus the goodput fraction;
+* a **pod roll-up** — per-state sums across ranks and pod goodput (the MIN
+  over ranks, with the owning rank named — a pod moves at its slowest
+  rank's pace);
+* **MFU / HFU per executable bucket** — measured ``cost_analysis()`` FLOPs
+  next to the analytic 6ND model per TrainStep bucket / engine executable,
+  and the run-level MFU vs HFU ratios (they split under ``--recompute``:
+  the hardware replays FLOPs the model's math never asked for);
+* the **top-3 goodput losses** — the largest non-productive states, each
+  with its single worst episode (the slowest compile / stall / save) and
+  that episode's trace id when the span tracer recorded one, so the path
+  from "we lost 40s to data_wait" to a causal waterfall is one
+  ``tools/trace_view.py`` invocation.
+
+Stdlib only — runs anywhere the JSONL files are visible.
+
+Usage:
+    python tools/goodput_report.py run.jsonl [run.proc1.jsonl ...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# shared JSONL/flight-dump parsing + rank inference + the goodput state
+# tuple (the one copy of that contract outside paddle_tpu — this tool must
+# run without jax on any box holding the files): resolve the sibling
+# module by path so the CLI works from any cwd
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from metrics_summary import (GOODPUT_STATES as STATES,  # noqa: E402
+                             _proc_of, load_records)
+
+# state -> (event kind, duration field) of its worst-episode candidates
+EPISODES = {
+    "compile": (("recompile", "compile_s"), ("serve_compile", "compile_s")),
+    "data_wait": (("loader_stall", "wait_s"),),
+    "ckpt": (("ckpt_save", "dur_s"),),
+    "reshard": (("reshard", "wall_s"),),
+}
+
+
+def _gauges_of(records, snap):
+    """The final gauges view of one rank's stream."""
+    if snap is not None:
+        return snap.get("gauges") or {}
+    out = {}
+    for r in records:
+        if r.get("kind") == "counters" and isinstance(r.get("metrics"),
+                                                      dict):
+            out = r["metrics"].get("gauges") or {}
+    return out
+
+
+def _fmt_si(v, suffix):
+    if v is None:
+        return "-"
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000 or unit == "P":
+            return f"{v:.1f}{unit}{suffix}"
+        v /= 1000.0
+    return f"{v:.1f}P{suffix}"
+
+
+def _fmt_flops(v):
+    return _fmt_si(v, "F")
+
+
+def _fmt_bytes(v):
+    return _fmt_si(v, "B")
+
+
+def _breakdown(gauges):
+    vals = {s: float(gauges.get(f"goodput/{s}_s", 0.0)) for s in STATES}
+    total = sum(vals[s] for s in STATES)
+    return vals, total, float(gauges.get("goodput/fraction", 0.0))
+
+
+def _render_table(vals, total, fraction, out, indent="  "):
+    for s in STATES:
+        pct = vals[s] / total * 100 if total else 0.0
+        bar = "#" * int(round(pct / 2.5))
+        print(f"{indent}{s:<11}{vals[s]:>10.3f}s {pct:>6.1f}%  {bar}",
+              file=out)
+    print(f"{indent}{'wall':<11}{total:>10.3f}s   goodput fraction "
+          f"{fraction:.1%}", file=out)
+
+
+def _worst_episode(records, state):
+    worst = None
+    for kind, field in EPISODES.get(state, ()):
+        for r in records:
+            if r.get("kind") != kind or r.get(field) is None:
+                continue
+            if worst is None or float(r[field]) > float(worst[1]):
+                worst = (r, float(r[field]))
+    return worst
+
+
+def report(paths, out=sys.stdout):
+    per_rank = {}       # rank -> (records, gauges)
+    next_free = 0
+    for path in paths:
+        records, snap = load_records(path)
+        proc = _proc_of(path, records)
+        if proc is None or proc in per_rank:
+            while next_free in per_rank:
+                next_free += 1
+            proc = next_free
+        per_rank[proc] = (records, _gauges_of(records, snap))
+    per_rank = {r: v for r, v in sorted(per_rank.items())}
+    ranks_with = {r: v for r, v in per_rank.items()
+                  if any(k.startswith("goodput/") for k in v[1])}
+    if not ranks_with:
+        print("no goodput gauges found — was the monitor enabled? "
+              "(PADDLE_MONITOR=run.jsonl; the accounting plane rides the "
+              "monitor session)", file=out)
+        return 1
+
+    print("== goodput report ==", file=out)
+    pod_vals = {s: 0.0 for s in STATES}
+    pod_total = 0.0
+    fractions = {}
+    for rank, (records, gauges) in ranks_with.items():
+        vals, total, fraction = _breakdown(gauges)
+        fractions[rank] = fraction
+        for s in STATES:
+            pod_vals[s] += vals[s]
+        pod_total += total
+        print(f"\n-- rank {rank} --", file=out)
+        _render_table(vals, total, fraction, out)
+
+    if len(ranks_with) > 1:
+        worst = min(fractions, key=fractions.get)
+        print(f"\n-- pod roll-up ({len(ranks_with)} ranks) --", file=out)
+        _render_table(pod_vals, pod_total,
+                      pod_vals["productive"] / pod_total if pod_total else 0,
+                      out)
+        print(f"  pod goodput {fractions[worst]:.1%} (min over ranks — "
+              f"rank {worst} is the floor)", file=out)
+
+    # ---- MFU / HFU per executable bucket
+    rows = []
+    seen = set()
+    for rank, (records, gauges) in ranks_with.items():
+        for r in records:
+            if r.get("kind") != "exec_cost":
+                continue
+            key = (rank, r.get("label"))
+            if key in seen:
+                # a re-mint overwrites: keep the newest entry per label
+                rows = [row for row in rows if (row[0], row[1]) != key]
+            seen.add(key)
+            rows.append((rank, r.get("label"), r.get("flops"),
+                         r.get("analytic_flops"), r.get("bytes"),
+                         bool(r.get("recompute"))))
+    multi = len(ranks_with) > 1
+    if rows:
+        print("\n-- FLOP ledger (per executable bucket) --", file=out)
+        print(f"  {'bucket':<22}{'measured/call':>14}{'analytic/call':>14}"
+              f"{'bytes/call':>12}  note", file=out)
+        for rank, label, flops, analytic, nbytes, rec in rows:
+            note = []
+            if rec:
+                note.append("recompute: measured includes replays (HFU "
+                            "source; MFU uses analytic)")
+            elif flops and analytic:
+                note.append(f"measured/analytic {flops / analytic:.2f}x")
+            tagged = (f"[p{rank}] " if multi else "") + str(label)
+            print(f"  {tagged:<22}{_fmt_flops(flops):>14}"
+                  f"{_fmt_flops(analytic):>14}"
+                  f"{_fmt_bytes(nbytes):>12}  {'; '.join(note)}", file=out)
+    for rank, (records, gauges) in ranks_with.items():
+        mfu, hfu = gauges.get("mfu/mfu"), gauges.get("mfu/hfu")
+        if mfu is not None or hfu is not None:
+            tagged = f"rank {rank}: " if multi else ""
+            peak = gauges.get("mfu/peak_flops")
+            print(f"  {tagged}MFU {mfu:.3f}  HFU {hfu:.3f}"
+                  + (f"  (peak {_fmt_flops(peak)}/s)" if peak else "")
+                  + ("  << HFU>MFU: recompute replays on the hot path"
+                     if hfu and mfu and hfu > mfu * 1.01 else ""),
+                  file=out)
+        fpt = gauges.get("serve/model_flops_per_token")
+        tps = gauges.get("serve/tokens_per_s_chip")
+        if fpt or tps:
+            tagged = f"rank {rank}: " if multi else ""
+            print(f"  {tagged}serving: "
+                  + (f"{_fmt_flops(fpt)}/token  " if fpt else "")
+                  + (f"{tps:.1f} tokens/s/chip" if tps else ""), file=out)
+
+    # ---- top-3 goodput losses (+ the worst episode's trace id)
+    print("\n-- top goodput losses --", file=out)
+    losses = sorted(((s, pod_vals[s]) for s in STATES if s != "productive"),
+                    key=lambda kv: kv[1], reverse=True)[:3]
+    all_records = [r for rank, (records, _) in ranks_with.items()
+                   for r in records]
+    any_loss = False
+    for s, secs in losses:
+        if secs <= 0:
+            continue
+        any_loss = True
+        pct = secs / pod_total * 100 if pod_total else 0.0
+        line = f"  {s:<11}{secs:>10.3f}s {pct:>6.1f}% of wall"
+        ep = _worst_episode(all_records, s)
+        if ep is not None:
+            rec, dur = ep
+            line += f"   worst episode: {rec.get('kind')} {dur:.3f}s"
+            if rec.get("trace"):
+                line += f"  [trace {rec['trace']}]"
+        print(line, file=out)
+    if not any_loss:
+        print("  none — every accounted second was productive", file=out)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="monitor JSONL file(s) / flight dumps, one per rank")
+    args = ap.parse_args(argv)
+    return report(args.paths)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

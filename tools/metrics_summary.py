@@ -29,6 +29,12 @@ import json
 import re
 import sys
 
+# the goodput accounting plane's state timeline, in gauge-sum order — the
+# ONE copy of the contract outside paddle_tpu (must match
+# monitor/goodput.py GOODPUT_STATES; tools/goodput_report.py imports it)
+GOODPUT_STATES = ("productive", "compile", "data_wait", "ckpt", "reshard",
+                  "overhead", "idle")
+
 
 def load_records(path):
     """Returns (event_records, final_metrics_snapshot_or_None)."""
@@ -230,6 +236,97 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       file=out)
 
     gauges_m = (metrics or {}).get("gauges", {})
+
+    # goodput accounting plane (monitor/goodput.py): the gap-free state
+    # timeline + MFU/HFU. tools/goodput_report.py is the full per-rank
+    # view; this section is the one-look health check + the two WARNs.
+    # Multi-rank: states SUM across ranks (a pod timeline) and the
+    # headline fraction follows the pod-min doctrine (the pod moves at
+    # its slowest rank's pace) — the generic max-merge above would report
+    # the BEST rank's fraction and a breakdown belonging to no rank.
+    _GOODPUT_STATES = GOODPUT_STATES
+    gp_wall = gauges_m.get("goodput/wall_s", 0)
+    if gp_wall:
+        brk_g = breakdown.get("gauges", {})
+
+        def per_rank(name):
+            per = brk_g.get(name)
+            return per if per else {0: gauges_m.get(name, 0.0)}
+
+        walls = per_rank("goodput/wall_s")
+        pod_wall = sum(walls.values())
+        classified_by_rank = {p: 0.0 for p in walls}
+        print(f"\n== goodput =="
+              + (f" (sum over {len(walls)} ranks)"
+                 if len(walls) > 1 else ""), file=out)
+        for s in _GOODPUT_STATES:
+            per = per_rank(f"goodput/{s}_s")
+            v = sum(per.values())
+            for p, pv in per.items():
+                classified_by_rank[p] = classified_by_rank.get(p, 0.0) + pv
+            if v or s in ("productive", "idle"):
+                print(f"  {s:<11}{v:>10.3f}s  "
+                      f"{v / pod_wall * 100 if pod_wall else 0:>5.1f}%"
+                      + _brk(breakdown, "gauges", f"goodput/{s}_s",
+                             lambda x: f"{x:.2f}s"), file=out)
+        fracs = per_rank("goodput/fraction")
+        if len(fracs) > 1:
+            worst = min(fracs, key=fracs.get)
+            print(f"  pod goodput {fracs[worst]:.1%} (min over ranks — "
+                  f"rank {worst} is the floor) over {pod_wall:.3f}s "
+                  f"summed wall"
+                  + _brk(breakdown, "gauges", "goodput/fraction",
+                         lambda x: f"{x:.1%}"), file=out)
+        else:
+            print(f"  goodput fraction "
+                  f"{next(iter(fracs.values()), 0):.1%} over "
+                  f"{pod_wall:.3f}s wall", file=out)
+        # lost-accounting signature: the per-state gauges are refreshed on
+        # every publish/snapshot, so each rank's classified sum tracks that
+        # RANK's own record span (not the merged global one — a rank whose
+        # monitor session started later, e.g. an elastic restart, is
+        # healthy at a shorter span); a rank well short of its span means
+        # its ledger stopped being fed/refreshed and the breakdown above
+        # is a partial view
+        rank_span = {}
+        for r in all_records:
+            ts = r.get("ts")
+            if ts is None:
+                continue
+            lo, hi = rank_span.get(r["_proc"], (ts, ts))
+            rank_span[r["_proc"]] = (min(lo, ts), max(hi, ts))
+        for p, classified in sorted(classified_by_rank.items()):
+            lo, hi = rank_span.get(p, (0.0, 0.0))
+            span_p = hi - lo
+            if span_p > 1.0 and classified < 0.95 * span_p:
+                tag_r = f"rank {p}: " if len(walls) > 1 else ""
+                print(f"  WARNING: {tag_r}classified time "
+                      f"{classified:.1f}s covers only "
+                      f"{classified / span_p:.0%} of the rank's record "
+                      f"span {span_p:.1f}s — lost-accounting signature "
+                      f"(the goodput ledger went stale mid-run; gauges "
+                      f"above are a partial view)", file=out)
+        mfu = gauges_m.get("mfu/mfu")
+        hfu = gauges_m.get("mfu/hfu")
+        if mfu is not None and hfu is not None:
+            print(f"  MFU {mfu:.3f}  HFU {hfu:.3f}"
+                  + ("  (recompute replays on the hot path)"
+                     if hfu > mfu * 1.01 else ""), file=out)
+            # the hardware executes AT LEAST the model's FLOPs; a model
+            # utilization above hardware utilization is arithmetic that
+            # cannot happen — an accounting bug, not a measurement
+            if mfu > hfu * (1 + 1e-9):
+                print(f"  WARNING: MFU {mfu:.4f} > HFU {hfu:.4f} — "
+                      f"impossible inversion (model FLOPs cannot exceed "
+                      f"hardware FLOPs); the FLOP ledger is misattributing "
+                      f"(accounting bug)", file=out)
+        if gauges_m.get("serve/model_flops_per_token"):
+            print(f"  serving: "
+                  f"{gauges_m['serve/model_flops_per_token'] / 1e6:.2f}MF"
+                  f"/token  "
+                  f"{gauges_m.get('serve/tokens_per_s_chip', 0):.1f} "
+                  f"tokens/s/chip", file=out)
+
     world = gauges_m.get("shard/world_size", 0)
     if world > 1:
         accum = gauges_m.get("shard/accum_bytes", 0)
