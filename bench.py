@@ -260,7 +260,17 @@ def main_decode(argv=()):
     keeps the block table replicated. On a CPU host the mesh is virtual
     (the host-platform device-count flag is set before jax initializes);
     on a real TPU the first N chips form the mesh. The best-so-far line
-    then carries per-chip tokens/s and the prefix-cache hit rate."""
+    then carries per-chip tokens/s and the prefix-cache hit rate.
+
+    ``--chaos`` (requires ``--paged``) measures throughput UNDER FAULT: a
+    fixed PADDLE_SERVE_FAULT-style schedule injects slow decodes, pager
+    alloc failures (deterministic preemption pressure) and admission
+    faults through the guardrails seam, every 6th request carries an
+    impossible deadline (guaranteed expiry) and every 9th is cancelled
+    mid-flight; after the last window the engine drains. The best-so-far
+    line gains ``chaos``/``expired``/``cancelled`` so the driver can see
+    p95 TTFT and throughput degradation under fault next to the clean
+    number — the line stays rc=124-safe."""
     tpf = _cli_flag(argv, "tp")
     if tpf == "":
         # space-separated form: --tp N (the = form is --tp=N)
@@ -291,10 +301,15 @@ def main_decode(argv=()):
     from paddle_tpu.serving import DecodeEngine
 
     paged = _cli_flag(argv, "paged") is not None
+    chaos = _cli_flag(argv, "chaos") is not None
     tiny = bool(os.environ.get("BENCH_TINY"))
     if tp > 1 and not paged:
         print("--tp requires --paged (the row cache is single-chip); "
               "enabling --paged", file=sys.stderr)
+        paged = True
+    if chaos and not paged:
+        print("--chaos requires --paged (the fault seam's alloc site lives "
+              "in the BlockPager); enabling --paged", file=sys.stderr)
         paged = True
 
     paddle.seed(0)
@@ -317,10 +332,21 @@ def main_decode(argv=()):
         shard_gpt_tp(model)
 
     slots, horizon = (4, 64) if tiny else (16, 256)
+    faults = None
+    if chaos:
+        from paddle_tpu.serving import FaultSchedule
+        # fixed schedule (the whole point: reproducible chaos): slow
+        # decodes exercise the stall path, alloc denials inject
+        # deterministic pool pressure (preemption), an admission fault
+        # fails one request cleanly
+        faults = FaultSchedule.parse(
+            "slow@decode:3:0.01,slow@decode:11:0.01,"
+            "raise@alloc:6,raise@alloc:17,raise@alloc:40,raise@admit:5")
     if paged:
         engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
                               paged=True, block_size=16,
-                              prefill_chunk=16 if tiny else 32)
+                              prefill_chunk=16 if tiny else 32,
+                              fault_schedule=faults)
     else:
         engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
                               paged=False,
@@ -341,10 +367,16 @@ def main_decode(argv=()):
             n = int(rng.randint(lo, hi + 1))
             prompt = sys_prefix + rng.randint(
                 0, cfg.vocab_size, n - len(sys_prefix)).tolist()
+            kw = {}
+            if chaos and n_submitted[0] % mod_e == mod_e - 1:
+                kw["deadline_s"] = 0.0     # guaranteed expiry at next step
             r = engine.submit(prompt,
                               max_new_tokens=int(rng.randint(
-                                  horizon // 4, horizon // 2)))
+                                  horizon // 4, horizon // 2)), **kw)
             reqs.append(r)
+            all_reqs.append(r)       # never pruned: the drain-gate census
+            if chaos and n_submitted[0] % mod_c == mod_c - 1:
+                cancel_next.append(r)      # cancelled after the next step
             n_submitted[0] += 1
 
     def drain_ttfts():
@@ -353,7 +385,13 @@ def main_decode(argv=()):
         reqs[:] = [r for r in reqs if r.t_first_token is None]
 
     reqs = []
+    all_reqs = []      # every submission (drain_ttfts prunes reqs)
     n_submitted = [0]
+    cancel_next = []
+    # chaos cadence: every mod_e-th request carries an impossible deadline,
+    # every mod_c-th is cancelled mid-flight (tiny runs submit ~5 requests,
+    # so the cadence tightens to keep both paths exercised)
+    mod_e, mod_c = (3, 4) if tiny else (6, 9)
     # warmup: fill all slots and step until the first decode ran — by then
     # every executable (chunk/prefill + decode) is minted
     refill()
@@ -370,13 +408,20 @@ def main_decode(argv=()):
         for _ in range(iters):
             refill()
             engine.step()   # host readback of the step's tokens syncs
+            while cancel_next:
+                engine.cancel(cancel_next.pop())
         dt = time.time() - t0
         drain_ttfts()
         best = max(best, (engine.tokens_generated - tok0) / dt)
         q = (lambda v, p: float(np.percentile(v, p)) if v else None)
         chips = max(tp, 1)
         pager = engine._pager if paged else None
-        print(json.dumps(dict(_fleet_fields(), **_trace_fields(), **{
+        chaos_fields = ({"chaos": True, "expired": engine.expired,
+                         "cancelled": engine.cancelled,
+                         "preemptions": engine.preemptions}
+                        if chaos else {})
+        print(json.dumps(dict(_fleet_fields(), **_trace_fields(),
+                              **chaos_fields, **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best / chips, 1),
             "unit": "tokens/s (decode)",
@@ -400,6 +445,29 @@ def main_decode(argv=()):
             "window": w,
         })))
         sys.stdout.flush()
+    if chaos:
+        # finish the story: the engine must also DRAIN cleanly after the
+        # fault storm (door closes, live slots finish within grace) and
+        # the pager's invariants must hold — printed as a final JSON line
+        # so the driver sees survival, not just throughput
+        t0 = time.time()
+        engine.drain(grace_s=30.0)
+        engine._pager.check_invariants()
+        terminal = sum(r.finished for r in all_reqs)
+        print(json.dumps({
+            "metric": "decode_chaos_drain",
+            "drained": engine.drained,
+            "drain_s": round(time.time() - t0, 3),
+            "submitted": n_submitted[0],
+            "terminal": terminal,
+            "expired": engine.expired,
+            "cancelled": engine.cancelled,
+            "preemptions": engine.preemptions,
+            "invariants": "ok",
+        }))
+        sys.stdout.flush()
+        assert terminal == len(all_reqs), \
+            f"{len(all_reqs) - terminal} request(s) not terminal after drain"
 
 
 if __name__ == "__main__":

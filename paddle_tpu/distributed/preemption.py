@@ -19,6 +19,13 @@ the watcher directly::
                 train_step.save_checkpoint(ckpt_dir, step, block=True)
                 break
 
+Serving uses the same watcher for graceful drain:
+``DecodeEngine.drain_on_preemption(grace_s=...)`` installs (or adopts) it,
+and the engine's next step boundary after SIGTERM begins a drain — the
+door answers ``rejected_draining``, live requests finish or expire within
+the grace budget, and the process exits clean instead of dying mid-token
+(tests/test_serve_drain_e2e.py).
+
 Signal handlers install on the MAIN thread only (CPython restriction);
 elsewhere ``install()`` degrades to a no-op watcher that never fires, so
 library code can install unconditionally.
@@ -32,7 +39,7 @@ from typing import Callable, Optional, Sequence
 
 from .. import monitor as _monitor
 
-__all__ = ["PreemptionWatcher", "install", "requested", "clear"]
+__all__ = ["PreemptionWatcher", "install", "requested", "clear", "get"]
 
 _DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
@@ -168,3 +175,12 @@ def requested() -> bool:
 def clear():
     if _global is not None:
         _global.clear()
+
+
+def get() -> Optional[PreemptionWatcher]:
+    """The process-wide watcher, or None if install() was never called —
+    lets tooling observe preemption state without installing handlers as
+    a side effect. (install() itself is idempotent and returns the same
+    watcher, which is how DecodeEngine.drain_on_preemption shares it with
+    a training loop's AutoCheckpoint.)"""
+    return _global

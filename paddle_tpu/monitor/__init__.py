@@ -509,17 +509,21 @@ class Monitor:
                   compile_s=compile_s, count=count, engine=engine_id)
 
     def serve_request(self, queued: bool, error: Optional[str] = None,
-                      overload: bool = False):
+                      overload: bool = False, draining: bool = False):
         """submit() outcome: admitted to the queue, or rejected at the door
         (malformed requests never reach a slot; ``overload`` marks a
-        well-formed request bounced off a full admission queue)."""
+        well-formed request bounced off a full admission queue;
+        ``draining`` one bounced off a draining engine's closed door)."""
         if queued:
             self.registry.counter("serve/requests").inc()
         else:
             self.registry.counter("serve/rejected").inc()
             if overload:
                 self.registry.counter("serve/rejected_overload").inc()
-            self.emit("serve_reject", error=error, overload=overload)
+            if draining:
+                self.registry.counter("serve/rejected_draining").inc()
+            self.emit("serve_reject", error=error, overload=overload,
+                      draining=draining)
 
     def serve_queue_wait(self, wait_s: float):
         """Time a request sat in the admission queue before its slot
@@ -629,6 +633,59 @@ class Monitor:
         self.registry.histogram("serve/request_tokens").observe(n_tokens)
         self.emit("serve_done", tokens=n_tokens, total_s=total_s,
                   status=status)
+
+    # ------------------------------------------ integration: serving guardrails
+
+    def serve_expired(self, where: str, preemptions: int = 0,
+                      tokens: int = 0, trace_id=None):
+        """A request's deadline passed at a step boundary (terminal status
+        "expired"); ``where`` names the state it died in (queue / prefill /
+        decode / drain). ``preemptions > 0`` on expiry events is the
+        pool-thrash signature metrics_summary WARNs on: requests are
+        losing their deadline budget to eviction-and-recompute churn, so
+        raise kv_blocks or lower deadlines. ``trace_id``: the expired
+        request's own trace."""
+        self.registry.counter("serve/expired").inc()
+        fields = dict(where=where, preemptions=int(preemptions),
+                      tokens=int(tokens))
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("serve_expire", **fields)
+
+    def serve_cancelled(self, where: str, trace_id=None):
+        """engine.cancel() terminalized a request (queue / prefill /
+        decode); its slot and blocks are already released."""
+        self.registry.counter("serve/cancelled").inc()
+        fields = dict(where=where)
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("serve_cancel", **fields)
+
+    def serve_drain_begin(self, live: int, queued: int,
+                          grace_s: Optional[float]):
+        """The engine's door closed (begin_drain): ``live`` slots get the
+        grace budget, ``queued`` requests bounce as rejected_draining."""
+        self.emit("serve_drain_begin", live=int(live), queued=int(queued),
+                  grace_s=grace_s)
+
+    def serve_drain_end(self, wall_s: float):
+        """Drain complete: nothing in flight. serve/drained counts drain
+        OPERATIONS (per-request outcomes live in completions / expired /
+        rejected_draining)."""
+        self.registry.counter("serve/drained").inc()
+        self.emit("serve_drain_end", wall_s=wall_s)
+
+    def serve_hang(self, kind: str, bucket, elapsed_s: float, hang_s: float,
+                   engine_id=None, trace_ids=()):
+        """The dispatch watchdog caught a decode/chunk call exceeding
+        PADDLE_SERVE_HANG_S — emitted FROM the watchdog thread while the
+        dispatch is still stuck, so the evidence outlives a wedged
+        process. ``trace_ids``: the live requests' traces (escalated past
+        head sampling by the caller)."""
+        self.registry.counter("serve/hang_warns").inc()
+        self.emit("serve_hang", path=kind, bucket=bucket,
+                  elapsed_s=elapsed_s, hang_s=hang_s, engine=engine_id,
+                  traces=list(trace_ids))
 
     # -------------------------------------------------- integration: profiler
 

@@ -19,14 +19,27 @@ repeated system prompts prefill once per process, not once per burst.
     eng.run()                      # or eng.step() inside a serving loop
     print(req.output_tokens)
 
+Failure behavior is a specified contract, not an accident (the guardrail
+plane): per-request deadlines (``submit(..., ttft_deadline_s=,
+deadline_s=)``), ``cancel()`` from any state, graceful ``drain()`` wired
+to SIGTERM via ``drain_on_preemption()``, a dispatch watchdog that WARNs
+and fails loudly on a wedged executable call, and the
+``PADDLE_SERVE_FAULT`` chaos seam (guardrails.py) that makes every
+failure path deterministically testable. Every request ends in exactly
+one ``TERMINAL_STATUSES`` member.
+
 Telemetry: ``serve/*`` counters/gauges/histograms in ``paddle_tpu.monitor``
-(QPS, TTFT, per-token latency, slot occupancy, executable mints).
+(QPS, TTFT, per-token latency, slot occupancy, executable mints,
+expired/cancelled/drained/hang_warns).
 """
 from .engine import (DecodeEngine, Request, generate_via_engine,
                      quantize_for_serving)
+from .guardrails import (DispatchWatchdog, EngineHangError, FaultSchedule,
+                         InjectedFault)
 from .pager import BlockPager
-from .scheduler import AdmissionQueue, SlotAllocator
+from .scheduler import TERMINAL_STATUSES, AdmissionQueue, SlotAllocator
 
 __all__ = ["DecodeEngine", "Request", "generate_via_engine",
            "quantize_for_serving", "AdmissionQueue", "SlotAllocator",
-           "BlockPager"]
+           "BlockPager", "TERMINAL_STATUSES", "FaultSchedule",
+           "InjectedFault", "DispatchWatchdog", "EngineHangError"]

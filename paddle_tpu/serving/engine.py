@@ -64,6 +64,7 @@ fp accumulation, same executables, same zero-recompile contract.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from collections import namedtuple
 from typing import List, Optional, Sequence
@@ -79,11 +80,14 @@ from ..core.tensor import Tensor
 from ..distributed.env import get_mesh
 from ..models.gpt import (_lm_head_logits, _pick_token,
                           _resolve_decode_horizon, set_paged_kv_sharding)
+from .guardrails import (HANG_ENV, DispatchWatchdog, EngineHangError,
+                         FaultSchedule, InjectedFault)
 from .pager import TRASH_BLOCK, BlockPager
-from .scheduler import AdmissionQueue, Request, SlotAllocator
+from .scheduler import (TERMINAL_STATUSES, AdmissionQueue, Request,
+                        SlotAllocator)
 
 __all__ = ["DecodeEngine", "Request", "generate_via_engine",
-           "quantize_for_serving"]
+           "quantize_for_serving", "EngineHangError", "TERMINAL_STATUSES"]
 
 
 ModelSpec = namedtuple("ModelSpec", [
@@ -220,6 +224,12 @@ class DecodeEngine:
       do_sample/temperature/top_k/seed
                        sampling config — STATIC per engine (baked into the
                        executables); greedy by default
+      hang_s           dispatch-watchdog threshold in seconds (default:
+                       env PADDLE_SERVE_HANG_S; 0/unset = off — CPU XLA
+                       steps legitimately take seconds under load)
+      fault_schedule   a guardrails.FaultSchedule, or None to read the
+                       PADDLE_SERVE_FAULT env (the chaos seam; production
+                       never sets either)
 
     ``submit()`` validates and queues; ``step()`` runs ONE scheduler
     iteration (admit into free slots, advance pending prefill chunks, then
@@ -227,6 +237,21 @@ class DecodeEngine:
     under ``serve/*`` when the monitor is enabled, and every minted
     executable bumps ``compile_count`` (the serving recompile sentinel —
     flat in steady state).
+
+    **Guardrails** (all host-side — no shape, no executable, no parity
+    impact when unused): per-request deadlines (``submit(...,
+    ttft_deadline_s=, deadline_s=)``, enforced at step boundaries
+    including across preemption/requeue and chunked prefill; terminal
+    status ``expired``, slot + blocks released exactly once);
+    ``cancel(req)`` from queue, mid-prefill or mid-decode (terminal
+    ``cancelled``); ``drain(grace_s=)`` / ``begin_drain()`` graceful
+    shutdown (door answers ``rejected_draining``, live slots finish or
+    expire within grace) with ``drain_on_preemption()`` wiring a
+    PreemptionWatcher so SIGTERM drains instead of dying mid-token; a
+    dispatch watchdog that WARNs + flight-dumps on a wedged decode/chunk
+    call and then fails the engine loudly; and the PADDLE_SERVE_FAULT
+    chaos seam that makes every one of those paths deterministically
+    testable.
     """
 
     _ids = itertools.count()
@@ -238,7 +263,9 @@ class DecodeEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_queue: Optional[int] = 1024,
                  quantize: Optional[str] = None, do_sample: bool = False,
-                 temperature: float = 1.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 1.0, top_k: int = 0, seed: int = 0,
+                 hang_s: Optional[float] = None,
+                 fault_schedule: Optional[FaultSchedule] = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_len < 2:
@@ -422,6 +449,39 @@ class DecodeEngine:
         self.decode_steps = 0
         self.tokens_generated = 0
         self.engine_id = next(DecodeEngine._ids)
+        # ---- guardrail plane (all host state; zero effect until used)
+        # injectable clock: deadlines and drain grace read THIS, so tests
+        # fast-forward time instead of sleeping
+        self._clock = time.time
+        self._faults = fault_schedule if fault_schedule is not None \
+            else FaultSchedule.from_env()
+        if self.paged and self._faults is not None:
+            self._pager.fault_schedule = self._faults
+        if hang_s is None:
+            try:
+                hang_s = float(os.environ.get(HANG_ENV, "0") or 0)
+            except ValueError:
+                hang_s = 0.0
+        self._watchdog = DispatchWatchdog(hang_s, self._on_hang) \
+            if hang_s and hang_s > 0 else None
+        # terminal transitions that happened OUTSIDE a step (cancel(), a
+        # failed engine's terminalizations): the next step() returns them,
+        # so pollers of step()'s return see every terminal exactly once
+        self._terminal_buf: List[Request] = []
+        # non-terminal requests carrying a deadline: the expiry sweep is
+        # O(queue + slots) per step, so it early-outs when this is empty
+        # (the common no-deadline workload pays one set check per step)
+        self._deadline_reqs: set = set()
+        self._draining = False
+        self._drain_t0: Optional[float] = None
+        self._drain_deadline: Optional[float] = None
+        self._drain_reported = False
+        self._pw = None                    # PreemptionWatcher, if wired
+        self._pw_grace_s: Optional[float] = None
+        # cumulative guardrail counters (stats() + monitor mirrors)
+        self.expired = 0
+        self.cancelled = 0
+        self.drains = 0
         mon = _monitor._active
         if mon is not None:
             mon.serve_engine(self.max_slots, self.max_len,
@@ -672,17 +732,27 @@ class DecodeEngine:
         return None
 
     def submit(self, prompt, max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None, request_id=None
-               ) -> Request:
+               eos_token_id: Optional[int] = None, request_id=None,
+               ttft_deadline_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Validate + enqueue one request. A malformed request comes back
         ``failed`` with ``error`` set and is never admitted — the live
         batch cannot be poisoned by one bad input. A well-formed request
         hitting a FULL admission queue comes back ``rejected_overload``
         (saturation is the caller's signal to back off, not the engine's
-        license to grow host memory without bound)."""
+        license to grow host memory without bound); one arriving while the
+        engine drains comes back ``rejected_draining`` (the door is
+        closed, resubmit to the replacement process).
+
+        ``ttft_deadline_s`` bounds submit -> first token; ``deadline_s``
+        bounds the whole request. Both are enforced at step boundaries —
+        expiry releases the slot and KV blocks exactly once and the
+        request ends ``expired``."""
         try:
             req = Request(prompt, max_new_tokens=max_new_tokens,
-                          eos_token_id=eos_token_id, request_id=request_id)
+                          eos_token_id=eos_token_id, request_id=request_id,
+                          ttft_deadline_s=ttft_deadline_s,
+                          deadline_s=deadline_s)
         except (TypeError, ValueError, OverflowError) as e:
             # the fallback Request must not re-raise: pin every field to a
             # known-safe value (the original bad ones live in the message)
@@ -722,6 +792,16 @@ class DecodeEngine:
             self._reject(req, f"prompt length {n} exceeds the largest "
                               f"prefill bucket "
                               f"({self.prefill_buckets[-1]})")
+        elif self._draining:
+            req.status, req.error = "rejected_draining", \
+                "engine is draining (shutdown in progress)"
+            req.t_done = time.time()
+            mon = _monitor._active
+            if mon is not None:
+                mon.serve_request(queued=False, error=req.error,
+                                  draining=True)
+            if req._trace is not None:
+                req._trace.end(status="rejected_draining", error=req.error)
         elif not self._queue.push(req):
             req.status, req.error = "rejected_overload", \
                 f"admission queue full ({self._queue.max_queue})"
@@ -733,6 +813,8 @@ class DecodeEngine:
             if req._trace is not None:
                 req._trace.end(status="rejected_overload", error=req.error)
         else:
+            if req.ttft_deadline_s is not None or req.deadline_s is not None:
+                self._deadline_reqs.add(req)
             mon = _monitor._active
             if mon is not None:
                 mon.serve_request(queued=True)
@@ -765,23 +847,34 @@ class DecodeEngine:
         return len(self._queue)
 
     def step(self) -> List[Request]:
-        """ONE iteration of continuous batching: fold queued prompts into
-        free slots, advance every in-flight chunked prefill by at most
-        ``prefill_chunk`` tokens, then decode every live slot one token.
-        Returns the requests that finished during this step."""
+        """ONE iteration of continuous batching: enforce deadlines and
+        drain state, fold queued prompts into free slots, advance every
+        in-flight chunked prefill by at most ``prefill_chunk`` tokens,
+        then decode every live slot one token. Returns every request that
+        reached a TERMINAL status since the last step (done / failed /
+        expired / cancelled / rejected_draining — one list, one contract).
+        """
         mon = _monitor._active
         # goodput bracket: the whole scheduler iteration; the executable
         # calls inside classify as productive/compile, the remainder is
         # engine host overhead — the serving timeline stays gap-free
         sched_t0 = time.perf_counter() if mon is not None else None
         finished: List[Request] = []
-        while self._queue and self._slots.n_free:
-            if self.paged:
-                if not self._try_admit_paged(self._queue.peek()):
-                    break          # head-of-line waits for blocks, FIFO kept
-                self._queue.pop()
-            else:
-                self._admit(self._queue.pop(), self._slots.alloc(), finished)
+        if self._terminal_buf:
+            # cancel()/engine-failure terminalizations since the last step
+            finished.extend(self._terminal_buf)
+            self._terminal_buf.clear()
+        # SIGTERM wiring: the watcher recorded a signal -> begin draining
+        # at THIS step boundary (never mid-executable-call)
+        if not self._draining and self._pw is not None \
+                and self._pw.requested():
+            self.begin_drain(self._pw_grace_s)
+        now = self._clock()
+        self._expire_sweep(now, finished)
+        if self._draining:
+            self._drain_step(now, finished)
+        else:
+            self._admit_queued(finished)
         if self._prefilling:
             for slot in sorted(self._prefilling,
                                key=lambda s: self._slot_seq[s]):
@@ -789,23 +882,366 @@ class DecodeEngine:
                     self._advance_prefill(slot, finished)
         if self._live.any():
             self._decode(finished)
+        if self._draining and self.drained and not self._drain_reported:
+            self._drain_reported = True
+            self.drains += 1
+            mon2 = _monitor._active
+            if mon2 is not None:
+                mon2.serve_drain_end(self._clock() - (self._drain_t0 or now))
         if sched_t0 is not None and mon is _monitor._active:
             mon.serve_sched(sched_t0, time.perf_counter())
         return finished
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
-        """Drain: step until queue and slots are empty. ``max_steps`` is a
-        hard budget — exactly that many scheduler iterations run before the
-        undrained engine raises."""
+        """Drain the work queue: step until queue and slots are empty.
+        ``max_steps`` is a hard budget — exactly that many scheduler
+        iterations run before the undrained engine raises."""
         out: List[Request] = []
         steps = 0
-        while self._queue or self._live.any() or self._prefilling:
+        while self._queue or self._live.any() or self._prefilling \
+                or self._terminal_buf:
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(
                     f"run() exceeded max_steps={max_steps} with "
                     f"{len(self._queue)} queued / {self.live_count} live")
             out.extend(self.step())
             steps += 1
+        return out
+
+    def _admit_queued(self, finished: List[Request]):
+        """Fold queued prompts into free slots (the admission half of
+        step()). The "admit" fault site counts ATTEMPTS — a blocked
+        head-of-line request retrying every step keeps counting — and an
+        injected raise fails just that request, cleanly."""
+        while self._queue and self._slots.n_free:
+            head = self._queue.peek()
+            if self._faults is not None:
+                try:
+                    self._faults.fire("admit")
+                except InjectedFault as e:
+                    self._queue.pop()
+                    self._terminalize(head, "failed", str(e), finished)
+                    continue
+            if self.paged:
+                if not self._try_admit_paged(head):
+                    break          # head-of-line waits for blocks, FIFO kept
+                self._queue.pop()
+            else:
+                self._admit(self._queue.pop(), self._slots.alloc(), finished)
+
+    # ----------------------------------------------------------- guardrails
+
+    def _release_slot_state(self, slot: int):
+        """Return ``slot`` to the allocator and zero its host row — the ONE
+        release path shared by finish / preempt / expire / cancel / engine
+        failure, so a request's blocks can never be released twice (the
+        pager decrefs exactly once; registered blocks re-park in the
+        prefix LRU with refcounts intact)."""
+        self._prefilling.pop(slot, None)
+        self._live[slot] = False
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._slot_req[slot] = None
+        if self.paged:
+            self._pager.release_slot(slot)
+        self._slots.release(slot)
+
+    def _terminalize(self, req: Request, status: str, why: str,
+                     finished: Optional[List[Request]], where: str = None):
+        """Move ``req`` (queue position / slot already released by the
+        caller) to a terminal status, closing its trace and telemetry.
+        ``finished=None`` buffers it for the next step() return instead
+        (transitions made between steps, e.g. cancel())."""
+        assert status in TERMINAL_STATUSES and not req.finished
+        self._deadline_reqs.discard(req)
+        req.status, req.error = status, why
+        req.slot = None
+        req.t_done = time.time()
+        (self._terminal_buf if finished is None else finished).append(req)
+        mon = _monitor._active
+        trace_id = req._trace.trace_id if req._trace is not None else None
+        if mon is not None:
+            # dedicated counters, not serve/completions — the summary's
+            # "completed" stays stop-condition completions, and requests
+            # still add up: completed + rejected + expired + cancelled
+            if status == "expired":
+                mon.serve_expired(where or "?", preemptions=req.preemptions,
+                                  tokens=len(req.tokens),
+                                  trace_id=trace_id)
+            elif status == "cancelled":
+                mon.serve_cancelled(where or "?", trace_id=trace_id)
+            elif status == "rejected_draining":
+                mon.serve_request(queued=False, error=why, draining=True)
+        if req._trace is not None:
+            mono = time.perf_counter()
+            req._trace_phase(None, t0=mono)
+            req._trace.end(t1=mono, status=status, error=why,
+                           tokens=len(req.tokens),
+                           preemptions=req.preemptions)
+        if status == "expired":
+            self.expired += 1
+        elif status == "cancelled":
+            self.cancelled += 1
+
+    def _expire_sweep(self, now: float, finished: List[Request]):
+        """Enforce deadlines at the step boundary, across every state a
+        request can be in: queued (a preempted/requeued request included —
+        its blocks were already released at preemption), mid-chunked-
+        prefill, and decoding. Slot + pager blocks release exactly once.
+        Early-outs when no live request carries a deadline — the common
+        workload pays one set check, not an O(queue+slots) scan."""
+        if not self._deadline_reqs:
+            return
+        for req in [r for r in self._queue if r.deadline_exceeded(now)]:
+            which = req.deadline_exceeded(now)
+            if self._queue.remove(req):
+                self._terminalize(req, "expired",
+                                  f"{which} deadline exceeded in queue",
+                                  finished, where="queue")
+        for slot in [s for s, st in list(self._prefilling.items())
+                     if st.req.deadline_exceeded(now)]:
+            st = self._prefilling[slot]
+            which = st.req.deadline_exceeded(now)
+            self._release_slot_state(slot)
+            self._terminalize(st.req, "expired",
+                              f"{which} deadline exceeded mid-prefill "
+                              f"({st.done}/{st.n} tokens cached)",
+                              finished, where="prefill")
+        for slot in range(self.max_slots):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            which = req.deadline_exceeded(now)
+            if which is not None:
+                self._release_slot_state(slot)
+                self._terminalize(req, "expired",
+                                  f"{which} deadline exceeded mid-decode "
+                                  f"({len(req.tokens)} tokens out)",
+                                  finished, where="decode")
+
+    def cancel(self, req) -> bool:
+        """Cancel one request wherever it is — queued, mid-prefill, or
+        mid-decode. Takes the Request or its ``.id``. True when the
+        request was live and is now terminal ``cancelled`` (slot + blocks
+        released); False when it was already terminal or unknown. Takes
+        effect immediately (host state only, safe between steps); the
+        next step() includes it in the returned terminal list."""
+        if not isinstance(req, Request):
+            rid, req = req, None
+            for cand in list(self._queue) \
+                    + [st.req for st in self._prefilling.values()] \
+                    + [r for r in self._slot_req if r is not None]:
+                if cand.id == rid:
+                    req = cand
+                    break
+            if req is None:
+                return False
+        if req.finished:
+            return False
+        if self._queue.remove(req):
+            self._terminalize(req, "cancelled", "cancelled while queued",
+                              None, where="queue")
+            return True
+        for slot, st in list(self._prefilling.items()):
+            if st.req is req:
+                self._release_slot_state(slot)
+                self._terminalize(req, "cancelled",
+                                  "cancelled mid-prefill", None,
+                                  where="prefill")
+                return True
+        for slot in range(self.max_slots):
+            if self._slot_req[slot] is req:
+                self._release_slot_state(slot)
+                self._terminalize(req, "cancelled",
+                                  "cancelled mid-decode", None,
+                                  where="decode")
+                return True
+        return False                     # not this engine's request
+
+    # --------------------------------------------------------------- drain
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """Drain complete: the door is closed and nothing is in flight."""
+        return self._draining and not self._queue and not self._prefilling \
+            and not self._live.any() and not self._terminal_buf
+
+    def begin_drain(self, grace_s: Optional[float] = None):
+        """Close the door (further submits answer ``rejected_draining``),
+        bounce the waiting queue, and let live slots finish — or expire
+        them once ``grace_s`` runs out. Idempotent; takes effect at step
+        boundaries. Use ``drain()`` to also run the steps."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_reported = False
+        self._drain_t0 = self._clock()
+        self._drain_deadline = None if grace_s is None \
+            else self._drain_t0 + float(grace_s)
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_drain_begin(self.live_count + len(self._prefilling),
+                                  len(self._queue), grace_s)
+
+    def _drain_step(self, now: float, finished: List[Request]):
+        """The draining replacement for admission: every still-queued
+        request leaves as ``rejected_draining`` (a preemption re-queue
+        during drain included — deterministic beats half-admitted), and
+        grace exhaustion expires whatever is still on a slot."""
+        for req in self._queue.drain_all():
+            self._terminalize(req, "rejected_draining",
+                              "engine is draining (shutdown in progress)",
+                              finished)
+        if self._drain_deadline is not None and now > self._drain_deadline:
+            for slot in list(self._prefilling):
+                st = self._prefilling[slot]
+                self._release_slot_state(slot)
+                self._terminalize(st.req, "expired",
+                                  "drain grace exceeded mid-prefill",
+                                  finished, where="drain")
+            for slot in range(self.max_slots):
+                req = self._slot_req[slot]
+                if req is not None:
+                    self._release_slot_state(slot)
+                    self._terminalize(req, "expired",
+                                      "drain grace exceeded mid-decode",
+                                      finished, where="drain")
+
+    def drain(self, grace_s: Optional[float] = None,
+              max_steps: Optional[int] = None) -> List[Request]:
+        """Graceful shutdown: ``begin_drain(grace_s)`` + step until
+        drained. Returns every request that reached a terminal status
+        during the drain. With a grace budget the loop is bounded by
+        construction; ``max_steps`` is the extra hard stop for the
+        unbounded (grace_s=None) form."""
+        self.begin_drain(grace_s)
+        out: List[Request] = []
+        steps = 0
+        while not self.drained:
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"drain() exceeded max_steps={max_steps} with "
+                    f"{self.live_count} live / {len(self._prefilling)} "
+                    f"prefilling")
+            out.extend(self.step())
+            steps += 1
+        return out
+
+    def drain_on_preemption(self, watcher=None,
+                            grace_s: Optional[float] = 30.0):
+        """Wire a ``distributed.PreemptionWatcher`` into the serving loop:
+        once the watcher records SIGTERM/SIGINT, the next step() begins a
+        drain with ``grace_s`` — the process finishes (or expires) its
+        live requests instead of dying mid-token. ``watcher=None``
+        installs the process-wide watcher. Returns the watcher; the
+        serving loop keeps calling step() and exits on ``drained``."""
+        if watcher is None:
+            from ..distributed import preemption as _preemption
+            watcher = _preemption.install()
+        self._pw = watcher
+        self._pw_grace_s = grace_s
+        return watcher
+
+    # ------------------------------------------------------ failure paths
+
+    def _fail_engine(self, exc: BaseException):
+        """Deterministic loud failure: a decode/chunk dispatch raised (or
+        hung past the watchdog). Every in-flight request terminalizes as
+        ``failed`` with slots and blocks released — host state stays
+        consistent (check_invariants holds) — and the exception
+        propagates out of step(); the scheduler is never silently wedged
+        and never decodes onward on a runtime it just caught misbehaving.
+        """
+        why = f"engine failed: {exc}"
+        for req in self._queue.drain_all():
+            self._terminalize(req, "failed", why, None)
+        for slot in list(self._prefilling):
+            st = self._prefilling[slot]
+            self._release_slot_state(slot)
+            self._terminalize(st.req, "failed", why, None)
+        for slot in range(self.max_slots):
+            req = self._slot_req[slot]
+            if req is not None:
+                self._release_slot_state(slot)
+                self._terminalize(req, "failed", why, None)
+        raise exc
+
+    def _on_hang(self, info: dict, elapsed_s: float):
+        """Watchdog thread: the armed dispatch exceeded hang_s and is
+        STILL STUCK. Make it loud and attributable now — escalate the
+        live requests' traces past head sampling, emit the trace-linked
+        WARN naming the executable, flight-dump the monitor ring — so the
+        evidence exists even if the call never returns."""
+        import warnings
+        traces = info.get("traces") or ()
+        for tr in traces:
+            try:
+                tr.escalate("serve_hang")
+            except Exception:
+                pass
+        trace_ids = [tr.trace_id for tr in traces]
+        mon = _monitor._active
+        dump_path = None
+        if mon is not None:
+            try:
+                mon.serve_hang(info.get("kind", "?"), info.get("bucket"),
+                               elapsed_s, self._watchdog.hang_s,
+                               engine_id=self.engine_id,
+                               trace_ids=trace_ids)
+                dump_path = mon.dump()
+            except Exception:
+                pass
+        warnings.warn(
+            f"serving dispatch hang: {info.get('kind', '?')} executable "
+            f"(engine {self.engine_id}, bucket {info.get('bucket')}) "
+            f"exceeded {HANG_ENV}={self._watchdog.hang_s}s "
+            f"({elapsed_s:.2f}s and counting); traces {trace_ids[:4]}"
+            + (f"; flight dump {dump_path}" if dump_path else ""),
+            RuntimeWarning)
+
+    def _dispatch_guarded(self, kind: str, bucket, call):
+        """Run one decode/chunk dispatch under the guardrails: the chaos
+        seam fires first (a ``slow`` lands inside the armed window — that
+        is how the watchdog is tested), the watchdog brackets the call +
+        host sync, and any exception or detected hang routes through
+        ``_fail_engine`` so the engine fails loudly with consistent
+        state. ``call`` must COMMIT the donated pools/caches to the engine
+        itself before returning — on the hang path the dispatch completed
+        (the old buffers are donated away), so the commit must not depend
+        on this function returning normally."""
+        wd = self._watchdog
+        if wd is not None:
+            traces = [r._trace for r in self._slot_req if r is not None
+                      and r._trace is not None]
+            traces += [st.req._trace for st in self._prefilling.values()
+                       if st.req._trace is not None]
+            wd.arm(kind=kind, bucket=bucket, engine=self.engine_id,
+                   traces=traces)
+        try:
+            if self._faults is not None:
+                self._faults.fire(kind)
+            out = call()
+        except Exception as e:
+            if wd is not None:
+                # a hang that then RAISED: the raise is the failure that
+                # propagates; drop the latch so the reused engine's next
+                # healthy dispatch doesn't inherit a stale hang verdict
+                wd.fired = None
+            self._fail_engine(e)
+        finally:
+            if wd is not None:
+                wd.disarm()
+        if wd is not None and wd.fired is not None:
+            fired, wd.fired = wd.fired, None
+            self._fail_engine(EngineHangError(
+                f"{fired.get('kind', '?')} dispatch took "
+                f"{fired.get('elapsed_s', 0):.2f}s "
+                f"(> {HANG_ENV}={wd.hang_s}s); WARN + flight dump emitted "
+                f"while it hung"))
         return out
 
     # ------------------------------------------------- paged scheduling
@@ -909,11 +1345,16 @@ class DecodeEngine:
         ids[0, :end - p0] = st.prompt[p0:end]
         src, dst = self._cow_args(copies)
         t0 = time.time()
-        self._pools, tok0 = exe(
-            self._leaf_values(), self._pools,
-            self._dev(self._pager.tables), self._dev(ids),
-            self._dev(jnp.int32(slot)), self._dev(jnp.int32(p0)),
-            self._dev(jnp.int32(end)), src, dst, self._next_key())
+
+        def _call():
+            self._pools, picked = exe(
+                self._leaf_values(), self._pools,
+                self._dev(self._pager.tables), self._dev(ids),
+                self._dev(jnp.int32(slot)), self._dev(jnp.int32(p0)),
+                self._dev(jnp.int32(end)), src, dst, self._next_key())
+            return picked
+
+        tok0 = self._dispatch_guarded("chunk", sc, _call)
         chunk_s = time.time() - t0
         st.prefill_s += chunk_s
         mon = _monitor._active
@@ -970,14 +1411,9 @@ class DecodeEngine:
         """Pool pressure: evict the tenant of ``slot`` back to the FRONT of
         the queue (its blocks free immediately; its compute is redone on
         re-admission — vLLM's recompute-style preemption)."""
-        st = self._prefilling.pop(slot, None)
+        st = self._prefilling.get(slot)
         req = st.req if st is not None else self._slot_req[slot]
-        self._pager.release_slot(slot)
-        self._slots.release(slot)
-        self._live[slot] = False
-        self._pos[slot] = 0
-        self._tok[slot] = 0
-        self._slot_req[slot] = None
+        self._release_slot_state(slot)
         req.status, req.slot = "queued", None
         req.tokens = []
         req.t_first_token = None
@@ -1030,9 +1466,23 @@ class DecodeEngine:
             if req._phase is not None:
                 req._phase.set(slot=slot)
             req._trace_phase("prefill", t0=mono0, slot=slot, bucket=sb)
-        self._caches, tok0 = exe(
-            self._leaf_values(), self._caches, jnp.asarray(ids),
-            jnp.int32(slot), jnp.int32(n), self._next_key())
+        def _call():
+            self._caches, picked = exe(
+                self._leaf_values(), self._caches, jnp.asarray(ids),
+                jnp.int32(slot), jnp.int32(n), self._next_key())
+            return picked
+
+        try:
+            tok0 = self._dispatch_guarded("chunk", sb, _call)
+        except BaseException as e:
+            # the half-admitted slot is in neither _prefilling nor
+            # _slot_req yet, so _fail_engine could not release it — and
+            # its tenant must terminalize like everyone else
+            self._slots.release(slot)
+            if not req.finished:
+                self._terminalize(req, "failed", f"engine failed: {e}",
+                                  None)
+            raise
         t = int(tok0)
         dt = time.time() - t0
         req.slot, req.status = slot, "running"
@@ -1093,16 +1543,26 @@ class DecodeEngine:
             src, dst = self._cow_args(
                 [p for c in copies_by_slot.values() for p in c])
             t0 = time.time()
-            self._pools, nxt = exe(
-                self._leaf_values(), self._pools,
-                self._dev(self._pager.tables), self._dev(self._tok),
-                self._dev(self._pos), src, dst, self._next_key())
+
+            def _call():
+                self._pools, picked = exe(
+                    self._leaf_values(), self._pools,
+                    self._dev(self._pager.tables), self._dev(self._tok),
+                    self._dev(self._pos), src, dst, self._next_key())
+                # host readback inside the armed window: a hang in the
+                # device sync is a hang in the dispatch
+                return np.asarray(picked)
         else:
             t0 = time.time()
-            self._caches, nxt = exe(
-                self._leaf_values(), self._caches, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), self._next_key())
-        nxt = np.asarray(nxt)
+
+            def _call():
+                self._caches, picked = exe(
+                    self._leaf_values(), self._caches,
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    self._next_key())
+                return np.asarray(picked)
+
+        nxt = self._dispatch_guarded("decode", None, _call)
         dt = time.time() - t0
         live = 0
         for slot in range(self.max_slots):
@@ -1128,14 +1588,8 @@ class DecodeEngine:
                 mon.serve_paged(self._pager.stats(), self.kv_util())
 
     def _finish(self, req: Request, finished: List[Request]):
-        slot = req.slot
-        self._live[slot] = False
-        self._pos[slot] = 0
-        self._tok[slot] = 0
-        self._slot_req[slot] = None
-        if self.paged:
-            self._pager.release_slot(slot)
-        self._slots.release(slot)
+        self._release_slot_state(req.slot)
+        self._deadline_reqs.discard(req)
         req.status, req.t_done = "done", time.time()
         finished.append(req)
         mon = _monitor._active
@@ -1174,6 +1628,14 @@ class DecodeEngine:
             "live_slots": self.live_count,
             "queue_depth": self.queue_depth,
             "kv_util": round(self.kv_util(), 4),
+            "guardrails": {
+                "expired": self.expired,
+                "cancelled": self.cancelled,
+                "drains": self.drains,
+                "draining": self._draining,
+                "hang_warns": self._watchdog.hangs
+                if self._watchdog is not None else 0,
+            },
         }
         if self.paged:
             out["paged"] = dict(self._pager.stats().as_dict(),
@@ -1181,6 +1643,13 @@ class DecodeEngine:
                                 preemptions=self.preemptions,
                                 prefilling=len(self._prefilling))
         return out
+
+    def close(self):
+        """Stop the watchdog thread (daemonized, so this is hygiene, not
+        correctness — long-lived engines can skip it)."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
 
 
 def generate_via_engine(lm, input_ids, max_new_tokens: int = 32,

@@ -117,6 +117,11 @@ class BlockPager:
         # per-admission scratch the engine reads right after share_prefix
         self.last_adopt_parked = 0
         self.last_adopt_parked_tokens = 0
+        # PADDLE_SERVE_FAULT chaos seam (serving/guardrails.py): the engine
+        # installs its FaultSchedule here; an injected "raise" at the alloc
+        # site manifests as deterministic pool exhaustion (the failure the
+        # callers actually handle), never as a propagating exception
+        self.fault_schedule = None
         # cumulative telemetry (monitor gauges/counters read these)
         self.cow_copies = 0
         self.shared_hits = 0          # admissions that adopted >= 1 block
@@ -209,6 +214,12 @@ class BlockPager:
     # ------------------------------------------------------------ allocation
 
     def _alloc_block(self) -> Optional[int]:
+        if self.fault_schedule is not None:
+            from .guardrails import InjectedFault
+            try:
+                self.fault_schedule.fire("alloc")
+            except InjectedFault:
+                return None        # scripted exhaustion: callers evict/defer
         if self._free:
             blk = self._free.pop()
         elif self._lru:

@@ -15,29 +15,61 @@ import time
 from collections import deque
 from typing import List, Optional
 
-__all__ = ["Request", "SlotAllocator", "AdmissionQueue"]
+__all__ = ["Request", "SlotAllocator", "AdmissionQueue",
+           "TERMINAL_STATUSES"]
+
+# THE terminal-status set: a request in any of these states will never
+# change again — no slot, no queued position, no pending work. One copy,
+# shared by ``Request.finished``, the engine's step()/run() returns, and
+# tools/metrics_summary.py accounting. (The latent poller-spin bug this
+# replaces: ``finished`` counted only done/failed, so a poller waiting on
+# a rejected_overload request spun forever.)
+TERMINAL_STATUSES = frozenset((
+    "done", "failed", "rejected_overload", "rejected_draining",
+    "expired", "cancelled"))
 
 
 class Request:
     """One generation request: prompt in, tokens out, per-request stop.
 
-    Lifecycle: ``queued`` -> ``running`` (slot assigned, first token
-    emitted by the prefill) -> ``done`` | ``failed``. A malformed request
-    (empty prompt, prompt that cannot fit the engine's ``max_len``) goes
-    straight to ``failed`` with ``error`` set — it never reaches a slot, so
-    it cannot poison the live batch.
+    Lifecycle: ``queued`` -> ``prefilling`` -> ``running`` (slot assigned,
+    first token emitted by the prefill) -> a terminal status. Terminal
+    (``TERMINAL_STATUSES``): ``done`` (stop condition), ``failed``
+    (malformed at submit, or the engine failed under it), ``rejected_
+    overload`` (full admission queue), ``rejected_draining`` (engine
+    draining), ``expired`` (deadline passed), ``cancelled``
+    (``engine.cancel``). A malformed request (empty prompt, prompt that
+    cannot fit the engine's ``max_len``) goes straight to ``failed`` with
+    ``error`` set — it never reaches a slot, so it cannot poison the live
+    batch.
+
+    Deadlines (both optional, both wall-clock seconds from ``t_submit``,
+    enforced at the engine's step boundaries — a request is never killed
+    mid-executable-call): ``ttft_deadline_s`` bounds the time to FIRST
+    token and stops applying the moment one is out; ``deadline_s`` bounds
+    the whole request and applies from submit to stop. When both are set,
+    whichever is violated first expires the request.
     """
 
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None, request_id=None):
+                 eos_token_id: Optional[int] = None, request_id=None,
+                 ttft_deadline_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None):
         self.id = request_id if request_id is not None else next(Request._ids)
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
+        self.ttft_deadline_s = None if ttft_deadline_s is None \
+            else float(ttft_deadline_s)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        for name, d in (("ttft_deadline_s", self.ttft_deadline_s),
+                        ("deadline_s", self.deadline_s)):
+            if d is not None and (d < 0 or d != d):
+                raise ValueError(f"{name} must be >= 0, got {d}")
         self.tokens: List[int] = []      # generated tokens (eos inclusive)
-        # queued|prefilling|running|done|failed|rejected_overload
+        # queued|prefilling|running | TERMINAL_STATUSES
         self.status = "queued"
         self.error: Optional[str] = None
         self.slot: Optional[int] = None
@@ -82,7 +114,18 @@ class Request:
 
     @property
     def finished(self) -> bool:
-        return self.status in ("done", "failed")
+        return self.status in TERMINAL_STATUSES
+
+    def deadline_exceeded(self, now: float) -> Optional[str]:
+        """Which deadline (if any) ``now`` violates: "ttft" while no first
+        token is out, "total" for the whole-request bound. None = alive."""
+        if self.deadline_s is not None \
+                and now - self.t_submit > self.deadline_s:
+            return "total"
+        if self.ttft_deadline_s is not None and self.t_first_token is None \
+                and now - self.t_submit > self.ttft_deadline_s:
+            return "ttft"
+        return None
 
     def _stop_hit(self) -> bool:
         """Per-request stop: eos emitted, or the token budget spent."""
@@ -149,8 +192,30 @@ class AdmissionQueue:
     def peek(self) -> Request:
         return self._q[0]
 
+    def remove(self, req: Request) -> bool:
+        """Take ``req`` out of the line wherever it sits (cancel / expiry
+        of a queued request). False when it was not queued — the caller
+        races admission, and losing that race just means the request gets
+        handled on the slotted path instead."""
+        try:
+            self._q.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def drain_all(self) -> List[Request]:
+        """Empty the queue, returning the requests in FIFO order (the
+        engine terminalizes them on drain)."""
+        out = list(self._q)
+        self._q.clear()
+        return out
+
     def __len__(self):
         return len(self._q)
 
     def __bool__(self):
         return bool(self._q)
+
+    def __iter__(self):
+        # snapshot: sweeps remove() while iterating
+        return iter(list(self._q))

@@ -1,0 +1,97 @@
+"""Subprocess worker for the SIGTERM graceful-drain e2e
+(tests/test_serve_drain_e2e.py).
+
+A minimal serving process: tiny GPT engine, PreemptionWatcher wired via
+``engine.drain_on_preemption``, a submit/step loop that keeps the slots
+hot. Prints READY once decoding, then on SIGTERM the next step boundary
+begins the drain — live requests finish (or expire within grace), late
+submissions bounce off the closed door — and the process exits rc=0 with
+a JSON summary on the last line. Dying mid-token would be rc!=0 or a
+missing summary; both fail the parent's assertions.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    grace_s = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import DecodeEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    eng = DecodeEngine(m, max_slots=2, max_len=48, block_size=8,
+                       prefill_chunk=8)
+    watcher = eng.drain_on_preemption(grace_s=grace_s)
+    rng = np.random.RandomState(0)
+    reqs = []
+
+    def refill():
+        while eng.queue_depth + eng.active_count < eng.max_slots:
+            r = eng.submit(rng.randint(1, 64, 5).tolist(),
+                           max_new_tokens=24)
+            reqs.append(r)
+
+    refill()
+    while eng.decode_steps == 0:
+        eng.step()
+    print("READY", flush=True)
+
+    rejected_draining = 0
+    deadline = time.time() + 60.0          # failsafe: never loop forever
+    while time.time() < deadline:
+        if not eng.draining:
+            refill()
+        else:
+            # the door must be CLOSED now: every late submission bounces
+            late = eng.submit(rng.randint(1, 64, 5).tolist(),
+                              max_new_tokens=4)
+            assert late.status == "rejected_draining", late.status
+            assert late.finished
+            rejected_draining += 1
+        eng.step()
+        if eng.drained:
+            break
+    else:
+        print(json.dumps({"error": "drain never completed"}), flush=True)
+        return 3
+
+    # the door stays closed after the drain too: a post-drain submission
+    # must bounce (deterministic probe — the in-loop ones race with how
+    # fast the live slots emptied)
+    late = eng.submit(rng.randint(1, 64, 5).tolist(), max_new_tokens=4)
+    assert late.status == "rejected_draining", late.status
+    assert late.finished
+    rejected_draining += 1
+
+    statuses = {}
+    for r in reqs:
+        statuses[r.status] = statuses.get(r.status, 0) + 1
+        assert r.finished, f"non-terminal request after drain: {r}"
+    eng._pager.check_invariants()
+    print(json.dumps({
+        "drained": eng.drained,
+        "signal": watcher.signum,
+        "statuses": statuses,
+        "rejected_draining_door": rejected_draining,
+        "drains": eng.drains,
+        "invariants": "ok",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

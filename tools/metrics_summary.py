@@ -519,6 +519,38 @@ def summarize(paths, show_events=False, out=sys.stdout):
                 print(f"  queue overload rejections {int(overload)} "
                       f"(admission queue saturated — callers should back "
                       f"off or the pool should grow)", file=out)
+        # guardrail plane (deadlines / cancellation / drain / watchdog):
+        # every request ends in a terminal status, and this block accounts
+        # for the non-"done" ones next to the completions above
+        expired = counters_m.get("serve/expired", 0)
+        cancelled = counters_m.get("serve/cancelled", 0)
+        drains = counters_m.get("serve/drained", 0)
+        drain_rej = counters_m.get("serve/rejected_draining", 0)
+        hangs = counters_m.get("serve/hang_warns", 0)
+        if expired or cancelled or drains or drain_rej or hangs:
+            print(f"  guardrails: expired {int(expired)}  cancelled "
+                  f"{int(cancelled)}  drains {int(drains)}  "
+                  f"rejected_draining {int(drain_rej)}  hang warns "
+                  f"{int(hangs)}", file=out)
+            # pool-thrash signature: expirations clustering with
+            # preemptions — a request that was evicted (compute redone on
+            # re-admission) and THEN blew its deadline lost the budget to
+            # pool pressure, not to its own length
+            thrash = [r for r in by_kind.get("serve_expire", [])
+                      if r.get("preemptions", 0) > 0]
+            if thrash:
+                print(f"  WARNING: {len(thrash)} expired request(s) had "
+                      f"been preempted first — pool-thrash signature "
+                      f"(eviction/recompute churn is eating deadline "
+                      f"budget; raise kv_blocks or lower deadlines)",
+                      file=out)
+        for r in by_kind.get("serve_hang", []):
+            print(f"  WARNING: {tag(r)}dispatch hang: {r.get('path', '?')} "
+                  f"executable exceeded PADDLE_SERVE_HANG_S="
+                  f"{r.get('hang_s')}s ({r.get('elapsed_s', 0):.2f}s when "
+                  f"caught)"
+                  + (f"  traces {r['traces'][:3]}" if r.get("traces")
+                     else ""), file=out)
         frag = [r for r in by_kind.get("serve_page_reject", [])
                 if r.get("free_blocks", 0) >= r.get("needed_blocks", 1)]
         if frag:
