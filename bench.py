@@ -270,7 +270,16 @@ def main_decode(argv=()):
     mid-flight; after the last window the engine drains. The best-so-far
     line gains ``chaos``/``expired``/``cancelled`` so the driver can see
     p95 TTFT and throughput degradation under fault next to the clean
-    number — the line stays rc=124-safe."""
+    number — the line stays rc=124-safe.
+
+    ``--spec[=prompt_lookup|draft_model|early_exit]`` (requires
+    ``--paged``; default drafter prompt_lookup) turns on speculative
+    decoding: each decode step drafts k tokens and verifies them in one
+    chunk-shaped dispatch, so tokens/s rises with the workload's
+    acceptance rate while greedy output stays bitwise identical. The
+    shared-prefix workload is exactly where prompt-lookup shines (the
+    output keeps re-quoting the repetitive context). The best-so-far line
+    gains ``spec``/``accepted_per_step``/``draft_hit_rate``."""
     tpf = _cli_flag(argv, "tp")
     if tpf == "":
         # space-separated form: --tp N (the = form is --tp=N)
@@ -302,6 +311,13 @@ def main_decode(argv=()):
 
     paged = _cli_flag(argv, "paged") is not None
     chaos = _cli_flag(argv, "chaos") is not None
+    spec = _cli_flag(argv, "spec")
+    if spec == "":
+        spec = "prompt_lookup"     # bare --spec: the no-model drafter
+    if spec is not None and spec not in ("prompt_lookup", "draft_model",
+                                         "early_exit"):
+        raise SystemExit(f"--spec={spec}: drafter must be prompt_lookup, "
+                         f"draft_model or early_exit")
     tiny = bool(os.environ.get("BENCH_TINY"))
     if tp > 1 and not paged:
         print("--tp requires --paged (the row cache is single-chip); "
@@ -310,6 +326,10 @@ def main_decode(argv=()):
     if chaos and not paged:
         print("--chaos requires --paged (the fault seam's alloc site lives "
               "in the BlockPager); enabling --paged", file=sys.stderr)
+        paged = True
+    if spec and not paged:
+        print("--spec requires --paged (speculative K/V lands in pager "
+              "blocks); enabling --paged", file=sys.stderr)
         paged = True
 
     paddle.seed(0)
@@ -342,11 +362,31 @@ def main_decode(argv=()):
         faults = FaultSchedule.parse(
             "slow@decode:3:0.01,slow@decode:11:0.01,"
             "raise@alloc:6,raise@alloc:17,raise@alloc:40,raise@admit:5")
+    drafter = None
+    if spec == "prompt_lookup":
+        from paddle_tpu.serving import PromptLookupDrafter
+        drafter = PromptLookupDrafter(max_n=3, min_n=1, max_k=8)
+    elif spec == "draft_model":
+        from paddle_tpu.serving import DraftModelDrafter
+        # a genuinely small draft next to the target (tiny runs halve it)
+        dsize = dict(size, num_layers=max(1, size["num_layers"] // 4),
+                     hidden_size=size["hidden_size"] // 2,
+                     num_heads=max(1, size["num_heads"] // 2))
+        dcfg = GPTConfig(hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0, **dsize)
+        dmodel = GPTForCausalLM(dcfg)
+        for _, p in dmodel.named_parameters():
+            p._data = p.value().astype("bfloat16")
+        drafter = DraftModelDrafter(dmodel, ctx_len=horizon // 4, max_k=4)
+    elif spec == "early_exit":
+        from paddle_tpu.serving import EarlyExitDrafter
+        drafter = EarlyExitDrafter(model, interval=2,
+                                   ctx_len=horizon // 4, max_k=4)
     if paged:
         engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
                               paged=True, block_size=16,
                               prefill_chunk=16 if tiny else 32,
-                              fault_schedule=faults)
+                              fault_schedule=faults, drafter=drafter)
     else:
         engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
                               paged=False,
@@ -392,9 +432,18 @@ def main_decode(argv=()):
     # every mod_c-th is cancelled mid-flight (tiny runs submit ~5 requests,
     # so the cadence tightens to keep both paths exercised)
     mod_e, mod_c = (3, 4) if tiny else (6, 9)
-    # warmup: fill all slots and step until the first decode ran — by then
-    # every executable (chunk/prefill + decode) is minted
-    refill()
+    # warmup: ONE request through prefill + first decode mints every
+    # executable (chunk + decode/verify) — filling all 16 slots first cost
+    # a full batch of prefills before the first window could start, which
+    # is why a budget-starved round used to die without emitting a line;
+    # the remaining slots fill inside the first measured window instead
+    n = int(rng.randint(lo, hi + 1))
+    r = engine.submit(sys_prefix + rng.randint(
+        0, cfg.vocab_size, n - len(sys_prefix)).tolist(),
+        max_new_tokens=int(rng.randint(horizon // 4, horizon // 2)))
+    reqs.append(r)
+    all_reqs.append(r)
+    n_submitted[0] += 1
     while engine.decode_steps == 0:
         engine.step()
     warm_compiles = engine.compile_count
@@ -420,8 +469,16 @@ def main_decode(argv=()):
                          "cancelled": engine.cancelled,
                          "preemptions": engine.preemptions}
                         if chaos else {})
+        spec_fields = ({"spec": spec,
+                        "accepted_per_step":
+                            round(engine.spec_emitted
+                                  / max(engine.spec_steps, 1), 3),
+                        "draft_hit_rate":
+                            round(engine.spec_accepted
+                                  / max(engine.spec_drafted, 1), 3)}
+                       if spec else {})
         print(json.dumps(dict(_fleet_fields(), **_trace_fields(),
-                              **chaos_fields, **{
+                              **chaos_fields, **spec_fields, **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best / chips, 1),
             "unit": "tokens/s (decode)",

@@ -509,7 +509,11 @@ class GPTModel(nn.Layer):
                 p.set_value(init(tuple(p.shape), p.dtype))
 
     def forward(self, input_ids, attn_mask=None, kv_caches=None,
-                start_pos=None, write_end=None):
+                start_pos=None, write_end=None, layer_subset=None):
+        """``layer_subset`` (non-cached path only): run just the named
+        block indices — the early-exit speculative drafter's shallow pass
+        over the same weights (the ``recompute_interval`` layer-selection
+        idiom, applied to inference depth instead of checkpoint spacing)."""
         b, s = input_ids.shape
         if kv_caches is not None:
             if isinstance(self.h, GPTScannedBlocks):
@@ -541,6 +545,10 @@ class GPTModel(nn.Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         if isinstance(self.h, GPTScannedBlocks):
+            if layer_subset is not None:
+                raise NotImplementedError(
+                    "layer_subset requires scan_layers=False (the scanned "
+                    "stack has no per-block seam to skip at)")
             x = self.h(x, attn_mask)
         else:
             gran = self.config.recompute_granularity
@@ -550,6 +558,8 @@ class GPTModel(nn.Layer):
                       and (dispatch.in_trace()
                            or dispatch.is_grad_enabled()))
             for i, block in enumerate(self.h):
+                if layer_subset is not None and i not in layer_subset:
+                    continue
                 if use_rc and i % interval == 0:
                     # block forward under the recompute policy: the compiled
                     # path drops this block's residuals per `gran` and

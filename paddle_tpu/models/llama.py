@@ -255,7 +255,10 @@ class LlamaModel(nn.Layer):
                 p.set_value(init(tuple(p.shape), p.dtype))
 
     def forward(self, input_ids, kv_caches=None, start_pos=None,
-                write_end=None):
+                write_end=None, layer_subset=None):
+        """``layer_subset`` (non-cached path only): run just the named
+        block indices — the early-exit speculative drafter's shallow pass
+        over the same weights (see GPTModel.forward)."""
         x = self.embed_tokens(input_ids)
         if kv_caches is not None:
             p0 = start_pos if start_pos is not None else jnp.int32(0)
@@ -276,6 +279,8 @@ class LlamaModel(nn.Layer):
         use_rc = (gran != "none" and self.training
                   and (dispatch.in_trace() or dispatch.is_grad_enabled()))
         for i, block in enumerate(self.layers):
+            if layer_subset is not None and i not in layer_subset:
+                continue
             if use_rc and i % interval == 0:
                 from ..distributed.fleet.recompute import recompute
                 x = recompute(block, x, policy=gran)

@@ -454,10 +454,12 @@ class Monitor:
 
     def serve_engine(self, max_slots: int, max_len: int, buckets, quantize,
                      engine_id=None, paged=None, block_size=None,
-                     kv_blocks=None, prefill_chunk=None, tp=1):
+                     kv_blocks=None, prefill_chunk=None, tp=1,
+                     drafter=None):
         """A DecodeEngine came up: record its static geometry (paged
         engines add the block pool shape and the prefill chunk size; a
-        mesh-native engine carries its tensor-parallel degree)."""
+        mesh-native engine carries its tensor-parallel degree; a
+        speculative engine names its drafter)."""
         g = self.registry.gauge
         g("serve/max_slots").set(max_slots)
         g("serve/max_len").set(max_len)
@@ -470,7 +472,8 @@ class Monitor:
         self.emit("serve_engine", max_slots=max_slots, max_len=max_len,
                   prefill_buckets=list(buckets), quantize=quantize,
                   engine=engine_id, paged=paged, block_size=block_size,
-                  kv_blocks=kv_blocks, prefill_chunk=prefill_chunk, tp=tp)
+                  kv_blocks=kv_blocks, prefill_chunk=prefill_chunk, tp=tp,
+                  drafter=drafter)
 
     def serve_compiled(self, kind: str, bucket, compile_s: float, count: int,
                        engine_id=None, compiled=None, tokens=None,
@@ -608,6 +611,54 @@ class Monitor:
         self.goodput.dispatch("serve", (engine_id, "decode", None),
                               now - dur_s, now, tokens=live,
                               generated=True)
+
+    def serve_spec_step(self, dur_s: float, drafted: int, accepted: int,
+                        emitted: int, width: int, drafter: str,
+                        live: int = 0, queue_depth: int = 0,
+                        accepted_per_step=None, hit_rate=None,
+                        engine_id=None):
+        """One speculative verify dispatch for one slot: ``drafted`` tokens
+        proposed, ``accepted`` of them agreed with the verifier, and
+        ``emitted`` tokens actually advanced the request (accepted + the
+        bonus token, clipped by eos/budget). Goodput accounting is the
+        multi-token mirror of serve_step: the verify executable ran
+        ``width`` positions (HFU bills all of them), but only ``emitted``
+        tokens are model progress — the ledger's tokens/registered-tokens
+        scaling attributes exactly the accepted fraction to MFU, so
+        rejected-draft FLOPs can never inflate utilization, and
+        serve/tokens_per_s_chip counts ACCEPTED tokens only."""
+        c = self.registry.counter
+        c("serve/spec_steps").inc()
+        c("serve/tokens").inc(emitted)
+        if drafted:
+            c("serve/spec_drafted").inc(drafted)
+            c(f"serve/spec_drafted.{drafter}").inc(drafted)
+        if accepted:
+            c("serve/spec_accepted").inc(accepted)
+            c(f"serve/spec_accepted.{drafter}").inc(accepted)
+        c(f"serve/spec_emitted.{drafter}").inc(emitted)
+        g = self.registry.gauge
+        g("serve/live_slots").set(live)
+        g("serve/queue_depth").set(queue_depth)
+        if accepted_per_step is not None:
+            g("serve/spec_accepted_per_step").set(accepted_per_step)
+        if hit_rate is not None:
+            g("serve/spec_draft_hit_rate").set(hit_rate)
+        self.registry.histogram("serve/spec_step_s").observe(dur_s)
+        now = time.perf_counter()
+        self.goodput.dispatch("serve", (engine_id, "verify", width),
+                              now - dur_s, now, tokens=emitted,
+                              generated=True)
+
+    def serve_spec(self, drafter: str, drafted: int, accepted: int,
+                   emitted: int, trace_id=None):
+        """A speculative request finished: its whole-lifetime draft ledger
+        as one event (per-step figures live in the counters above)."""
+        fields = dict(drafter=drafter, drafted=int(drafted),
+                      accepted=int(accepted), emitted=int(emitted))
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("serve_spec", **fields)
 
     def serve_prefill_step(self, dur_s: float, bucket, tokens: int,
                            engine_id=None):

@@ -207,6 +207,10 @@ class GoodputLedger:
         self._model_flops = 0.0
         self._serve_tokens = 0
         self._serve_decode_s = 0.0    # decode-active time: the tokens/s basis
+        # model FLOPs attributed to GENERATED tokens (decode + accepted
+        # speculative): serve/flops_per_token's numerator. Rejected-draft
+        # verify FLOPs never land here — they ride _hw_flops (HFU) only.
+        self._serve_model_flops = 0.0
         self._tp = 1
         self._peak = peak
         self._peak_resolved = peak is not None
@@ -364,6 +368,7 @@ class GoodputLedger:
             if host_t0 is not None:
                 self._add_locked("overhead", host_t0, t0)
             rec = self._exes.get((kind, key)) or self._latest.get(kind)
+            attributed = 0.0
             if rec is not None:
                 hw = rec.hw_flops_per_call()
                 model = rec.model_flops_per_call()
@@ -373,7 +378,8 @@ class GoodputLedger:
                 if hw:
                     self._hw_flops += hw
                 if model:
-                    self._model_flops += model * scale
+                    attributed = model * scale
+                    self._model_flops += attributed
             if generated:
                 # tokens/s basis is DECODE-ACTIVE time, not session wall: a
                 # burst's throughput must not dilute against unrelated
@@ -382,6 +388,8 @@ class GoodputLedger:
                 self._serve_decode_s += max(t1 - t0, 0.0)
                 if tokens:
                     self._serve_tokens += int(tokens)
+                if attributed:
+                    self._serve_model_flops += attributed
 
     # ------------------------------------------------------------------ sweep
 
@@ -454,6 +462,12 @@ class GoodputLedger:
         if self._serve_tokens and self._serve_decode_s > 0:
             g("serve/tokens_per_s_chip").set(
                 self._serve_tokens / self._serve_decode_s / self._tp)
+        if self._serve_tokens and self._serve_model_flops:
+            # per-ACCEPTED-token model cost: a speculative verify bills
+            # its model FLOPs pre-scaled by emitted/width, so rejected
+            # drafts cannot shrink (or inflate) this figure
+            g("serve/flops_per_token").set(
+                self._serve_model_flops / self._serve_tokens)
         vals["wall"] = wall
         vals["fraction"] = frac
         return vals

@@ -28,9 +28,19 @@ and fails loudly on a wedged executable call, and the
 failure path deterministically testable. Every request ends in exactly
 one ``TERMINAL_STATUSES`` member.
 
+Speculative decoding (spec.py): pass ``drafter=`` to the engine and each
+decode step drafts k tokens, verifies them all in ONE chunk-shaped
+dispatch, and emits the longest agreeing prefix + a bonus token — greedy
+output stays bitwise identical to sequential decode, only faster. Three
+drafters ship: ``PromptLookupDrafter`` (n-gram over the request's own
+history, no model), ``DraftModelDrafter`` (a small causal LM), and
+``EarlyExitDrafter`` (the target model at strided depth). Speculative
+K/V writes land in pager-reserved blocks and roll back exactly on
+rejection.
+
 Telemetry: ``serve/*`` counters/gauges/histograms in ``paddle_tpu.monitor``
 (QPS, TTFT, per-token latency, slot occupancy, executable mints,
-expired/cancelled/drained/hang_warns).
+expired/cancelled/drained/hang_warns, spec accepted-per-step/hit-rate).
 """
 from .engine import (DecodeEngine, Request, generate_via_engine,
                      quantize_for_serving)
@@ -38,8 +48,12 @@ from .guardrails import (DispatchWatchdog, EngineHangError, FaultSchedule,
                          InjectedFault)
 from .pager import BlockPager
 from .scheduler import TERMINAL_STATUSES, AdmissionQueue, SlotAllocator
+from .spec import (Drafter, DraftModelDrafter, EarlyExitDrafter,
+                   PromptLookupDrafter)
 
 __all__ = ["DecodeEngine", "Request", "generate_via_engine",
            "quantize_for_serving", "AdmissionQueue", "SlotAllocator",
            "BlockPager", "TERMINAL_STATUSES", "FaultSchedule",
-           "InjectedFault", "DispatchWatchdog", "EngineHangError"]
+           "InjectedFault", "DispatchWatchdog", "EngineHangError",
+           "Drafter", "PromptLookupDrafter", "DraftModelDrafter",
+           "EarlyExitDrafter"]

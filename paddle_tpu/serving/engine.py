@@ -265,7 +265,8 @@ class DecodeEngine:
                  quantize: Optional[str] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, seed: int = 0,
                  hang_s: Optional[float] = None,
-                 fault_schedule: Optional[FaultSchedule] = None):
+                 fault_schedule: Optional[FaultSchedule] = None,
+                 drafter=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_len < 2:
@@ -289,6 +290,28 @@ class DecodeEngine:
         self._do_sample = bool(do_sample)
         self._temperature = float(temperature)
         self._top_k = int(top_k)
+        # ---- speculative decoding (spec.py): a drafter guesses k tokens,
+        # ONE chunk-shaped verify dispatch scores all of them, the longest
+        # agreeing prefix + the bonus token are emitted. Greedy-only: the
+        # acceptance rule IS bitwise argmax agreement, so output is exactly
+        # what sequential decode would produce.
+        self.drafter = drafter
+        if drafter is not None:
+            if not self.paged:
+                raise NotImplementedError(
+                    "speculative decoding requires paged=True (speculative "
+                    "K/V lands in trash-redirectable BlockPager positions)")
+            if self._do_sample:
+                raise NotImplementedError(
+                    "speculative decoding is greedy-only (acceptance is "
+                    "bitwise argmax agreement; do_sample would need a "
+                    "rejection-sampling acceptance rule)")
+            # verify width: k drafts + 1 carried token per dispatch. Minted
+            # ONCE — drafts ride as ids data, never as shape.
+            self._spec_width = int(min(
+                max(2, int(getattr(drafter, "max_k", 4)) + 1), max_len))
+        else:
+            self._spec_width = None
         # the executables rebind EVERY param and buffer as an input, so
         # weight updates (or an int8 swap) between calls flow through
         # without retracing
@@ -438,7 +461,13 @@ class DecodeEngine:
         self._slots = SlotAllocator(self.max_slots)
         self._queue = AdmissionQueue(max_queue)
         self._decode_exe = None
+        self._verify_exe = None
         self._prefill_exes = {}
+        # cumulative speculation counters (stats() + monitor mirrors)
+        self.spec_steps = 0        # verify dispatches
+        self.spec_drafted = 0      # tokens proposed by the drafter
+        self.spec_accepted = 0     # drafts that agreed with the verifier
+        self.spec_emitted = 0      # tokens emitted by spec steps (acc+bonus)
         self._key = jax.random.PRNGKey(int(seed))
         self._greedy_key = jax.random.PRNGKey(0)   # unused by greedy pick
         if self._repl is not None:
@@ -489,7 +518,9 @@ class DecodeEngine:
                              engine_id=self.engine_id, paged=self.paged,
                              block_size=self.block_size,
                              kv_blocks=self.kv_blocks,
-                             prefill_chunk=self.prefill_chunk, tp=self._tp)
+                             prefill_chunk=self.prefill_chunk, tp=self._tp,
+                             drafter=getattr(drafter, "name", None)
+                             if drafter is not None else None)
 
     # ------------------------------------------------------------- tracing
 
@@ -685,6 +716,47 @@ class DecodeEngine:
                                     out_shardings=self._pool_out_shardings())
         self._prefill_exes[sc] = exe
         self._minted("prefill", sc, time.time() - t0, exe=exe, tokens=sc)
+        return exe
+
+    def _build_verify(self):
+        """Speculative verify: the chunk machinery verbatim — ``[1, vw]``
+        ids through ONE slot's block-table row at absolute position ``p0``,
+        write path trashed past ``end`` — except the pick happens at EVERY
+        position instead of just the last. Position i's argmax is the
+        model's next token after ids[i], which is exactly the agreement
+        test the accept loop needs, and position a's argmax doubles as the
+        bonus token. Minted once per engine: drafts ride as ids DATA, so
+        no drafter can change this shape."""
+        spec = self.spec
+        mbs = self._mbs
+        vw = self._spec_width
+
+        def fn(leaves, pools, table, ids, slot, p0, end, cow_src, cow_dst,
+               key):
+            def body():
+                pools2 = self._apply_cow(pools, cow_src, cow_dst)
+                row = jax.lax.dynamic_slice(table, (slot, jnp.int32(0)),
+                                            (1, mbs))
+                caches = [(pk, pv, row) for pk, pv in pools2]
+                hidden, new_pools = spec.backbone(
+                    Tensor(ids), kv_caches=caches, start_pos=p0,
+                    write_end=end)
+                logits = self._head(hidden.value()[0])        # [vw, V]
+                picked = self._pick(logits, key).astype(jnp.int32)
+                return new_pools, picked
+            return self._traced(leaves, body)
+
+        pad = self._dev(jnp.zeros(self.max_slots, jnp.int32))
+        args = (self._leaf_values(), self._pools,
+                self._dev(self._pager.tables),
+                self._dev(jnp.zeros((1, vw), jnp.int32)),
+                self._dev(jnp.int32(0)), self._dev(jnp.int32(0)),
+                self._dev(jnp.int32(1)), pad, pad, self._greedy_key)
+        t0 = time.time()
+        exe = self._compile_in_eval(fn, args,
+                                    out_shardings=self._pool_out_shardings())
+        self._verify_exe = exe
+        self._minted("verify", vw, time.time() - t0, exe=exe, tokens=vw)
         return exe
 
     def _build_prefill(self, sb: int):
@@ -1382,6 +1454,9 @@ class DecodeEngine:
         self._tok[slot] = t
         self._live[slot] = True
         self._slot_req[slot] = req
+        if self.drafter is not None:
+            # (re-)admission resets drafter state with the token history
+            self.drafter.begin_request(req)
         mon = _monitor._active
         if mon is not None:
             mon.serve_admitted(req.t_first_token - req.t_submit, sc,
@@ -1509,6 +1584,8 @@ class DecodeEngine:
             self._finish(req, finished)
 
     def _decode(self, finished: List[Request]):
+        if self.drafter is not None:
+            return self._decode_spec(finished)
         exe = self._decode_exe
         if exe is None:
             exe = self._build_decode()
@@ -1587,6 +1664,118 @@ class DecodeEngine:
             if self.paged:
                 mon.serve_paged(self._pager.stats(), self.kv_util())
 
+    def _decode_spec(self, finished: List[Request]):
+        """Speculative decode step: per live slot, draft up to
+        ``_spec_width - 1`` tokens, verify the carried token + all drafts
+        in ONE chunk-shaped dispatch, emit the longest agreeing prefix
+        plus the verifier's bonus token. Every emitted token is bitwise
+        the token sequential greedy decode would have picked, so eos and
+        max_new_tokens are simply re-checked after each appended token —
+        both can land mid-batch and clip the advance.
+
+        Block discipline: the guaranteed single-token target gets the
+        batched-decode treatment (ensure_writable + preemption retry);
+        the DRAFT positions get a best-effort reservation that never
+        preempts — speculation must not evict a live tenant, it just
+        shrinks k to what the pool can back — and is exactly rolled back
+        past the accepted cursor after the verify returns (COW sources
+        re-referenced, fresh extensions re-trashed). Rejected drafts'
+        K/V writes die with the rolled-back blocks or sit above the
+        cursor where the next dispatch overwrites them before any read."""
+        exe = self._verify_exe
+        if exe is None:
+            exe = self._build_verify()
+        vw = self._spec_width
+        drafter = self.drafter
+        stepped = False
+        for slot in range(self.max_slots):
+            if not self._live[slot]:
+                continue
+            req = self._slot_req[slot]
+            p = int(self._pos[slot])
+            copies = self._ensure_or_evict(slot, p, p + 1)
+            if copies is None or not self._live[slot]:
+                continue                   # self-preempted: skip this slot
+            stepped = True
+            remaining = req.max_new_tokens - len(req.tokens)
+            k_cap = max(0, min(vw - 1, remaining - 1,
+                               self.max_len - 1 - p))
+            drafts = []
+            if k_cap > 0:
+                drafts = [int(t) for t in drafter.propose(req, k_cap)]
+                drafts = drafts[:k_cap]
+            reservation = []
+            if drafts:
+                cov_end, rcopies, reservation = \
+                    self._pager.reserve_speculative(slot, p + 1,
+                                                    p + 1 + len(drafts))
+                drafts = drafts[:max(0, cov_end - (p + 1))]
+                copies = copies + rcopies
+            k = len(drafts)
+            ids = np.zeros((1, vw), np.int32)
+            ids[0, 0] = self._tok[slot]
+            if k:
+                ids[0, 1:1 + k] = drafts
+            end = p + 1 + k
+            src, dst = self._cow_args(copies)
+            t0 = time.time()
+
+            def _call():
+                self._pools, picked = exe(
+                    self._leaf_values(), self._pools,
+                    self._dev(self._pager.tables), self._dev(ids),
+                    self._dev(jnp.int32(slot)), self._dev(jnp.int32(p)),
+                    self._dev(jnp.int32(end)), src, dst, self._next_key())
+                # host readback inside the armed window (see _decode)
+                return np.asarray(picked)
+
+            # on dispatch failure _fail_engine terminalizes every tenant
+            # and releases the pager state — the reservation dies with it
+            out = self._dispatch_guarded("verify", vw, _call)
+            dt = time.time() - t0
+            a = 0
+            while a < k and int(out[a]) == drafts[a]:
+                a += 1
+            n_emit = 0
+            for t in drafts[:a] + [int(out[a])]:
+                req.tokens.append(int(t))
+                self.tokens_generated += 1
+                n_emit += 1
+                if req._stop_hit():
+                    break
+            self._pos[slot] = p + n_emit
+            self._tok[slot] = req.tokens[-1]
+            if reservation:
+                self._pager.rollback_speculative(slot, p + n_emit,
+                                                 reservation)
+            req.spec_drafted += k
+            req.spec_accepted += a
+            self.spec_steps += 1
+            self.spec_drafted += k
+            self.spec_accepted += a
+            self.spec_emitted += n_emit
+            drafter.observe(req, a, k)
+            if req._phase is not None:
+                req._phase.event("spec_step", drafted=k, accepted=a,
+                                 emitted=n_emit, dur_s=round(dt, 6))
+            mon = _monitor._active
+            if mon is not None:
+                mon.serve_spec_step(
+                    dt, k, a, n_emit, vw, drafter.name,
+                    live=self.live_count, queue_depth=len(self._queue),
+                    accepted_per_step=self.spec_emitted / self.spec_steps,
+                    hit_rate=(self.spec_accepted / self.spec_drafted
+                              if self.spec_drafted else 0.0),
+                    engine_id=self.engine_id)
+            if req._stop_hit():
+                self._finish(req, finished)
+        if not stepped:
+            return
+        self.decode_steps += 1
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_paged(self._pager.stats(), self.kv_util())
+
     def _finish(self, req: Request, finished: List[Request]):
         self._release_slot_state(req.slot)
         self._deadline_reqs.discard(req)
@@ -1596,6 +1785,11 @@ class DecodeEngine:
         if mon is not None:
             mon.serve_done(len(req.tokens), req.t_done - req.t_submit,
                            "done")
+            if self.drafter is not None and req.spec_drafted:
+                mon.serve_spec(self.drafter.name, req.spec_drafted,
+                               req.spec_accepted, len(req.tokens),
+                               trace_id=req._trace.trace_id
+                               if req._trace is not None else None)
         if req._trace is not None:
             mono = time.perf_counter()
             if req._phase is not None:
@@ -1642,6 +1836,21 @@ class DecodeEngine:
                                 block_size=self.block_size,
                                 preemptions=self.preemptions,
                                 prefilling=len(self._prefilling))
+        if self.drafter is not None:
+            out["spec"] = {
+                "drafter": self.drafter.name,
+                "width": self._spec_width,
+                "steps": self.spec_steps,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "emitted": self.spec_emitted,
+                "accepted_per_step": round(
+                    self.spec_emitted / self.spec_steps, 4)
+                if self.spec_steps else 0.0,
+                "draft_hit_rate": round(
+                    self.spec_accepted / self.spec_drafted, 4)
+                if self.spec_drafted else 0.0,
+            }
         return out
 
     def close(self):

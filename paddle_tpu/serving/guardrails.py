@@ -7,7 +7,7 @@ that make that possible:
 
 * **`FaultSchedule`** — the ``PADDLE_SERVE_FAULT`` chaos seam, the serving
   mirror of ``PADDLE_CKPT_FAULT`` (distributed/checkpoint.py): a scripted
-  schedule of faults fired at exact call counts of the engine's four
+  schedule of faults fired at exact call counts of the engine's six
   interesting sites, so a test (or ``bench.py decode --chaos``) can drive
   expiry, cancellation, preemption, hang detection and drain through the
   very same code paths production traffic would, with zero randomness.
@@ -17,20 +17,26 @@ that make that possible:
       PADDLE_SERVE_FAULT="slow@decode:5:0.2,raise@admit:3,raise@alloc:7"
                           <action>@<site>:<nth>[:<arg>]
 
-  | site     | counts                         | ``raise`` means            |
-  |----------|--------------------------------|----------------------------|
-  | decode   | Nth decode executable call     | InjectedFault out of step()|
-  | chunk    | Nth chunk/prefill exe call     | InjectedFault out of step()|
-  | admit    | Nth paged admission attempt    | that request fails cleanly |
-  | alloc    | Nth BlockPager block alloc     | deterministic exhaustion   |
+  | site         | counts                          | ``raise`` means            |
+  |--------------|---------------------------------|----------------------------|
+  | decode       | Nth decode executable call      | InjectedFault out of step()|
+  | chunk        | Nth chunk/prefill exe call      | InjectedFault out of step()|
+  | admit        | Nth paged admission attempt     | that request fails cleanly |
+  | alloc        | Nth BlockPager block alloc      | deterministic exhaustion   |
+  | verify       | Nth speculative verify dispatch | InjectedFault out of step()|
+  | spec_reserve | Nth speculative reservation     | reservation yields nothing |
 
   ``slow`` sleeps ``<arg>`` seconds (default 0.05) at the site — inside
-  the watchdog's armed window for decode/chunk, which is how the hang
-  detector is tested without a real wedged runtime. At the ``alloc`` site
-  an injected ``raise`` does NOT propagate: the pager reports it as pool
-  exhaustion (returns no block), because exhaustion is the failure its
-  callers actually handle — this is deterministic preemption injection.
-  Counts are per-schedule (per-engine), 1-based.
+  the watchdog's armed window for decode/chunk/verify, which is how the
+  hang detector is tested without a real wedged runtime. At the ``alloc``
+  site an injected ``raise`` does NOT propagate: the pager reports it as
+  pool exhaustion (returns no block), because exhaustion is the failure
+  its callers actually handle — this is deterministic preemption
+  injection. Likewise at ``spec_reserve`` an injected ``raise`` makes the
+  reservation come back empty: the engine degrades to a plain one-token
+  verify for that step — speculation is an optimization, so its chaos
+  failure mode is graceful, never an error. Counts are per-schedule
+  (per-engine), 1-based.
 
 * **`DispatchWatchdog`** — a monitor-side thread that detects a decode or
   chunk dispatch exceeding ``PADDLE_SERVE_HANG_S`` (default off — CPU XLA
@@ -56,7 +62,8 @@ __all__ = ["FaultSchedule", "InjectedFault", "DispatchWatchdog",
 FAULT_ENV = "PADDLE_SERVE_FAULT"
 HANG_ENV = "PADDLE_SERVE_HANG_S"
 
-FAULT_SITES = ("decode", "chunk", "admit", "alloc")
+FAULT_SITES = ("decode", "chunk", "admit", "alloc", "verify",
+               "spec_reserve")
 _ACTIONS = ("raise", "slow")
 _DEFAULT_SLOW_S = 0.05
 

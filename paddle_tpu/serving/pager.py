@@ -310,6 +310,85 @@ class BlockPager:
             self.tables[slot, lidx] = fresh
         return copies
 
+    # ------------------------------------------------- speculative reserve
+
+    def reserve_speculative(self, slot: int, start_pos: int, end_pos: int
+                            ) -> Tuple[int, List[Tuple[int, int]],
+                                       List[Tuple[int, Optional[int]]]]:
+        """Best-effort private backing for the speculative write range
+        [start_pos, end_pos) of ``slot`` — where draft tokens' K/V lands
+        until the verifier accepts them. Same per-block walk as
+        ``ensure_writable`` (allocate missing, COW shared) with two
+        deliberate differences: it NEVER preempts — pool pressure must not
+        evict a live tenant for guesses, so the walk simply stops at the
+        first block the pool cannot supply — and instead of all-or-nothing
+        it reports how far it got.
+
+        Returns ``(covered_end, copies, reservation)``: every position
+        below ``covered_end`` is now privately writable (the caller clips
+        its drafts to that), ``copies`` are (src, dst) COW pairs to fold
+        into the verify dispatch, and ``reservation`` is the exact
+        rollback script — (lidx, previous_block) per table entry this call
+        replaced, in take order — for ``rollback_speculative``. Resolve
+        the reservation (rollback or commit) before the slot's next pager
+        operation; the engine does so synchronously right after the verify
+        returns. An injected "spec_reserve" fault (PADDLE_SERVE_FAULT)
+        reserves nothing: the engine degrades to a plain one-token verify,
+        never an error."""
+        if self.fault_schedule is not None:
+            from .guardrails import InjectedFault
+            try:
+                self.fault_schedule.fire("spec_reserve")
+            except InjectedFault:
+                return start_pos, [], []
+        copies: List[Tuple[int, int]] = []
+        reservation: List[Tuple[int, Optional[int]]] = []
+        covered = start_pos
+        for lidx in range(start_pos // self.block_size,
+                          self.blocks_for(end_pos)):
+            blk = int(self.tables[slot, lidx])
+            if blk != TRASH_BLOCK and self._ref[blk] == 1:
+                covered = min((lidx + 1) * self.block_size, end_pos)
+                continue                              # already private
+            fresh = self._alloc_block()
+            if fresh is None:
+                break         # partial coverage: the caller shrinks k
+            if blk != TRASH_BLOCK:                    # shared -> COW
+                copies.append((blk, fresh))
+                self.cow_copies += 1
+                self._decref(blk)
+                reservation.append((lidx, blk))
+            else:
+                reservation.append((lidx, None))
+            self.tables[slot, lidx] = fresh
+            covered = min((lidx + 1) * self.block_size, end_pos)
+        return covered, copies, reservation
+
+    def rollback_speculative(self, slot: int, keep_end: int,
+                             reservation: List[Tuple[int, Optional[int]]]):
+        """Resolve a ``reserve_speculative`` reservation after the verify:
+        every reserved entry whose block starts at or past ``keep_end``
+        (the post-accept cursor) covered ONLY rejected positions — free
+        the speculative block and restore what the table held before
+        (re-reference the COW source, reviving it from the LRU if it
+        parked meanwhile; trash for a fresh extension). Entries covering
+        any accepted position commit by doing nothing: the accepted
+        tokens' K/V already lives in them and the table already points at
+        them. Rejected drafts' writes die with the freed blocks — or, on
+        a committed block, sit above the cursor where the next dispatch
+        overwrites them before anything reads."""
+        for lidx, old in reversed(reservation):
+            if lidx * self.block_size < keep_end:
+                continue              # covers accepted positions: committed
+            self._decref(int(self.tables[slot, lidx]))
+            if old is not None:
+                if self._ref[old] == 0:      # parked mid-flight: revive
+                    self._lru.pop(old, None)
+                self._ref[old] += 1
+                self.tables[slot, lidx] = old
+            else:
+                self.tables[slot, lidx] = TRASH_BLOCK
+
     # -------------------------------------------------------- prefix sharing
 
     def share_prefix(self, slot: int, tokens: Sequence[int]) -> int:

@@ -74,6 +74,13 @@ class Request:
         self.error: Optional[str] = None
         self.slot: Optional[int] = None
         self.preemptions = 0             # pool-pressure evictions survived
+        # speculative-decoding bookkeeping (engine + spec.py): cumulative
+        # drafted/accepted token counts for THIS request, and the drafter's
+        # per-request scratch (reset by Drafter.begin_request on every
+        # (re-)admission — the token history it derives from resets too)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.drafter_state: Optional[dict] = None
         # chunk-executable calls the (final) prefill took: the counted
         # signal the prefix-cache gate reads — a request whose prompt was
         # served from parked blocks prefills only the uncovered remainder

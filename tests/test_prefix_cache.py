@@ -231,10 +231,13 @@ class TestLRUOrdering:
 
 def test_pager_invariants_random_ops():
     """~1k-op randomized sequences of alloc / share / COW / free / preempt
-    / LRU-park / adopt, asserting after every op that each block is in
-    exactly one of {free, LRU, owned}, refcounts match table references,
-    the trash block is never registered or parked, and the pool conserves
-    its blocks (all via BlockPager.check_invariants)."""
+    / LRU-park / adopt / speculative reserve+accept/rollback, asserting
+    after every op that each block is in exactly one of {free, LRU,
+    owned}, refcounts match table references, the trash block is never
+    registered or parked, and the pool conserves its blocks (all via
+    BlockPager.check_invariants). Speculative reservations resolve within
+    the same op — the reserve_speculative contract (the engine resolves
+    synchronously right after the verify returns)."""
     rng = np.random.RandomState(0)
     for round_ in range(4):
         bs = int(rng.choice([2, 4, 8]))
@@ -247,7 +250,25 @@ def test_pager_invariants_random_ops():
                         .tolist()) for _ in range(6)]
         live = {}
         for _ in range(250):
-            op = rng.randint(0, 10)
+            op = rng.randint(0, 12)
+            if op >= 10 and live:
+                # speculative reserve + partial accept: best-effort private
+                # backing past the cached extent, then roll back everything
+                # the (simulated) verify rejected — committed coverage
+                # becomes the new cached extent, exactly the engine's use
+                slot = list(live)[rng.randint(len(live))]
+                toks, end = live[slot]
+                cap = mbs * bs
+                if end < cap:
+                    want = min(end + int(rng.randint(1, 2 * bs + 1)), cap)
+                    cov, _copies, res = pg.reserve_speculative(slot, end,
+                                                               want)
+                    assert end <= cov <= want
+                    keep = end + int(rng.randint(0, cov - end + 1))
+                    pg.rollback_speculative(slot, keep, res)
+                    live[slot] = (toks, keep)
+                pg.check_invariants()
+                continue
             if op < 4 and len(live) < max_slots:        # admit
                 slot = next(s for s in range(max_slots) if s not in live)
                 toks = list(family[rng.randint(len(family))])

@@ -519,6 +519,36 @@ def summarize(paths, show_events=False, out=sys.stdout):
                 print(f"  queue overload rejections {int(overload)} "
                       f"(admission queue saturated — callers should back "
                       f"off or the pool should grow)", file=out)
+        # speculative decoding: drafted-vs-accepted economics per drafter,
+        # and the wasted-work alarm — spec enabled with acceptance ~0 means
+        # every verify dispatch carried dead drafts (a misconfigured
+        # drafter burns chunk-shaped dispatches for nothing)
+        spec_steps = counters_m.get("serve/spec_steps", 0)
+        if spec_steps:
+            drafted = counters_m.get("serve/spec_drafted", 0)
+            accepted = counters_m.get("serve/spec_accepted", 0)
+            aps = gauges_m.get("serve/spec_accepted_per_step", 0)
+            rate = accepted / drafted if drafted else 0.0
+            print(f"  speculation: {int(spec_steps)} verify steps  "
+                  f"drafted {int(drafted)}  accepted {int(accepted)} "
+                  f"({rate:.0%})  accepted/step {aps:.2f}", file=out)
+            per = {}
+            for k, v in counters_m.items():
+                if k.startswith("serve/spec_drafted."):
+                    per.setdefault(k.split(".", 1)[1], [0, 0])[0] = v
+                elif k.startswith("serve/spec_accepted."):
+                    per.setdefault(k.split(".", 1)[1], [0, 0])[1] = v
+            for name in sorted(per):
+                d, acc = per[name]
+                print(f"    drafter {name}: drafted {int(d)}  accepted "
+                      f"{int(acc)} "
+                      f"({acc / d if d else 0.0:.0%})", file=out)
+            if drafted >= 16 and rate < 0.05:
+                print(f"  WARNING: speculation is on but the draft "
+                      f"acceptance rate is {rate:.1%} over {int(drafted)} "
+                      f"drafted tokens — wasted-work signature (every "
+                      f"verify dispatch pays for drafts that never land; "
+                      f"switch drafters or turn speculation off)", file=out)
         # guardrail plane (deadlines / cancellation / drain / watchdog):
         # every request ends in a terminal status, and this block accounts
         # for the non-"done" ones next to the completions above
