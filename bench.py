@@ -83,6 +83,31 @@ def _fleet_fields():
     return out
 
 
+def _health_fields():
+    """health_trips for the best-so-far line: a best-of figure measured
+    across windows that tripped the numerics plane is not a clean number —
+    the line says so. Empty when the monitor is off."""
+    try:
+        from paddle_tpu import monitor
+        mon = monitor.get()
+    except Exception:
+        return {}
+    h = getattr(mon, "health", None)
+    if h is None:
+        return {}
+    return {"health_trips": int(h.nan_trips + h.overflow_trips + h.spikes)}
+
+
+def _heartbeat(what, window):
+    """One flushed line the moment a measurement window OPENS. A round the
+    driver kills mid-window (rc=124, the BENCH r05 silent-timeout shape)
+    then shows WHERE it died — dispatch inside window N, not warmup — in
+    place of an empty log."""
+    print(json.dumps({"heartbeat": what, "window": window,
+                      "ts": round(time.time(), 3)}))
+    sys.stdout.flush()
+
+
 def main(argv=()):
     import jax
     # persistent compile cache: XLA compiles through the tunnel are slow (~2min);
@@ -219,6 +244,7 @@ def main(argv=()):
         }
         payload.update(_fleet_fields())
         payload.update(_trace_fields())
+        payload.update(_health_fields())
         print(json.dumps(payload))
         sys.stdout.flush()
 
@@ -227,6 +253,7 @@ def main(argv=()):
     iters, windows = (1, 2) if tiny else (5, 6)
     best = 0.0
     for w in range(windows):
+        _heartbeat("train_window_open", w)
         t0 = time.time()
         for _ in range(iters):
             loss = step(ids, ids)
@@ -452,6 +479,7 @@ def main_decode(argv=()):
     iters, windows = (4, 2) if tiny else (20, 6)
     best = 0.0
     for w in range(windows):
+        _heartbeat("decode_window_open", w)
         tok0 = engine.tokens_generated
         t0 = time.time()
         for _ in range(iters):
@@ -478,6 +506,7 @@ def main_decode(argv=()):
                                   / max(engine.spec_drafted, 1), 3)}
                        if spec else {})
         print(json.dumps(dict(_fleet_fields(), **_trace_fields(),
+                              **_health_fields(),
                               **chaos_fields, **spec_fields, **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best / chips, 1),
@@ -498,6 +527,7 @@ def main_decode(argv=()):
             "live_slots": engine.live_count,
             "compiles": engine.compile_count,
             "steady_state_recompiles": engine.compile_count - warm_compiles,
+            "nan_logits": engine.nan_logits,
             "device_kind": kind,
             "window": w,
         })))

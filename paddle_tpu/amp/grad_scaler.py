@@ -89,6 +89,16 @@ class GradScaler:
                 if self._good_steps >= self._incr_every_n_steps:
                     self._scale *= self._incr_ratio
                     self._good_steps = 0
+        # feed the health plane (monitor/health.py): the loss-scale
+        # trajectory next to the trip timeline is how triage separates "the
+        # scaler is doing its job" (trips + skipped updates) from "the
+        # update went through unprotected". update() is the common tail of
+        # BOTH the eager step()+update() pair and the compiled-step replay
+        # (_compiled_outcome), so each outcome is fed exactly once.
+        from .. import monitor as _monitor
+        mon = _monitor._active
+        if mon is not None:
+            mon.health.scaler_outcome(self._found_inf, self._scale)
         self._found_inf = False
         self._unscaled = False
 

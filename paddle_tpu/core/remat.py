@@ -137,6 +137,15 @@ def tag_array(x, name: str):
                 _stats["named_bytes"].get(name, 0) + nb
         except Exception:
             pass
+        # health-plane activation tap: when TrainStep is tracing with an
+        # open collector (monitor/health.py), the named activation also
+        # contributes (sumsq, count) so its RMS rides the compiled step's
+        # outputs. Trace-time only, and None whenever health is off — the
+        # executed step never runs this.
+        from ..monitor.health import active_taps
+        taps = active_taps()
+        if taps is not None:
+            taps.record(name, x)
     return checkpoint_name(x, name)
 
 

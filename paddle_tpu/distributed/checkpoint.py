@@ -883,7 +883,8 @@ def _restore(base: str, step: int, model, optimizer, grad_scaler,
 def load_checkpoint(directory: str, model=None, optimizer=None,
                     step: Optional[int] = None, grad_scaler=None,
                     verify: bool = True, quarantine: bool = True,
-                    force_gather: bool = False
+                    force_gather: bool = False,
+                    max_step: Optional[int] = None
                     ) -> Optional[Dict[str, Any]]:
     """Resume from the newest committed snapshot (or the given ``step``).
 
@@ -895,6 +896,14 @@ def load_checkpoint(directory: str, model=None, optimizer=None,
     with a diagnostic naming the snapshot — never an opaque backend error;
     ``step=N, verify=False`` is the operator override that restores a
     manifest-less snapshot anyway.
+
+    ``max_step`` bounds auto-resume: snapshots with a LARGER step are
+    skipped untouched — not verified, never quarantined — and the newest
+    committed snapshot at or below the bound restores. This is the
+    health plane's quarantine-the-spike-step rollback primitive
+    (``monitor/health.py``): snapshots taken after a loss spike may hold
+    poisoned weights, but they are suspect, not corrupt, so they stay on
+    disk for the post-mortem. Ignored when ``step`` is explicit.
 
     Directories written BEFORE the commit protocol hold manifest-less
     snapshots, which auto-resume treats exactly like torn saves (skipped and
@@ -941,6 +950,8 @@ def load_checkpoint(directory: str, model=None, optimizer=None,
             if m:
                 all_steps.append(int(m.group(1)))
     for s in sorted(all_steps, reverse=True):
+        if max_step is not None and s > max_step:
+            continue                       # suspect, not corrupt: untouched
         base = _snapshot_dir(directory, s)
         manifest = read_manifest(base)
         if manifest is None:

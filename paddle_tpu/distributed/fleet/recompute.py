@@ -131,8 +131,12 @@ def recompute(function, *args, preserve_rng_state: bool = True,
         # under jit/TrainStep tracing, apply jax.checkpoint so the compiled
         # program actually drops this region's residuals and recomputes them
         # in backward (a pass-through here would silently lose the memory
-        # saving the user asked for)
-        return _recompute_traced(function, args, kwargs, policy)
+        # saving the user asked for). Health activation taps are suspended
+        # for the region: a value recorded inside jax.checkpoint is an
+        # inner-trace tracer that cannot escape to the step's outputs.
+        from ...monitor.health import suspend_taps
+        with suspend_taps():
+            return _recompute_traced(function, args, kwargs, policy)
     if not dispatch.is_grad_enabled():
         return function(*args, **kwargs)  # nothing to save anyway
 

@@ -207,14 +207,23 @@ class AutoCheckpoint(Callback):
     * watches SIGTERM/SIGINT (preemption): at the next step boundary it
       writes a synchronous emergency snapshot and stops fit cleanly — on a
       preemptible TPU slice the relaunched job resumes exactly where the
-      eviction hit.
+      eviction hit;
+    * opt-in ``rollback_on_spike``: the per-batch fit loss feeds the health
+      plane's rolling median/MAD spike detector, and on a spike (or a
+      non-finite loss) the model/optimizer/scaler roll back to the newest
+      snapshot committed BEFORE the spike step — quarantine semantics: the
+      spiked step's weights and any snapshot at-or-after it are never
+      adopted. The data stream does NOT rewind; training continues forward
+      on restored weights (the point is to eject the bad update, not to
+      bitwise-replay the input pipeline).
     """
 
     def __init__(self, directory: str, save_steps: Optional[int] = None,
                  save_secs: Optional[float] = None, keep: int = 3,
                  resume: bool = True, asynchronous: bool = True,
                  grad_scaler=None, watch_signals: bool = True,
-                 verbose: int = 1, coordinator=None):
+                 verbose: int = 1, coordinator=None,
+                 rollback_on_spike: bool = False):
         super().__init__()
         if not save_steps and save_secs is None:
             save_steps = 100  # save SOMETHING periodically by default
@@ -232,12 +241,17 @@ class AutoCheckpoint(Callback):
         # commit POD-wide, and an elastic relaunch at a different world size
         # reshards transparently at the resume below
         self.coordinator = coordinator
+        self.rollback_on_spike = rollback_on_spike
         self._ckptr = None
         self._watcher = None
         self._global_step = 0
         self._last_saved = -1
         self._t_last = 0.0
         self._emergency_done = False
+        self._spike_plane = None     # monitor health plane (hook installed)
+        self._spike_det = None       # standalone detector (no monitor)
+        self._hook_installed = False
+        self.rollbacks = 0
 
     # ------------------------------------------------------------- plumbing
 
@@ -272,6 +286,70 @@ class AutoCheckpoint(Callback):
                             mode=mode or ("sync" if block else "async"))
         self._last_saved = self._global_step
         self._t_last = time.monotonic()
+
+    # ------------------------------------------------------- spike rollback
+
+    def _spike_rollback(self, spike_step, info):
+        """health-plane rollback hook: restore the newest snapshot committed
+        strictly before the CURRENT fit step (the plane may number its steps
+        from process start — the fit-global step is what names snapshots
+        here, so quarantine is anchored on it, not on ``spike_step``)."""
+        from ..distributed import checkpoint as _ckpt
+        try:
+            self._ckptr.wait()
+        except Exception as stale:
+            import warnings
+            warnings.warn(f"AutoCheckpoint: discarding stale async write "
+                          f"error before spike rollback: {stale!r}",
+                          stacklevel=2)
+        info = _ckpt.load_checkpoint(self.directory,
+                                     model=self.model.network,
+                                     optimizer=self.model._optimizer,
+                                     grad_scaler=self._scaler(),
+                                     max_step=int(self._global_step) - 1)
+        if info is None:
+            import warnings
+            warnings.warn("AutoCheckpoint: rollback_on_spike found no "
+                          "committed snapshot predating the spike; training "
+                          "continues on the spiked weights", stacklevel=2)
+            return None
+        self.rollbacks += 1
+        self._global_step = int(info["step"])
+        self._last_saved = self._global_step  # this exact state IS on disk
+        if self.verbose:
+            print(f"AutoCheckpoint: loss spike — rolled back to step "
+                  f"{self._global_step} ({self.directory})", file=sys.stderr)
+        return info
+
+    def _feed_spike(self, logs):
+        try:
+            lv = float((logs or {}).get("loss"))
+        except (TypeError, ValueError):
+            return
+        if self._spike_plane is not None:
+            sp = self._spike_plane.spike.observe(lv)
+            if sp is not None:
+                self._spike_plane.spike_tripped(self._global_step, sp,
+                                                source="fit")
+        elif self._spike_det is not None:
+            sp = self._spike_det.observe(lv)
+            if sp is not None:
+                import warnings
+                warnings.warn(
+                    f"AutoCheckpoint: loss spike at step "
+                    f"{self._global_step}: {sp['loss']:.6g}"
+                    + (f" vs rolling median {sp['median']:.6g}"
+                       if sp.get("median") is not None else " (non-finite)"),
+                    RuntimeWarning, stacklevel=2)
+                if self._spike_rollback(self._global_step, sp) is not None:
+                    self._spike_det.reset()
+
+    def _spike_teardown(self):
+        if self._hook_installed and self._spike_plane is not None:
+            self._spike_plane.rollback_hook = None
+        self._hook_installed = False
+        self._spike_plane = None
+        self._spike_det = None
 
     # ------------------------------------------------------------ callbacks
 
@@ -311,6 +389,23 @@ class AutoCheckpoint(Callback):
                     print(f"AutoCheckpoint: resuming from step "
                           f"{self._global_step} ({self.directory}{detail})",
                           file=sys.stderr)
+        if self.rollback_on_spike:
+            from .. import monitor as _monitor
+            from ..monitor import health as _health
+            mon = _monitor._active
+            if mon is not None and mon.health.enabled:
+                # share the session's detector: a spike caught by EITHER
+                # channel (sampled TrainStep tick or this per-batch feed)
+                # runs the rollback through the plane's hook
+                self._spike_plane = mon.health
+                if mon.health.rollback_hook is None:
+                    mon.health.rollback_hook = self._spike_rollback
+                    self._hook_installed = True
+            else:
+                self._spike_det = _health.SpikeDetector(
+                    window=_health._env_int("PADDLE_HEALTH_SPIKE_WINDOW", 32),
+                    k=_health._env_float("PADDLE_HEALTH_SPIKE_K", 10.0),
+                    min_fill=_health._env_int("PADDLE_HEALTH_SPIKE_MIN", 8))
         # install the process-global handlers only once the fallible resume
         # is done: if it raises, fit unwinds before on_train_abort/-end
         # would run, and a leaked watcher swallows every later SIGTERM
@@ -349,6 +444,11 @@ class AutoCheckpoint(Callback):
                       f"{self._global_step} (signal "
                       f"{self._watcher.signum}); stopping", file=sys.stderr)
             return
+        if self.rollback_on_spike:
+            # feed BEFORE the periodic-save check: a spiked step must roll
+            # back, not snapshot its poisoned weights (after a rollback
+            # _last_saved == _global_step, so the due-save below no-ops)
+            self._feed_spike(logs)
         due = bool(self.save_steps) and \
             self._global_step % self.save_steps == 0
         if not due and self.save_secs is not None:
@@ -361,6 +461,7 @@ class AutoCheckpoint(Callback):
             if self._ckptr is not None:
                 self._ckptr.wait()  # surface any async write error here
         finally:
+            self._spike_teardown()
             if self._watcher is not None:
                 self._watcher.uninstall()
                 self._watcher = None
@@ -377,6 +478,7 @@ class AutoCheckpoint(Callback):
         except Exception:
             pass
         finally:
+            self._spike_teardown()
             if self._watcher is not None:
                 self._watcher.uninstall()
                 self._watcher = None

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import monitor as _monitor
+from ..monitor import health as _health
 from ..monitor import trace as _trace
 from ..core import dispatch
 from ..core import random as _random
@@ -315,8 +316,11 @@ class TrainStep:
         self._scaler_on = grad_scaler is not None and grad_scaler.is_enable()
         self._loss_fn = loss_fn
         self._donate = donate_params
-        self._params: List[Parameter] = [p for _, p in
-                                         self._model.named_parameters()]
+        named = list(self._model.named_parameters())
+        self._params: List[Parameter] = [p for _, p in named]
+        # leaf names in param order: the health plane's trip attribution and
+        # the PADDLE_HEALTH_FAULT seam both address leaves by name
+        self._param_names: List[str] = [n for n, _ in named]
         # trainable param count for the goodput plane's analytic 6ND FLOP
         # model (fallback + cross-check next to cost_analysis at each mint)
         self._n_train_params = sum(
@@ -343,6 +347,12 @@ class TrainStep:
         # a step counter for its attrs — None/0 while tracing is off
         self._cur_trace = None
         self._trace_n = 0
+        # health-plane state: the CompiledHealth spec captured at build time
+        # (None when the monitor is off or PADDLE_HEALTH=0 — the program is
+        # then byte-for-byte what it always was) and the step counter the
+        # host sampling cadence keys on
+        self._health_spec = None
+        self._health_n = 0
         self._opt._ensure_all_states()
         # ZeRO / hybrid optimizers place their states on construction paths that
         # run inside step(); trigger placement explicitly when present
@@ -404,6 +414,16 @@ class TrainStep:
         n_p, n_b = len(params), len(buffers)
 
         trainables = [p.trainable for p in params]
+        # health plane: captured at build time so its stat block compiles
+        # INTO this executable's outputs (flags are data, not shape — one
+        # program per bucket with health on or off, never both)
+        mon0 = _monitor._active
+        health = None
+        if mon0 is not None and mon0.health.enabled:
+            diff_names = [n for n, p in zip(self._param_names, params)
+                          if p.trainable]
+            health = mon0.health.compiled_spec(diff_names)
+        self._health_spec = health
         static = dict(opt._static_config())
         static["lr_scales"] = tuple(
             float(p.optimize_attr.get("learning_rate", 1.0))
@@ -456,6 +476,12 @@ class TrainStep:
             saved_p = [p._data for p in params]
             saved_b = [b._data for b in buffers]
             dispatch.push_trace(ctx)
+            # health activation taps: core/remat.tag_array records (sumsq,
+            # count) for each named activation while this collector is open
+            # (suspended inside scan bodies / jax.checkpoint regions, whose
+            # inner tracers cannot escape to the step's outputs)
+            tap_cm = _health.collect_taps() if health is not None else None
+            taps = tap_cm.__enter__() if tap_cm is not None else None
             try:
                 for p, a in zip(params, param_arrays):
                     p._data = a
@@ -472,8 +498,11 @@ class TrainStep:
                 updates = {id(t): arr for t, arr in ctx.buffer_updates}
                 new_buffers = tuple(updates.get(id(b), arr)
                                     for b, arr in zip(buffers, buffer_arrays))
-                return loss.value(), new_buffers
+                act = taps.harvest() if taps is not None else {}
+                return loss.value(), new_buffers, act
             finally:
+                if tap_cm is not None:
+                    tap_cm.__exit__(None, None, None)
                 dispatch.pop_trace()
                 ctx.restore()
                 for p, d in zip(params, saved_p):
@@ -538,17 +567,17 @@ class TrainStep:
                 di = iter(diff_params)
                 for a, t in zip(param_arrays, trainables):
                     full.append(next(di) if t else a)
-                loss, new_buffers = run_model(tuple(full), buffer_arrays,
-                                              input_arrays)
+                loss, new_buffers, act = run_model(tuple(full), buffer_arrays,
+                                                   input_arrays)
                 if scaler_on:
                     return (loss * scalars["loss_scale"].astype(loss.dtype),
-                            (loss, new_buffers))
-                return loss, (loss, new_buffers)
+                            (loss, new_buffers, act))
+                return loss, (loss, new_buffers, act)
 
             diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
-            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+            (_, (loss, new_buffers, act)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(diff_in)
-            return loss, new_buffers, grads
+            return loss, new_buffers, act, grads
 
         def step_fn_accum(param_arrays, masters, states, buffer_arrays,
                           scalars, input_arrays):
@@ -565,25 +594,29 @@ class TrainStep:
 
                 def body(carry, mb_inputs):
                     bufs, acc = carry
-                    loss, new_bufs, g = microbatch_grads(
+                    loss, new_bufs, act_mb, g = microbatch_grads(
                         param_arrays, bufs, mb_inputs, scalars)
                     if accum_plan is not None:
                         acc = accum_plan.add(acc, g)
                     else:
                         acc = tuple(a + gi.astype(jnp.float32)
                                     for a, gi in zip(acc, g))
-                    return (new_bufs, acc), loss
+                    # activation stats ride the scan's ys (stacked [K],
+                    # averaged below) — they escape the body legitimately,
+                    # unlike values tapped INSIDE an inner scan/remat trace
+                    return (new_bufs, acc), (loss, act_mb)
 
-                (new_buffers, grads), losses = jax.lax.scan(
+                (new_buffers, grads), (losses, acts) = jax.lax.scan(
                     body, (tuple(buffer_arrays), acc0), input_arrays,
                     unroll=min(self._scan_unroll, k))
                 if accum_plan is not None:
                     grads = accum_plan.unflatten(grads)
                 loss = jnp.mean(losses)
+                act = {n: jnp.mean(v) for n, v in acts.items()}
                 factor = (1.0 / k) if avg else 1.0
             else:
                 k = 1
-                loss, new_buffers, grads = microbatch_grads(
+                loss, new_buffers, act, grads = microbatch_grads(
                     param_arrays, buffer_arrays, input_arrays, scalars)
                 factor = 1.0
 
@@ -606,6 +639,9 @@ class TrainStep:
                 grads = tuple(
                     g if sh is None else jax.lax.with_sharding_constraint(g, sh)
                     for g, sh in zip(grads, grad_shardings))
+            # health stats read the UNCLIPPED grads: a NaN global norm would
+            # smear the clip's NaN across every group and destroy attribution
+            health_grads = tuple(grads) if health is not None else None
             if grad_clip is not None:
                 grads = [g for _, g in grad_clip(list(zip(diff_in, grads)))]
 
@@ -630,6 +666,13 @@ class TrainStep:
                 param_arrays, masters, states, new_upd, new_states_diff)
             loss_out = ({"loss": loss, "found_inf": found_inf} if scaler_on
                         else loss)
+            if health is not None:
+                # on a skipped update new_upd was where()'d back to upd_in,
+                # so the param digest correctly reports "weights unchanged"
+                h = health.pack(loss, health_grads, new_upd, upd_in, act)
+                loss_out = dict(loss_out) if scaler_on \
+                    else {"loss": loss}
+                loss_out["health"] = h
             return (loss_out, new_params, new_masters, new_states,
                     tuple(new_buffers))
 
@@ -640,10 +683,12 @@ class TrainStep:
                 di = iter(diff_params)
                 for a, t in zip(param_arrays, trainables):
                     full.append(next(di) if t else a)
-                return run_model(tuple(full), buffer_arrays, input_arrays)
+                loss, new_buffers, act = run_model(tuple(full), buffer_arrays,
+                                                   input_arrays)
+                return loss, (new_buffers, act)
 
             diff_in = tuple(a for a, t in zip(param_arrays, trainables) if t)
-            (loss, new_buffers), grads = jax.value_and_grad(
+            (loss, (new_buffers, act)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(diff_in)
 
             if grad_shardings is not None:
@@ -651,6 +696,8 @@ class TrainStep:
                     g if sh is None else jax.lax.with_sharding_constraint(g, sh)
                     for g, sh in zip(grads, grad_shardings))
 
+            # health stats read the UNCLIPPED grads (attribution — see above)
+            health_grads = tuple(grads) if health is not None else None
             if grad_clip is not None:
                 grads = [g for _, g in grad_clip(list(zip(diff_in, grads)))]
 
@@ -665,7 +712,13 @@ class TrainStep:
                 diff_states, scalars, **static)
             new_params, new_masters, new_states = repack(
                 param_arrays, masters, states, new_upd, new_states_diff)
-            return (loss, new_params, new_masters, new_states, new_buffers)
+            loss_out = loss
+            if health is not None:
+                loss_out = {"loss": loss,
+                            "health": health.pack(loss, health_grads,
+                                                  new_upd, upd_in, act)}
+            return (loss_out, new_params, new_masters, new_states,
+                    new_buffers)
 
         # donate params too: __call__ re-reads p.value() fresh each step and
         # immediately replaces p._data with the step's output, so the input
@@ -693,6 +746,13 @@ class TrainStep:
     # ------------------------------------------------------------------ call
 
     def __call__(self, *inputs):
+        mon = _monitor._active
+        if mon is not None and mon.health.fault is not None:
+            # chaos seam: a scheduled PADDLE_HEALTH_FAULT poisons a live
+            # param host-side (same sharding, so the fast path re-adopts it
+            # without a recompile) before this call dispatches
+            mon.health.fault.maybe_fire(
+                list(zip(self._param_names, self._params)), emit=mon.emit)
         tracer = _trace._active
         t = None
         if tracer is not None:
@@ -980,28 +1040,70 @@ class TrainStep:
 
     def _finish_loss(self, loss_out):
         """Unpack the step's loss output; with a compiled-in scaler, replay
-        the eager GradScaler state machine on the device found-inf flag."""
-        if not self._scaler_on:
+        the eager GradScaler state machine on the device found-inf flag;
+        with the health plane compiled in, run the sampled host check."""
+        if not isinstance(loss_out, dict):
             return loss_out
-        # one host sync per step — the same sync the eager scaler's
-        # bool(all(isfinite)) already pays
-        found = bool(loss_out["found_inf"])
-        if found:
-            # the executable discarded the update; un-advance the step
-            # counter so bias correction replays this step number, exactly
-            # as the eager path where optimizer.step() never ran
-            self._opt._rollback_step()
-            if self._cur_trace is not None:
-                # a skipped update is exactly the kind of step a post-mortem
-                # wants whole: force it past head sampling
-                self._cur_trace.event("skip_update",
-                                      microbatches=self._acc_steps)
-                self._cur_trace.escalate("skip_update")
-            mon = _monitor._active
-            if mon is not None:
-                mon.update_skipped(self._acc_steps)
-        self._scaler._compiled_outcome(found)
+        if self._scaler_on:
+            # one host sync per step — the same sync the eager scaler's
+            # bool(all(isfinite)) already pays
+            found = bool(loss_out["found_inf"])
+            if found:
+                # the executable discarded the update; un-advance the step
+                # counter so bias correction replays this step number,
+                # exactly as the eager path where optimizer.step() never ran
+                self._opt._rollback_step()
+                if self._cur_trace is not None:
+                    # a skipped update is exactly the kind of step a
+                    # post-mortem wants whole: force it past head sampling
+                    self._cur_trace.event("skip_update",
+                                          microbatches=self._acc_steps)
+                    self._cur_trace.escalate("skip_update")
+                mon = _monitor._active
+                if mon is not None:
+                    mon.update_skipped(self._acc_steps)
+            self._scaler._compiled_outcome(found)
+        if "health" in loss_out:
+            self._health_tick(loss_out["loss"], loss_out["health"])
         return loss_out["loss"]
+
+    def _health_tick(self, loss_dev, payload):
+        """The host half of the health plane. The device stat block rides
+        EVERY step's outputs (it is just more output buffers — nothing
+        synced); only every ``PADDLE_HEALTH_SAMPLE``-th step pulls it and
+        runs the checks, so the steady-state step stays sync-free."""
+        self._health_n += 1
+        mon = _monitor._active
+        spec = self._health_spec
+        if mon is None or spec is None \
+                or not mon.health.should_sample(self._health_n):
+            return
+        host = jax.device_get(payload)
+        loss_val = float(jax.device_get(loss_dev))
+        mon.health.on_sample(
+            spec, self._health_n, loss_val, host,
+            named_params=list(zip(self._param_names, self._params)))
+
+    def rollback_last_commit(self, directory: str, before_step=None):
+        """Quarantine-the-spike-step restore for raw training loops: load
+        the newest snapshot committed strictly BEFORE ``before_step`` (any
+        committed snapshot when None), leaving newer — possibly poisoned —
+        snapshots on disk untouched. The natural ``rollback_on_spike`` hook
+        target when not using hapi's AutoCheckpoint:
+
+            mon.health.rollback_hook = lambda step, info: \\
+                step_fn.rollback_last_commit(ckpt_dir, before_step=step)
+
+        Returns the checkpoint info dict or None when nothing older exists.
+        The restore lands on the live arrays' placements, so the fast
+        path's AOT executables stay valid (arrays re-adopted, no rebuild)."""
+        from ..distributed.checkpoint import load_checkpoint
+        self.wait_checkpoint()
+        max_step = None if before_step is None else int(before_step) - 1
+        return load_checkpoint(directory, model=self._model,
+                               optimizer=self._opt,
+                               grad_scaler=self._scaler,
+                               max_step=max_step)
 
     # --------------------------------------------------------- checkpointing
 

@@ -151,8 +151,13 @@ def _gpt_scan_blocks_fwd(x, l1w, l1b, qw, qb, pw, pb, l2w, l2b, f1w, f1b, f2w,
         # unnamed [B,H,S,S] score/softmax region rematerializes in backward)
         note_region(remat)
         body = jax.checkpoint(body, policy=resolve_policy(remat))
-    out, _ = jax.lax.scan(body, x, (l1w, l1b, qw, qb, pw, pb, l2w, l2b,
-                                    f1w, f1b, f2w, f2b, keys))
+    # health activation taps pause over the scan: the body's tag_array
+    # values are scan-trace tracers that cannot escape to the step's
+    # outputs (the discrete-block path gives per-layer RMS instead)
+    from ..monitor.health import suspend_taps
+    with suspend_taps():
+        out, _ = jax.lax.scan(body, x, (l1w, l1b, qw, qb, pw, pb, l2w, l2b,
+                                        f1w, f1b, f2w, f2b, keys))
     return out
 
 

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from .. import ops
-from ..core.remat import (ATTN_OUT, ATTN_QKV, MLP_HIDDEN,
+from ..core.remat import (ATTN_CONTEXT, ATTN_OUT, ATTN_QKV, MLP_HIDDEN,
                           normalize_granularity, tag_activation)
 from ..ops._helpers import _op
 
@@ -142,6 +142,10 @@ class LlamaAttention(nn.Layer):
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                                  training=self.training)
+        # name the context like GPT does: selective remat then saves it (the
+        # score/softmax region stays the part recomputed in backward) and
+        # the health plane gets its per-layer context RMS
+        out = tag_activation(out, ATTN_CONTEXT)
         return tag_activation(self.o_proj(out.reshape([b, s, h])), ATTN_OUT)
 
     def _forward_cached(self, x, kv_cache):

@@ -30,9 +30,11 @@ import warnings
 from typing import Optional
 
 from . import goodput as _goodput_mod
+from . import health as _health_mod
 from . import prom as _prom
 from . import trace as _trace_mod
 from .goodput import GOODPUT_STATES, GoodputLedger
+from .health import HealthPlane
 from .memory import executable_memory_stats, live_array_census
 from .recorder import FlightRecorder
 from .registry import Counter, Gauge, Histogram, Registry
@@ -42,7 +44,8 @@ __all__ = ["enable", "disable", "enabled", "get", "emit", "dump",
            "counter", "gauge", "histogram", "snapshot", "fleet_state",
            "live_array_census", "executable_memory_stats", "prom_render",
            "Monitor", "Registry", "Counter", "Gauge", "Histogram",
-           "GoodputLedger", "GOODPUT_STATES", "SCHEMA_VERSION"]
+           "GoodputLedger", "GOODPUT_STATES", "HealthPlane",
+           "SCHEMA_VERSION"]
 
 # THE hot-path flag: integration points read this one module global and do
 # nothing when it is None. Everything else in this file is cold path.
@@ -65,7 +68,7 @@ _STALL_S = 1e-3
 # unsampled — step while their floating spans land in the NEXT one.
 _TRACED_KINDS = frozenset((
     "recompile", "skip_update", "fast_state_dropped", "serve_reject",
-    "crash"))
+    "crash", "health_nan", "health_overflow", "health_spike"))
 
 
 def _sig_json(sig):
@@ -114,6 +117,11 @@ class Monitor:
         # goodput/MFU accounting plane (monitor/goodput.py): consumes the
         # hooks below, costs nothing new on the disabled path
         self.goodput = GoodputLedger(self.registry, emit=self.emit)
+        # model-health plane (monitor/health.py): numerics tripwires,
+        # per-layer stats, spike rollback, divergence digests. Rides every
+        # session unless PADDLE_HEALTH=0; the disabled path is still the
+        # one `monitor._active is None` check at each integration point.
+        self.health = _health_mod.HealthPlane(self)
         self.warn_after = warn_after
         self._op_counts = {}
         self._op_compiles = 0
@@ -557,6 +565,17 @@ class Monitor:
         if trace_id:
             fields["trace"] = trace_id
         self.emit("serve_preempt", **fields)
+
+    def serve_nan_logits(self, where: str, trace_id=None):
+        """The decode/prefill executable reported non-finite logits for a
+        request; the engine terminalizes it as `failed` instead of
+        streaming garbage tokens. ``where``: which executable tripped
+        (prefill/chunk/decode/verify)."""
+        self.registry.counter("serve/nan_logits").inc()
+        fields = dict(where=where)
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("serve_nan_logits", **fields)
 
     def serve_paged(self, pager_stats, kv_util: float):
         """Per-decode-step paged-pool gauges (cheap sets, no event). The

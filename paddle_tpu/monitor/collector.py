@@ -17,7 +17,14 @@ can see:
   (``fleet/ranks_stale`` gauge + flight event) within two publish intervals;
 * **divergence tripwires** — a rank whose recompile or skipped-update
   counter advances ALONE is flagged (the all-ranks-vs-one-rank diagnostic
-  metrics_summary does offline, moved online).
+  metrics_summary does offline, moved online);
+* **weight-divergence digests** — the health plane's Rademacher projection
+  digests (``health/digest_step`` + ``health/digest/p<d>`` gauges, computed
+  in-executable by TrainStep) are bitwise-equal across ranks holding equal
+  weights; the aggregator compares them at a COMMON step (a small per-rank
+  history bridges unsynchronized publish windows) and flags the rank whose
+  *weights* — not just its counters — forked. Tolerance is
+  ``PADDLE_HEALTH_DIGEST_RTOL`` (default 1e-5 relative).
 
 Transport rides the launch KV master (``PADDLE_MONITOR_MASTER``, falling
 back to ``PADDLE_CKPT_MASTER`` — both exported by the launch controller)
@@ -51,6 +58,7 @@ from typing import Dict, List, Optional
 
 from . import goodput as _goodput_mod
 from . import trace as _trace_mod
+from .health import DIGEST_PREFIX, DIGEST_STEP_GAUGE
 from .registry import Registry
 from .sink import JsonlSink
 
@@ -306,6 +314,11 @@ class Aggregator:
         self._warned_stale: set = set()
         self._warned_straggler: set = set()
         self._trip_streak: Dict[str, tuple] = {}
+        self.digest_rtol = _env_float("PADDLE_HEALTH_DIGEST_RTOL", 1e-5)
+        # per-rank {digest_step: probe vector}, bounded — the alignment
+        # buffer for the cross-rank weight-digest comparison
+        self._digest_hist: Dict[int, Dict[int, tuple]] = {}
+        self._digest_streak = (None, 0)
         self._elastic = None
         self._elastic_mismatch = 0
         self.last_fleet: Optional[dict] = None
@@ -401,6 +414,12 @@ class Aggregator:
         st = self._ranks.get(rank)
         return st.trace if st is not None else None
 
+    def _digest_differs(self, a, b) -> bool:
+        if len(a) != len(b):
+            return True
+        return any(abs(x - y) > self.digest_rtol * max(abs(x), abs(y), 1.0)
+                   for x, y in zip(a, b))
+
     def _event(self, kind: str, **fields):
         """WARN/lifecycle events go to BOTH sides of the plane: the fleet
         stream (the live dashboard reads it) and rank 0's own monitor sink +
@@ -480,11 +499,68 @@ class Aggregator:
             if streak == 2:  # warn once on the transition, not every poll
                 diverged.append({"counter": name, "rank": leader})
 
+        # weight-divergence digests: record each live rank's latest
+        # (digest_step, probe vector) into a small history, then compare all
+        # ranks at the newest step EVERY digest-publishing rank has seen —
+        # publish windows are unsynchronized, so rank A's freshest digest
+        # may label a step rank B published two polls ago. Same two-poll
+        # streak discipline as the counter tripwires: one poll of
+        # disagreement can be a torn read, two is a forked rank.
+        div_rank, div_step = None, None
+        for r in live:
+            st = self._ranks[r]
+            ds = st.gauges.get(DIGEST_STEP_GAUGE)
+            if ds is None:
+                continue
+            vec, i = [], 0
+            while True:
+                v = st.gauges.get(f"{DIGEST_PREFIX}p{i}")
+                if v is None:
+                    break
+                vec.append(float(v))
+                i += 1
+            if not vec:
+                continue
+            hist = self._digest_hist.setdefault(r, {})
+            hist[int(ds)] = tuple(vec)
+            while len(hist) > 8:
+                del hist[min(hist)]
+        ranks_d = [r for r in live if self._digest_hist.get(r)]
+        if len(ranks_d) >= 2:
+            shared = set.intersection(
+                *(set(self._digest_hist[r]) for r in ranks_d))
+            if shared:
+                step = max(shared)
+                vecs = {r: self._digest_hist[r][step] for r in ranks_d}
+                # reference = the rank the most siblings agree with (ties
+                # to the lowest rank — rank 0 anchors checkpoints and this
+                # aggregation, so in a 2-rank split it is the trusted side);
+                # exactly ONE rank off the reference is the forked-rank
+                # signature, several is seed/topology misconfiguration
+                agree = {r: sum(not self._digest_differs(vecs[r], vecs[q])
+                                for q in ranks_d if q != r) for r in ranks_d}
+                ref = min(ranks_d, key=lambda r: (-agree[r], r))
+                outliers = [r for r in ranks_d if r != ref
+                            and self._digest_differs(vecs[r], vecs[ref])]
+                if len(outliers) == 1:
+                    div_rank, div_step = outliers[0], step
+        prev_rank, streak = self._digest_streak
+        streak = streak + 1 if div_rank is not None and div_rank == prev_rank \
+            else (1 if div_rank is not None else 0)
+        self._digest_streak = (div_rank, streak)
+        if streak == 2:
+            diverged.append({"counter": DIGEST_STEP_GAUGE, "rank": div_rank,
+                             "kind": "weights", "step": div_step})
+
         derived = {"fleet/ranks": len(self._ranks), "fleet/ranks_live":
                    len(live), "fleet/ranks_stale": len(stale),
                    "fleet/step_skew": skew}
         if slowest is not None:
             derived["fleet/slowest_rank"] = slowest
+        derived["fleet/weight_divergence"] = \
+            1.0 if div_rank is not None and streak >= 2 else 0.0
+        if div_rank is not None and streak >= 2:
+            derived["fleet/weight_diverged_rank"] = div_rank
 
         # pod goodput (monitor/goodput.py accounting plane): a pod moves at
         # its slowest rank's pace, so pod goodput is the MIN over ranks —
@@ -534,6 +610,18 @@ class Aggregator:
             self._warned_straggler.clear()
 
         for div in d["diverged"]:
+            if div.get("kind") == "weights":
+                r = div["rank"]
+                tid = self._rank_trace(r)
+                self._event(
+                    "fleet_warn", warn="weight_divergence", rank=r,
+                    step=div.get("step"), trace=tid,
+                    msg=f"rank {r}'s weight digest disagrees with every "
+                        f"sibling at step {div.get('step')} — its WEIGHTS "
+                        f"(not just its counters) have forked; eject or "
+                        f"restore that rank before it poisons a collective"
+                        + (f" [trace {tid} on rank {r}]" if tid else ""))
+                continue
             self._event("fleet_warn", warn="divergence", rank=div["rank"],
                         counter=div["counter"],
                         trace=self._rank_trace(div["rank"]),

@@ -1,0 +1,617 @@
+"""Model-health plane — the fifth observability layer: watch the *numbers*.
+
+The other four layers watch time and throughput (metrics → fleet → traces →
+goodput/MFU); nothing watched the values flowing through the step. A NaN'd
+gradient, a silently diverging rank, or a loss spike stays invisible until
+the run is garbage — the failure class the reference framework dedicates
+``check_nan_inf`` / ``paddle.amp.debugging`` (TensorCheckerConfig) to, and
+the *detection* half of the MegaScale detect/eject/rollover doctrine whose
+*response* half (checkpoint commits, fleet tripwires) earlier PRs built.
+
+Four channels, all compiled INTO the existing executables (flags are data,
+not shape — the zero-steady-state-recompile gates hold with health ON; the
+disabled path stays the single ``monitor._active is None`` check):
+
+* **numerics tripwires** — a packed per-leaf-group isfinite/overflow stat
+  block rides ``TrainStep``'s compiled outputs (forward loss + grads).  The
+  host pulls it only every ``PADDLE_HEALTH_SAMPLE`` steps (one sync per
+  sample, not per step); a trip escalates the step's trace, WARNs naming
+  the offending leaf groups, runs an eager follow-up sweep over the live
+  params for exact leaf attribution, dumps the flight ring, and advances
+  ``health/*`` counters.
+* **per-layer tensor stats** — grad-norm, activation RMS (collected through
+  the existing ``core/remat.py`` checkpoint-name tags attn_qkv /
+  attn_context / attn_out / mlp_hidden), and update-to-weight ratio per
+  leaf group, gauged on the sample cadence so ``fleet_top``/prom see
+  layer-resolved health.  Activation taps are SUSPENDED inside
+  ``jax.lax.scan`` bodies and ``jax.checkpoint`` (remat) regions — a value
+  recorded there is an inner-trace tracer that cannot legally escape to the
+  step's outputs — so activation RMS covers the discrete-block non-remat
+  path; grad/update/digest stats work everywhere.
+* **loss-spike detector** — rolling median/MAD window over the (sampled)
+  loss with quarantine semantics: the spike value never enters the window.
+  An opt-in ``rollback_on_spike`` hook (``hapi.callbacks.AutoCheckpoint``,
+  or ``TrainStep.rollback_last_commit`` in a raw loop) restores the last
+  snapshot committed BEFORE the spike step.
+* **cross-rank weight-divergence digests** — a fixed-pseudo-random-
+  projection digest of params and grads computed in-executable (Rademacher
+  probes hashed elementwise from the flat index, salted per leaf and
+  probe — partition-invariant under TP/ZeRO, nothing materialized), gauged
+  per rank and published through the fleet collector; the aggregator flags
+  a rank whose *weights* — not just step counts — diverged.
+
+Env surface: ``PADDLE_HEALTH=0`` opts a monitor session out;
+``PADDLE_HEALTH_SAMPLE`` (default 16) is the host sampling cadence;
+``PADDLE_HEALTH_OVERFLOW`` (default 1e8) the |grad| overflow threshold;
+``PADDLE_HEALTH_DIGEST`` (default 2) the probe count (0 disables digests);
+``PADDLE_HEALTH_SPIKE_WINDOW``/``_K``/``_MIN`` tune the spike detector;
+``PADDLE_HEALTH_FAULT`` is the chaos seam (mirror of PADDLE_CKPT_FAULT /
+PADDLE_SERVE_FAULT): ``nan@param:N[:leaf]`` poisons a parameter with NaN
+before call N, ``scale@param:N[:factor]`` multiplies one to plant a loss
+spike — host-side, deterministic, parsed once.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import warnings
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["HealthPlane", "CompiledHealth", "SpikeDetector", "FaultPlan",
+           "collect_taps", "suspend_taps", "active_taps", "probe_salt",
+           "DIGEST_STEP_GAUGE", "DIGEST_PREFIX"]
+
+# gauge names the fleet aggregator keys its cross-rank comparison on
+DIGEST_STEP_GAUGE = "health/digest_step"
+DIGEST_PREFIX = "health/digest/"
+
+
+def probe_salt(leaf_j: int, probe_d: int) -> int:
+    """The 32-bit salt seeding leaf ``j``'s probe-``d`` Rademacher vector
+    (shared with tests' eager oracle: the digest contract is that the
+    compiled sharded computation reproduces exactly this keying)."""
+    return (0x5EED ^ (leaf_j * 0x9E3779B9) ^ (probe_d * 0x85EBCA6B)) \
+        & 0xFFFFFFFF
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ------------------------------------------------------------ activation taps
+#
+# core/remat.py's tag_array calls active_taps() at TRACE time: when a
+# collector is open (TrainStep building with health on) each named
+# activation contributes (sum of squares, element count) so the harvested
+# RMS rides the executable's outputs. Thread-local: tracing happens on the
+# calling thread; a serving engine tracing concurrently never sees a train
+# step's collector.
+
+_tls = threading.local()
+
+
+class _TapCollector:
+    def __init__(self):
+        self.sumsq = {}
+        self.count = {}
+
+    def record(self, name: str, x) -> None:
+        import jax.numpy as jnp
+        xf = x.astype(jnp.float32)
+        self.sumsq[name] = self.sumsq.get(name, 0.0) + jnp.sum(xf * xf)
+        self.count[name] = self.count.get(name, 0) + int(x.size)
+
+    def harvest(self) -> dict:
+        """{name: rms} as traced scalars (empty when nothing tapped)."""
+        import jax.numpy as jnp
+        return {n: jnp.sqrt(self.sumsq[n] / max(self.count[n], 1))
+                for n in self.sumsq}
+
+
+def active_taps() -> Optional[_TapCollector]:
+    if getattr(_tls, "suspended", 0):
+        return None
+    return getattr(_tls, "taps", None)
+
+
+class collect_taps:
+    """Context manager: collect named-activation stats while tracing."""
+
+    def __enter__(self) -> _TapCollector:
+        self._prev = getattr(_tls, "taps", None)
+        _tls.taps = _TapCollector()
+        return _tls.taps
+
+    def __exit__(self, *exc):
+        _tls.taps = self._prev
+        return False
+
+
+class suspend_taps:
+    """Pause tap collection inside scan bodies / jax.checkpoint regions,
+    where recorded values would be inner-trace tracers that cannot escape
+    to the step's outputs (re-entrant)."""
+
+    def __enter__(self):
+        _tls.suspended = getattr(_tls, "suspended", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.suspended -= 1
+        return False
+
+
+# ------------------------------------------------------------- leaf grouping
+
+
+def leaf_groups(names):
+    """Group trainable-leaf names by module (name minus its last component:
+    ``h.3.attn.qkv_proj.weight`` → ``h.3.attn.qkv_proj``) — fine enough to
+    name the offending layer, coarse enough that the packed stat block
+    stays a few hundred floats. Returns (group names, per-leaf index)."""
+    groups, group_of, index = [], [], {}
+    for n in names:
+        g = n.rsplit(".", 1)[0] if "." in n else n
+        if g not in index:
+            index[g] = len(groups)
+            groups.append(g)
+        group_of.append(index[g])
+    return groups, group_of
+
+
+# --------------------------------------------------------- compiled builders
+
+
+class CompiledHealth:
+    """The trace-time half: jnp builders TrainStep._build calls while
+    constructing the step function. Everything returned is fixed-shape
+    ([G,3] grad stats, [G,2] update/weight, [2] loss, [D] digests) — the
+    sampling cadence and every threshold stay host-side data, so health
+    never adds a shape bucket."""
+
+    def __init__(self, plane: "HealthPlane", names):
+        self.plane = plane
+        self.groups, self.group_of = leaf_groups(names)
+        self.names = list(names)
+        self.n_probes = plane.digest_probes
+
+    # stat layout columns (host side indexes by these)
+    GRAD_NONFINITE, GRAD_MAXABS, GRAD_SUMSQ = 0, 1, 2
+
+    def grad_stats(self, grads):
+        """[G, 3] per leaf group: (nonfinite count, max |finite|, finite
+        sum-of-squares). NaN/Inf are excluded from the max/sumsq columns so
+        the overflow and norm figures stay meaningful on a tripped step."""
+        import jax.numpy as jnp
+        G = len(self.groups)
+        nf = [jnp.float32(0.0)] * G
+        mx = [jnp.float32(0.0)] * G
+        ss = [jnp.float32(0.0)] * G
+        for g, gi in zip(grads, self.group_of):
+            gf = g.astype(jnp.float32)
+            fin = jnp.isfinite(gf)
+            a = jnp.where(fin, jnp.abs(gf), 0.0)
+            nf[gi] = nf[gi] + (jnp.float32(gf.size) -
+                               jnp.sum(fin).astype(jnp.float32))
+            mx[gi] = jnp.maximum(mx[gi], jnp.max(a) if gf.size else 0.0)
+            ss[gi] = ss[gi] + jnp.sum(a * a)
+        return jnp.stack([jnp.stack(nf), jnp.stack(mx), jnp.stack(ss)],
+                         axis=1)
+
+    def ratio_stats(self, new_upd, upd_in):
+        """[G, 2] per leaf group: (sum |Δw|², sum |w|²) in fp32 — the
+        update-to-weight ratio ‖Δw‖/‖w‖ is the classic LR-sanity figure."""
+        import jax.numpy as jnp
+        G = len(self.groups)
+        du = [jnp.float32(0.0)] * G
+        w = [jnp.float32(0.0)] * G
+        for nu, u, gi in zip(new_upd, upd_in, self.group_of):
+            d = (nu.astype(jnp.float32) - u.astype(jnp.float32))
+            du[gi] = du[gi] + jnp.sum(d * d)
+            uf = u.astype(jnp.float32)
+            w[gi] = w[gi] + jnp.sum(uf * uf)
+        return jnp.stack([jnp.stack(du), jnp.stack(w)], axis=1)
+
+    def loss_stats(self, loss):
+        """[2]: (nonfinite flag, |loss|) — the forward tripwire."""
+        import jax.numpy as jnp
+        lf = loss.astype(jnp.float32)
+        return jnp.stack([1.0 - jnp.isfinite(lf).astype(jnp.float32),
+                          jnp.abs(jnp.where(jnp.isfinite(lf), lf, 0.0))])
+
+    def digest(self, leaves):
+        """[D] fixed-pseudo-random-projection digest: per probe d, the sum
+        over leaves j of ⟨leaf_j, r(j, d)⟩ where r is a ±1 Rademacher vector
+        derived ELEMENTWISE from the flat index by an integer hash (murmur3
+        finalizer) salted with (leaf, probe). Elementwise-in-the-index is
+        the load-bearing property: each device hashes exactly the indices of
+        the shard it holds, so the digest of a sharded leaf is bitwise the
+        digest of the gathered global leaf — partition-INVARIANT under
+        TP/ZeRO, which ``jax.random.*`` inside an SPMD program is not (the
+        partitioner may split a threefry counter computation and change the
+        bits; jax_threefry_partitionable defaults off). Nothing is
+        materialized between steps and every rank derives identical probes,
+        so two ranks holding bitwise-equal weights produce bitwise-equal
+        digests and the fleet aggregator can flag the rank whose weights
+        forked."""
+        import jax
+        import jax.numpy as jnp
+
+        def probe(n, j, d):
+            i = jax.lax.iota(jnp.uint32, n)
+            x = i ^ jnp.uint32(probe_salt(j, d))
+            x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+            x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+            x = x ^ (x >> 16)
+            return 1.0 - 2.0 * (x & 1).astype(jnp.float32)
+
+        out = []
+        for d in range(self.n_probes):
+            acc = jnp.float32(0.0)
+            for j, x in enumerate(leaves):
+                r = probe(int(x.size), j, d)
+                acc = acc + jnp.vdot(x.astype(jnp.float32).reshape(-1), r)
+            out.append(acc)
+        return jnp.stack(out)
+
+    def pack(self, loss, grads, new_upd, upd_in, act):
+        """The health output pytree riding the step's loss_out dict."""
+        out = {"loss2": self.loss_stats(loss),
+               "grad": self.grad_stats(grads),
+               "ratio": self.ratio_stats(new_upd, upd_in)}
+        if self.n_probes > 0:
+            out["pdig"] = self.digest(new_upd)
+            out["gdig"] = self.digest(grads)
+        if act:
+            out["act"] = act
+        return out
+
+
+# ------------------------------------------------------------ spike detector
+
+
+class SpikeDetector:
+    """Rolling median/MAD outlier test with quarantine semantics: a value
+    flagged as a spike is NEVER appended to the window (one bad step must
+    not drag the baseline toward itself, and a rollback replaying the same
+    region must re-trip deterministically)."""
+
+    def __init__(self, window: int = 32, k: float = 10.0, min_fill: int = 8):
+        self.window = max(int(window), 4)
+        self.k = float(k)
+        self.min_fill = max(int(min_fill), 2)
+        self.vals = deque(maxlen=self.window)
+
+    def observe(self, loss: float) -> Optional[dict]:
+        """Feed one loss; returns a spike-info dict or None."""
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return {"loss": loss, "median": None, "mad": None,
+                    "nonfinite": True}
+        if len(self.vals) >= self.min_fill:
+            s = sorted(self.vals)
+            med = s[len(s) // 2]
+            mad = sorted(abs(v - med) for v in s)[len(s) // 2]
+            floor = 1e-8 * max(abs(med), 1.0)
+            if abs(loss - med) > self.k * max(mad, floor):
+                return {"loss": loss, "median": med, "mad": mad,
+                        "nonfinite": False}
+        self.vals.append(loss)
+        return None
+
+    def reset(self):
+        self.vals.clear()
+
+
+# ----------------------------------------------------------------- chaos seam
+
+
+class FaultPlan:
+    """PADDLE_HEALTH_FAULT: deterministic host-side numerics faults, the
+    mirror of PADDLE_CKPT_FAULT / PADDLE_SERVE_FAULT. Schedule syntax
+    ``<action>@<site>:<nth>[:<arg>]``, comma-separated:
+
+    * ``nan@param:N[:leaf]``  — before TrainStep call N (1-based), write a
+      NaN into element 0 of the first trainable param (or the first whose
+      name contains ``leaf``). The fast path re-adopts the replaced array,
+      so the poison flows through the compiled step like any real flip.
+    * ``scale@param:N[:factor]`` — multiply that param by ``factor``
+      (default 64): a finite perturbation that plants a loss SPIKE without
+      tripping the NaN channel.
+
+    Inputs are integer token ids here, so the seam poisons parameters —
+    the realistic entry point for a numerics fault (bad HBM bit, optimizer
+    bug, torn restore) anyway."""
+
+    def __init__(self, entries):
+        self.entries = entries          # [(action, nth, arg)]
+        self.calls = 0
+        self.fired = []
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> Optional["FaultPlan"]:
+        if not spec:
+            return None
+        entries = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                action, rest = part.split("@", 1)
+                site, nth, *arg = rest.split(":")
+                if site != "param" or action not in ("nan", "scale"):
+                    raise ValueError(part)
+                entries.append((action, int(nth), arg[0] if arg else None))
+            except (ValueError, IndexError):
+                warnings.warn(f"PADDLE_HEALTH_FAULT: unparsable entry "
+                              f"{part!r} (want <nan|scale>@param:<nth>"
+                              f"[:<arg>]); ignoring it", RuntimeWarning)
+        return cls(entries) if entries else None
+
+    def maybe_fire(self, named_params, emit=None) -> None:
+        """Called once per TrainStep call with [(name, Parameter)]."""
+        self.calls += 1
+        for action, nth, arg in self.entries:
+            if nth != self.calls:
+                continue
+            self._fire(action, arg, named_params, emit)
+
+    def _fire(self, action, arg, named_params, emit):
+        import jax
+        target = None
+        for n, p in named_params:
+            if not p.trainable:
+                continue
+            if action == "nan" and arg and arg not in n:
+                continue
+            target = (n, p)
+            break
+        if target is None:
+            return
+        n, p = target
+        arr = np.asarray(jax.device_get(p.value()))
+        if action == "nan":
+            arr = arr.copy()
+            arr.flat[0] = np.nan
+        else:
+            arr = arr * np.asarray(float(arg) if arg else 64.0,
+                                   dtype=arr.dtype)
+        sharding = getattr(p._data, "sharding", None)
+        p._data = jax.device_put(arr, sharding) if sharding is not None \
+            else jax.device_put(arr)
+        self.fired.append((self.calls, action, n))
+        if emit is not None:
+            emit("health_fault", call=self.calls, action=action, leaf=n)
+
+
+# ------------------------------------------------------------------ the plane
+
+
+class HealthPlane:
+    """One monitor session's health state: config, spike detector, trip
+    bookkeeping, and the host half of the sampled check. Created by
+    ``Monitor.__init__`` — it rides every session unless PADDLE_HEALTH
+    opts out — and consulted by TrainStep at build time (compiled half)
+    and on the sample cadence (host half)."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+        v = os.environ.get("PADDLE_HEALTH", "")
+        self.enabled = not (v and v.lower() in ("0", "false", "no", "off"))
+        self.sample_every = max(_env_int("PADDLE_HEALTH_SAMPLE", 16), 1)
+        self.overflow = _env_float("PADDLE_HEALTH_OVERFLOW", 1e8)
+        self.digest_probes = max(_env_int("PADDLE_HEALTH_DIGEST", 2), 0)
+        self.spike = SpikeDetector(
+            window=_env_int("PADDLE_HEALTH_SPIKE_WINDOW", 32),
+            k=_env_float("PADDLE_HEALTH_SPIKE_K", 10.0),
+            min_fill=_env_int("PADDLE_HEALTH_SPIKE_MIN", 8))
+        self.fault = FaultPlan.parse(os.environ.get("PADDLE_HEALTH_FAULT"))
+        self.rollback_hook = None     # callable(step, info) — opt-in
+        self.nan_trips = 0
+        self.overflow_trips = 0
+        self.spikes = 0
+        self._dumps = 0
+        self._max_dumps = 3
+
+    # ---------------------------------------------------------- compile side
+
+    def compiled_spec(self, names) -> Optional[CompiledHealth]:
+        """The builder TrainStep._build asks for; None keeps the program
+        byte-for-byte what it always was."""
+        if not self.enabled:
+            return None
+        return CompiledHealth(self, names)
+
+    # ------------------------------------------------------------- host side
+
+    def should_sample(self, step_n: int) -> bool:
+        return self.enabled and step_n % self.sample_every == 0
+
+    def on_sample(self, spec: CompiledHealth, step_n: int, loss_val: float,
+                  payload: dict, named_params=None, trace=None) -> dict:
+        """One sampled host check: gauges, tripwires, spike feed, digest
+        publication. ``payload`` is the device pytree pulled to numpy by
+        the caller (the sample's one sync). Returns {"nan":…, "overflow":…,
+        "spike":…} describing what tripped."""
+        reg = self.monitor.registry
+        g = reg.gauge
+        groups = spec.groups
+        grad = np.asarray(payload["grad"], np.float64)
+        ratio = np.asarray(payload["ratio"], np.float64)
+        loss2 = np.asarray(payload["loss2"], np.float64)
+
+        g("health/sample_every").set(self.sample_every)
+        g("health/groups").set(len(groups))
+        g("health/loss").set(float(loss_val)
+                             if math.isfinite(float(loss_val)) else -1.0)
+        for i, name in enumerate(groups):
+            g(f"health/grad_norm.{name}").set(math.sqrt(max(grad[i, 2], 0)))
+            g(f"health/grad_max.{name}").set(grad[i, 1])
+            wn = math.sqrt(max(ratio[i, 1], 0.0))
+            un = math.sqrt(max(ratio[i, 0], 0.0))
+            g(f"health/update_ratio.{name}").set(un / wn if wn > 0 else 0.0)
+        for name, rms in (payload.get("act") or {}).items():
+            g(f"health/act_rms.{name}").set(float(np.asarray(rms)))
+        if "pdig" in payload:
+            g(DIGEST_STEP_GAUGE).set(step_n)
+            for d, v in enumerate(np.asarray(payload["pdig"], np.float64)):
+                g(f"{DIGEST_PREFIX}p{d}").set(float(v))
+            for d, v in enumerate(np.asarray(payload["gdig"], np.float64)):
+                g(f"{DIGEST_PREFIX}g{d}").set(float(v))
+
+        nan_groups = [groups[i] for i in np.nonzero(grad[:, 0] > 0)[0]]
+        loss_bad = loss2[0] > 0
+        over_groups = [groups[i]
+                       for i in np.nonzero(grad[:, 1] > self.overflow)[0]]
+        out = {"nan": None, "overflow": None, "spike": None}
+        if nan_groups or loss_bad:
+            out["nan"] = self._trip_nan(step_n, nan_groups, loss_bad,
+                                        loss_val, named_params, trace)
+        elif over_groups:
+            out["overflow"] = self._trip_overflow(step_n, over_groups,
+                                                  float(grad[:, 1].max()),
+                                                  trace)
+        if not loss_bad:
+            sp = self.spike.observe(loss_val)
+            if sp is not None:
+                out["spike"] = self.spike_tripped(step_n, sp,
+                                                  source="train_step",
+                                                  trace=trace)
+        return out
+
+    # ------------------------------------------------------------- tripwires
+
+    def sweep_leaves(self, named_params, limit: int = 8):
+        """Eager follow-up sweep for EXACT attribution: which live leaves
+        hold non-finite values right now. The compiled flags name the leaf
+        GROUP cheaply every sample; this names the leaves, paid only on a
+        trip. (Under a compiled-in GradScaler the update was skipped and
+        params stay clean — then the grad-stat groups are the attribution
+        and this sweep correctly reports no poisoned weights.)"""
+        bad = []
+        for n, p in named_params or ():
+            try:
+                a = np.asarray(p.value(), np.float32)
+            except Exception:
+                continue
+            k = int(np.size(a) - np.count_nonzero(np.isfinite(a)))
+            if k:
+                bad.append({"leaf": n, "nonfinite": k})
+                if len(bad) >= limit:
+                    break
+        return bad
+
+    def _flight_dump(self):
+        if self._dumps >= self._max_dumps:
+            return None
+        self._dumps += 1
+        try:
+            return self.monitor.dump()
+        except Exception:
+            return None
+
+    def _escalate(self, reason: str):
+        from . import trace as _trace_mod
+        tracer = _trace_mod._active
+        if tracer is not None:
+            tracer.escalate(reason=reason)
+            return tracer.current_trace_id()
+        return None
+
+    def _trip_nan(self, step_n, groups, loss_bad, loss_val, named_params,
+                  trace):
+        self.nan_trips += 1
+        mon = self.monitor
+        mon.registry.counter("health/nan_trips").inc()
+        for name in groups:
+            mon.registry.counter(f"health/nan_trips.{name}").inc()
+        tid = trace or self._escalate("health_nan")
+        leaves = self.sweep_leaves(named_params)
+        dump = self._flight_dump()
+        info = dict(step=step_n, groups=groups, loss_nonfinite=bool(loss_bad),
+                    loss=float(loss_val), leaves=leaves, dump=dump)
+        mon.emit("health_nan", **({"trace": tid, **info} if tid else info))
+        where = ", ".join(groups) if groups else "forward loss"
+        warnings.warn(
+            f"health: non-finite values at step {step_n} in [{where}]"
+            + (f"; poisoned leaves: "
+               f"{[b['leaf'] for b in leaves]}" if leaves else "")
+            + (f" [trace {tid}]" if tid else "")
+            + " — see the health_nan event / flight dump for the sweep",
+            RuntimeWarning, stacklevel=3)
+        return info
+
+    def _trip_overflow(self, step_n, groups, max_abs, trace):
+        self.overflow_trips += 1
+        mon = self.monitor
+        mon.registry.counter("health/overflow_trips").inc()
+        tid = trace or self._escalate("health_overflow")
+        info = dict(step=step_n, groups=groups, max_abs=max_abs,
+                    threshold=self.overflow)
+        mon.emit("health_overflow",
+                 **({"trace": tid, **info} if tid else info))
+        warnings.warn(
+            f"health: |grad| {max_abs:.3e} exceeds the overflow threshold "
+            f"{self.overflow:.1e} at step {step_n} in [{', '.join(groups)}]"
+            + (f" [trace {tid}]" if tid else ""),
+            RuntimeWarning, stacklevel=3)
+        return info
+
+    def spike_tripped(self, step_n, sp: dict, source: str, trace=None):
+        """A loss spike was detected (by the sampled channel or a fit-loop
+        feed). Emits + counts, then runs the opt-in rollback hook."""
+        self.spikes += 1
+        mon = self.monitor
+        mon.registry.counter("health/spikes").inc()
+        tid = trace or self._escalate("health_spike")
+        info = dict(step=step_n, source=source, **sp)
+        mon.emit("health_spike", **({"trace": tid, **info} if tid else info))
+        med = sp.get("median")
+        warnings.warn(
+            f"health: loss spike at step {step_n}: {sp['loss']:.6g}"
+            + (f" vs rolling median {med:.6g} (mad {sp['mad']:.3g})"
+               if med is not None else " (non-finite)")
+            + (f" [trace {tid}]" if tid else ""),
+            RuntimeWarning, stacklevel=3)
+        hook, self_info = self.rollback_hook, info
+        if hook is not None:
+            try:
+                res = hook(step_n, info)
+            except Exception as e:
+                warnings.warn(f"health: rollback_on_spike hook failed "
+                              f"({type(e).__name__}: {e}); training "
+                              f"continues un-rolled-back", RuntimeWarning)
+                res = None
+            if res is not None:
+                mon.registry.counter("health/rollbacks").inc()
+                mon.emit("health_rollback", spike_step=step_n,
+                         restored_step=res.get("step")
+                         if isinstance(res, dict) else None)
+                self.spike.reset()
+                self_info["rollback"] = res if isinstance(res, dict) \
+                    else {"restored": True}
+        return self_info
+
+    def scaler_outcome(self, found_inf: bool, scale: float):
+        """amp.GradScaler feed: the loss-scale trajectory next to the trip
+        timeline is how the summary separates 'scaler doing its job'
+        (trips with skipped updates) from 'update unprotected'."""
+        reg = self.monitor.registry
+        reg.gauge("health/loss_scale").set(float(scale))
+        if found_inf:
+            reg.counter("health/found_inf").inc()

@@ -31,6 +31,44 @@ __all__ = ["render", "render_snapshot", "render_fleet", "sanitize"]
 
 _BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
+# HELP text for the families a dashboard needs explained at the endpoint —
+# the model-health plane especially, whose numbers are meaningless without
+# units/semantics. Keyed by RAW metric path; per-layer-group suffixes
+# (``health/grad_norm.h.0.attn``) match their family via the ``.`` split,
+# digest probes (``health/digest/p0``) via prefix. Unknown families render
+# without HELP, exactly as before.
+_HELP = {
+    "health/nan_trips": "sampled steps whose loss or grads held NaN/Inf",
+    "health/overflow_trips":
+        "sampled steps with |grad| over PADDLE_HEALTH_OVERFLOW",
+    "health/spikes": "loss spikes vs the rolling median/MAD window",
+    "health/rollbacks": "spike rollbacks that restored a prior snapshot",
+    "health/found_inf": "GradScaler-skipped updates (non-finite grads)",
+    "health/loss": "last sampled loss (-1 when non-finite)",
+    "health/loss_scale": "current AMP dynamic loss scale",
+    "health/grad_norm": "per-layer-group gradient L2 norm (sampled)",
+    "health/grad_max": "per-layer-group max |grad| over finite entries",
+    "health/update_ratio": "per-layer-group update-to-weight norm ratio",
+    "health/act_rms": "activation RMS at remat-tagged points (sampled)",
+    "health/digest_step": "train step of the published weight digest",
+    "health/digest/": "Rademacher-projection weight/grad digest probe "
+                      "(cross-rank divergence comparison)",
+    "serve/nan_logits": "requests terminalized for non-finite logits",
+    "fleet/weight_divergence":
+        "1 while one rank's weight digest disagrees with its siblings",
+    "fleet/weight_diverged_rank": "the rank whose weight digest forked",
+}
+
+
+def _help_for(raw: str):
+    fam = raw.split(".", 1)[0]
+    h = _HELP.get(raw) or _HELP.get(fam)
+    if h is None:
+        for k, v in _HELP.items():
+            if k.endswith("/") and raw.startswith(k):
+                return v
+    return h
+
 
 def sanitize(name: str, prefix: str = "paddle") -> str:
     n = _BAD.sub("_", name.strip("/"))
@@ -58,6 +96,13 @@ def _num(v) -> str:
     return repr(f)
 
 
+def _head(raw: str, name: str, typ: str, out: list):
+    h = _help_for(raw)
+    if h:
+        out.append(f"# HELP {name} {h}")
+    out.append(f"# TYPE {name} {typ}")
+
+
 def _hist_lines(name: str, h: dict, labels: dict, out: list):
     """One histogram summary -> quantile + _sum/_count lines."""
     for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
@@ -75,17 +120,17 @@ def render_snapshot(snap: dict, labels: dict = None,
     out = []
     for raw, v in sorted((snap.get("counters") or {}).items()):
         name = sanitize(raw, prefix) + "_total"
-        out.append(f"# TYPE {name} counter")
+        _head(raw, name, "counter", out)
         out.append(f"{name}{_labels(labels)} {_num(v)}")
     for raw, v in sorted((snap.get("gauges") or {}).items()):
         name = sanitize(raw, prefix)
-        out.append(f"# TYPE {name} gauge")
+        _head(raw, name, "gauge", out)
         out.append(f"{name}{_labels(labels)} {_num(v)}")
     for raw, h in sorted((snap.get("histograms") or {}).items()):
         if not isinstance(h, dict):
             continue
         name = sanitize(raw, prefix)
-        out.append(f"# TYPE {name} summary")
+        _head(raw, name, "summary", out)
         _hist_lines(name, h, labels, out)
     return "\n".join(out) + ("\n" if out else "")
 
@@ -98,19 +143,19 @@ def render_fleet(rec: dict, prefix: str = "paddle") -> str:
     metrics = rec.get("metrics") or {}
     for raw, m in sorted((metrics.get("counters") or {}).items()):
         name = sanitize(raw, prefix) + "_total"
-        out.append(f"# TYPE {name} counter")
+        _head(raw, name, "counter", out)
         for r, v in sorted((m.get("per_rank") or {}).items(),
                            key=lambda kv: int(kv[0])):
             out.append(f"{name}{_labels({'rank': r})} {_num(v)}")
     for raw, m in sorted((metrics.get("gauges") or {}).items()):
         name = sanitize(raw, prefix)
-        out.append(f"# TYPE {name} gauge")
+        _head(raw, name, "gauge", out)
         for r, v in sorted((m.get("per_rank") or {}).items(),
                            key=lambda kv: int(kv[0])):
             out.append(f"{name}{_labels({'rank': r})} {_num(v)}")
     for raw, m in sorted((metrics.get("histograms") or {}).items()):
         name = sanitize(raw, prefix)
-        out.append(f"# TYPE {name} summary")
+        _head(raw, name, "summary", out)
         per = m.get("per_rank") or {}
         if per:
             for r, h in sorted(per.items(), key=lambda kv: int(kv[0])):
@@ -119,7 +164,7 @@ def render_fleet(rec: dict, prefix: str = "paddle") -> str:
             _hist_lines(name, m, {}, out)
     for raw, v in sorted((rec.get("derived") or {}).items()):
         name = sanitize(raw, prefix)
-        out.append(f"# TYPE {name} gauge")
+        _head(raw, name, "gauge", out)
         out.append(f"{name} {_num(v)}")
     stale = set(rec.get("stale") or [])
     ranks = rec.get("ranks") or []

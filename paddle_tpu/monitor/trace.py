@@ -41,7 +41,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Dict, Optional
 
 from .sink import JsonlSink
 
@@ -166,6 +166,10 @@ class _Trace:
         if not self.sampled:
             self.sampled = True
             self.tracer._escalated += 1
+            # per-reason tally: a dump then says WHICH tripwire class
+            # (health_nan, straggler, deadline...) is forcing sampling
+            rs = self.tracer._escalate_reasons
+            rs[reason] = rs.get(reason, 0) + 1
         if self.escalated is None:
             self.escalated = reason
 
@@ -213,6 +217,7 @@ class Tracer:
         self.traces_sampled = 0
         self.spans_written = 0
         self._escalated = 0
+        self._escalate_reasons: Dict[str, int] = {}
         self._via_monitor = False
         if self.sink is not None:
             self.sink.write({"v": TRACE_SCHEMA_VERSION, "kind": "trace_meta",
@@ -376,7 +381,8 @@ class Tracer:
                 "recent": recent, "sample": self.sample,
                 "started": self.traces_started,
                 "sampled": self.traces_sampled,
-                "escalated": self._escalated}
+                "escalated": self._escalated,
+                "escalated_reasons": dict(self._escalate_reasons)}
 
     def flush(self):
         if self.sink is not None:

@@ -511,6 +511,7 @@ class DecodeEngine:
         self.expired = 0
         self.cancelled = 0
         self.drains = 0
+        self.nan_logits = 0
         mon = _monitor._active
         if mon is not None:
             mon.serve_engine(self.max_slots, self.max_len,
@@ -602,13 +603,15 @@ class DecodeEngine:
                 l.training = f
 
     def _pool_out_shardings(self):
-        """out_shardings pytree for (new_pools, picked_token) returns —
-        pools pinned to their (possibly head-sharded) input placement, the
-        token replicated. None off the mesh (single-chip: let jax infer)."""
+        """out_shardings pytree for (new_pools, picked_token, logits_ok)
+        returns — pools pinned to their (possibly head-sharded) input
+        placement, the token and the finite-logits flag replicated. None
+        off the mesh (single-chip: let jax infer)."""
         if self._mesh is None:
             return None
         return ([(self._pool_sh, self._pool_sh)
-                 for _ in range(self.spec.num_layers)], self._repl)
+                 for _ in range(self.spec.num_layers)], self._repl,
+                self._repl)
 
     def _minted(self, kind: str, bucket, compile_s: float, exe=None,
                 tokens=None):
@@ -647,7 +650,11 @@ class DecodeEngine:
                         start_pos=pos)
                     logits = self._head(hidden.value()[:, -1])
                     nxt = self._pick(logits, key).astype(jnp.int32)
-                    return new_pools, nxt
+                    # per-slot finite-logits flag: data, not shape — NaN
+                    # detection never retraces, and a clean step pays one
+                    # row-reduce fused into the head matmul's epilogue
+                    ok = jnp.all(jnp.isfinite(logits), axis=-1)
+                    return new_pools, nxt, ok
                 return self._traced(leaves, body)
 
             pad = self._dev(jnp.zeros(self.max_slots, jnp.int32))
@@ -662,7 +669,8 @@ class DecodeEngine:
                         start_pos=pos)
                     logits = self._head(hidden.value()[:, -1])
                     nxt = self._pick(logits, key).astype(jnp.int32)
-                    return new_caches, nxt
+                    ok = jnp.all(jnp.isfinite(logits), axis=-1)
+                    return new_caches, nxt, ok
                 return self._traced(leaves, body)
 
             args = (self._leaf_values(), self._caches,
@@ -701,8 +709,10 @@ class DecodeEngine:
                     write_end=end)
                 h_last = jax.lax.dynamic_slice_in_dim(
                     hidden.value(), end - p0 - 1, 1, axis=1)[:, 0]
-                tok0 = self._pick(self._head(h_last), key).astype(jnp.int32)
-                return new_pools, tok0[0]
+                logits = self._head(h_last)
+                tok0 = self._pick(logits, key).astype(jnp.int32)
+                ok = jnp.all(jnp.isfinite(logits))
+                return new_pools, tok0[0], ok
             return self._traced(leaves, body)
 
         pad = self._dev(jnp.zeros(self.max_slots, jnp.int32))
@@ -743,7 +753,11 @@ class DecodeEngine:
                     write_end=end)
                 logits = self._head(hidden.value()[0])        # [vw, V]
                 picked = self._pick(logits, key).astype(jnp.int32)
-                return new_pools, picked
+                # one flag over every verified position: a NaN anywhere in
+                # the window poisons the accept test, so the whole dispatch
+                # is disqualified rather than attributed per position
+                ok = jnp.all(jnp.isfinite(logits))
+                return new_pools, picked, ok
             return self._traced(leaves, body)
 
         pad = self._dev(jnp.zeros(self.max_slots, jnp.int32))
@@ -776,14 +790,16 @@ class DecodeEngine:
                 # padding tail is causally invisible to it
                 h_last = jax.lax.dynamic_slice_in_dim(
                     hidden.value(), true_len - 1, 1, axis=1)[:, 0]
-                tok0 = self._pick(self._head(h_last), key).astype(jnp.int32)
+                logits = self._head(h_last)
+                tok0 = self._pick(logits, key).astype(jnp.int32)
+                ok = jnp.all(jnp.isfinite(logits))
                 new_caches = [
                     (jax.lax.dynamic_update_slice(
                         big_k, sk.astype(big_k.dtype), (slot, 0, 0, 0)),
                      jax.lax.dynamic_update_slice(
                         big_v, sv.astype(big_v.dtype), (slot, 0, 0, 0)))
                     for (big_k, big_v), (sk, sv) in zip(caches, small_new)]
-                return new_caches, tok0[0]
+                return new_caches, tok0[0], ok
             return self._traced(leaves, body)
 
         args = (self._leaf_values(), self._caches,
@@ -1017,6 +1033,18 @@ class DecodeEngine:
         if self.paged:
             self._pager.release_slot(slot)
         self._slots.release(slot)
+
+    def _nan_logits(self, req: Request, where: str):
+        """Account one non-finite-logits trip (the caller releases the slot
+        and terminalizes the request as ``failed``): always-on engine
+        counter plus the monitor's ``serve/nan_logits`` mirror, trace-linked
+        to the victim request."""
+        self.nan_logits += 1
+        mon = _monitor._active
+        if mon is not None:
+            mon.serve_nan_logits(where,
+                                 trace_id=req._trace.trace_id
+                                 if req._trace is not None else None)
 
     def _terminalize(self, req: Request, status: str, why: str,
                      finished: Optional[List[Request]], where: str = None):
@@ -1419,14 +1447,14 @@ class DecodeEngine:
         t0 = time.time()
 
         def _call():
-            self._pools, picked = exe(
+            self._pools, picked, ok = exe(
                 self._leaf_values(), self._pools,
                 self._dev(self._pager.tables), self._dev(ids),
                 self._dev(jnp.int32(slot)), self._dev(jnp.int32(p0)),
                 self._dev(jnp.int32(end)), src, dst, self._next_key())
-            return picked
+            return picked, ok
 
-        tok0 = self._dispatch_guarded("chunk", sc, _call)
+        tok0, l_ok = self._dispatch_guarded("chunk", sc, _call)
         chunk_s = time.time() - t0
         st.prefill_s += chunk_s
         mon = _monitor._active
@@ -1439,6 +1467,15 @@ class DecodeEngine:
             st.req._phase.event("chunk", p0=int(p0), end=int(end),
                                 dur_s=round(chunk_s, 6),
                                 cow=len(copies))
+        if not bool(np.asarray(l_ok)):
+            # non-finite logits: this chunk's cached K/V are garbage —
+            # terminalize now instead of prefilling further (or streaming)
+            req = st.req
+            self._nan_logits(req, "chunk")
+            self._release_slot_state(slot)
+            self._terminalize(req, "failed", "non-finite logits (nan)",
+                              finished, where="chunk")
+            return
         if end < st.n:
             return                         # more chunks next iteration
         req = st.req
@@ -1542,13 +1579,13 @@ class DecodeEngine:
                 req._phase.set(slot=slot)
             req._trace_phase("prefill", t0=mono0, slot=slot, bucket=sb)
         def _call():
-            self._caches, picked = exe(
+            self._caches, picked, ok = exe(
                 self._leaf_values(), self._caches, jnp.asarray(ids),
                 jnp.int32(slot), jnp.int32(n), self._next_key())
-            return picked
+            return picked, ok
 
         try:
-            tok0 = self._dispatch_guarded("chunk", sb, _call)
+            tok0, l_ok = self._dispatch_guarded("chunk", sb, _call)
         except BaseException as e:
             # the half-admitted slot is in neither _prefilling nor
             # _slot_req yet, so _fail_engine could not release it — and
@@ -1558,6 +1595,14 @@ class DecodeEngine:
                 self._terminalize(req, "failed", f"engine failed: {e}",
                                   None)
             raise
+        if not bool(np.asarray(l_ok)):
+            # the slot never joined the decode batch; release it and fail
+            # the request instead of streaming from NaN logits
+            self._nan_logits(req, "prefill")
+            self._release_slot_state(slot)
+            self._terminalize(req, "failed", "non-finite logits (nan)",
+                              finished, where="prefill")
+            return
         t = int(tok0)
         dt = time.time() - t0
         req.slot, req.status = slot, "running"
@@ -1622,24 +1667,24 @@ class DecodeEngine:
             t0 = time.time()
 
             def _call():
-                self._pools, picked = exe(
+                self._pools, picked, ok = exe(
                     self._leaf_values(), self._pools,
                     self._dev(self._pager.tables), self._dev(self._tok),
                     self._dev(self._pos), src, dst, self._next_key())
                 # host readback inside the armed window: a hang in the
                 # device sync is a hang in the dispatch
-                return np.asarray(picked)
+                return np.asarray(picked), np.asarray(ok)
         else:
             t0 = time.time()
 
             def _call():
-                self._caches, picked = exe(
+                self._caches, picked, ok = exe(
                     self._leaf_values(), self._caches,
                     jnp.asarray(self._tok), jnp.asarray(self._pos),
                     self._next_key())
-                return np.asarray(picked)
+                return np.asarray(picked), np.asarray(ok)
 
-        nxt = self._dispatch_guarded("decode", None, _call)
+        nxt, l_ok = self._dispatch_guarded("decode", None, _call)
         dt = time.time() - t0
         live = 0
         for slot in range(self.max_slots):
@@ -1647,6 +1692,14 @@ class DecodeEngine:
             if req is None:
                 continue
             live += 1
+            if not bool(l_ok[slot]):
+                # this slot's logits went non-finite: fail ITS request and
+                # free the slot; the rest of the batch streams on untouched
+                self._nan_logits(req, "decode")
+                self._release_slot_state(slot)
+                self._terminalize(req, "failed", "non-finite logits (nan)",
+                                  finished, where="decode")
+                continue
             t = int(nxt[slot])
             req.tokens.append(t)
             self.tokens_generated += 1
@@ -1721,18 +1774,27 @@ class DecodeEngine:
             t0 = time.time()
 
             def _call():
-                self._pools, picked = exe(
+                self._pools, picked, ok = exe(
                     self._leaf_values(), self._pools,
                     self._dev(self._pager.tables), self._dev(ids),
                     self._dev(jnp.int32(slot)), self._dev(jnp.int32(p)),
                     self._dev(jnp.int32(end)), src, dst, self._next_key())
                 # host readback inside the armed window (see _decode)
-                return np.asarray(picked)
+                return np.asarray(picked), np.asarray(ok)
 
             # on dispatch failure _fail_engine terminalizes every tenant
             # and releases the pager state — the reservation dies with it
-            out = self._dispatch_guarded("verify", vw, _call)
+            out, l_ok = self._dispatch_guarded("verify", vw, _call)
             dt = time.time() - t0
+            if not bool(l_ok):
+                # a NaN anywhere in the verify window poisons the accept
+                # test: fail the request (release_slot frees the
+                # speculative reservation with the rest of its blocks)
+                self._nan_logits(req, "verify")
+                self._release_slot_state(slot)
+                self._terminalize(req, "failed", "non-finite logits (nan)",
+                                  finished, where="verify")
+                continue
             a = 0
             while a < k and int(out[a]) == drafts[a]:
                 a += 1
@@ -1826,6 +1888,7 @@ class DecodeEngine:
                 "expired": self.expired,
                 "cancelled": self.cancelled,
                 "drains": self.drains,
+                "nan_logits": self.nan_logits,
                 "draining": self._draining,
                 "hang_warns": self._watchdog.hangs
                 if self._watchdog is not None else 0,

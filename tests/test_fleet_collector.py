@@ -334,6 +334,86 @@ def test_divergence_tripwire_flags_lone_rank(tmp_path):
     agg.stop(final=False)
 
 
+def test_weight_divergence_digest_flags_forked_rank(tmp_path):
+    """The health plane's cross-rank channel: a rank whose weight DIGEST
+    disagrees with every sibling at the newest shared digest step is
+    flagged (two-poll streak, warn once, named rank + derived gauges),
+    and the flag clears when the digests re-agree."""
+    t = LocalTransport()
+    fleet = str(tmp_path / "run.fleet.jsonl")
+    r0, p0 = _mk_rank(t, 0)
+    r1, p1 = _mk_rank(t, 1)
+    agg = Aggregator(t, world=2, fleet_path=fleet, interval=0.1)
+
+    def set_digest(reg, step, v0, v1):
+        reg.gauge("health/digest_step").set(step)
+        reg.gauge("health/digest/p0").set(v0)
+        reg.gauge("health/digest/p1").set(v1)
+
+    def div_warns():
+        return [r for r in _read_jsonl(fleet)
+                if r["kind"] == "fleet_warn"
+                and r["warn"] == "weight_divergence"]
+
+    def publish_poll():
+        _steps(r0, 1, 0.01), _steps(r1, 1, 0.01)
+        p0.publish_once(), p1.publish_once()
+        return agg.poll_once()
+
+    # agreement: bitwise-equal digests at the same step -> no flag
+    set_digest(r0, 10, 1.25, -3.5)
+    set_digest(r1, 10, 1.25, -3.5)
+    rec = publish_poll()
+    assert rec["derived"]["fleet/weight_divergence"] == 0.0
+    assert "fleet/weight_diverged_rank" not in rec["derived"]
+
+    # rank 1's weights fork at step 20 (beyond the relative tolerance);
+    # one poll of disagreement could be a torn read -> no warn yet
+    set_digest(r0, 20, 2.0, -1.0)
+    set_digest(r1, 20, 2.1, -1.0)
+    rec = publish_poll()
+    assert not div_warns()
+    assert rec["derived"]["fleet/weight_divergence"] == 0.0
+    # second consecutive poll: forked for real -> warn names the rank
+    rec = publish_poll()
+    warns = div_warns()
+    assert len(warns) == 1 and warns[0]["rank"] == 1
+    assert warns[0]["step"] == 20
+    assert "WEIGHTS" in warns[0]["msg"]
+    assert rec["derived"]["fleet/weight_divergence"] == 1.0
+    assert rec["derived"]["fleet/weight_diverged_rank"] == 1
+    # episode already warned: later polls do not spam
+    rec = publish_poll()
+    assert len(div_warns()) == 1
+
+    # recovery: the rank is restored, digests re-agree -> flag clears
+    set_digest(r0, 30, 4.0, 2.0)
+    set_digest(r1, 30, 4.0, 2.0)
+    rec = publish_poll()
+    assert rec["derived"]["fleet/weight_divergence"] == 0.0
+    assert "fleet/weight_diverged_rank" not in rec["derived"]
+    assert len(div_warns()) == 1
+    agg.stop(final=False)
+
+
+def test_weight_divergence_within_tolerance_silent(tmp_path):
+    """Sub-tolerance digest wobble (fp reduction-order noise between
+    otherwise-identical ranks) must NOT flag."""
+    t = LocalTransport()
+    r0, p0 = _mk_rank(t, 0)
+    r1, p1 = _mk_rank(t, 1)
+    agg = Aggregator(t, world=2, fleet_path=None, interval=0.1)
+    for reg, v in ((r0, 100.0), (r1, 100.0 + 100.0 * 1e-6)):
+        reg.gauge("health/digest_step").set(5)
+        reg.gauge("health/digest/p0").set(v)
+    for _ in range(3):
+        _steps(r0, 1, 0.01), _steps(r1, 1, 0.01)
+        p0.publish_once(), p1.publish_once()
+        rec = agg.poll_once()
+        assert rec["derived"]["fleet/weight_divergence"] == 0.0
+    agg.stop(final=False)
+
+
 # --------------------------------------------------------- elastic crosscheck
 
 

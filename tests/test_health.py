@@ -1,0 +1,520 @@
+"""Model-health plane acceptance (numerics tripwires, per-layer stats,
+loss-spike rollback, weight-divergence digests — monitor/health.py).
+
+The contract under test:
+
+* health stats ride the COMPILED step's outputs: with the plane ON a
+  same-shape training loop still mints exactly one executable per shape
+  bucket (zero steady-state recompiles), and ``PADDLE_HEALTH=0`` keeps the
+  plain-loss path byte-for-byte (no health key, no gauges);
+* chaos NaN (``PADDLE_HEALTH_FAULT=nan@param:N``) is detected within ONE
+  sample interval with a WARN naming the offending leaf group, the exact
+  poisoned leaves (eager follow-up sweep) and the step's trace id;
+* the overflow channel trips on |grad| over ``PADDLE_HEALTH_OVERFLOW``;
+* a planted loss spike (``scale@param``) triggers the opt-in rollback hook:
+  the last snapshot committed BEFORE the spike is restored (quarantine —
+  the spiked and intervening steps are discarded) and the resumed
+  trajectory matches an uninterrupted control over the same batch schedule;
+* ``hapi.callbacks.AutoCheckpoint(rollback_on_spike=True)`` does the same
+  from a fit loop without any monitor session (standalone detector);
+* under ZeRO sharding (accumulate_steps 1 and 2) and a TP=2 virtual mesh
+  the flags and Rademacher digests are SHARD-CORRECT: the published digest
+  equals the digest of the gathered global weights, still one executable
+  per bucket;
+* a paged DecodeEngine with the health plane on keeps the zero-recompile
+  guarantee, and non-finite logits terminalize the request as ``failed``
+  with the ``serve/nan_logits`` counter advanced;
+* gated microbench (``PADDLE_MONITOR_BENCH=1``): monitor-on-health-off
+  throughput stays >= 0.8x monitor-off; health-on sampled overhead bounded.
+"""
+import json
+import math
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HEALTH_ENV = [k for k in ("PADDLE_HEALTH", "PADDLE_HEALTH_SAMPLE",
+                           "PADDLE_HEALTH_OVERFLOW", "PADDLE_HEALTH_DIGEST",
+                           "PADDLE_HEALTH_SPIKE_WINDOW",
+                           "PADDLE_HEALTH_SPIKE_K",
+                           "PADDLE_HEALTH_SPIKE_MIN",
+                           "PADDLE_HEALTH_FAULT")]
+
+
+@pytest.fixture(autouse=True)
+def _reset_env(monkeypatch):
+    # plane config is read at monitor.enable() time — never leak one test's
+    # env (or an enabled session, or a mesh) into the next
+    for k in _HEALTH_ENV:
+        monkeypatch.delenv(k, raising=False)
+    from paddle_tpu.distributed import env
+    env._env["initialized"] = False
+    env._env["mesh"] = None
+    env._env["hcg"] = None
+    from paddle_tpu.distributed import group
+    group._group_registry.clear()
+    monitor.disable()
+    yield
+    monitor.disable()
+
+
+class _WithLoss(nn.Layer):
+    """Returns its own loss (TrainStep contract); two modules so the health
+    plane sees two leaf groups ('a' and 'b')."""
+
+    def __init__(self, din=8, hid=16):
+        super().__init__()
+        self.a = nn.Linear(din, hid)
+        self.b = nn.Linear(hid, din)
+
+    def forward(self, x):
+        return ((self.b((self.a(x)) ** 2)) ** 2).mean()
+
+
+def _make(seed=0, din=8, hid=16, lr=1e-2):
+    paddle.seed(seed)
+    m = _WithLoss(din, hid)
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=m.parameters())
+    return m, opt
+
+
+def _inputs(seed=0, bs=4, din=8, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor((scale * rng.randn(bs, din)).astype("float32"))
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def _expected_digest(step, n_probes=2):
+    """The Rademacher digest recomputed in PURE NUMPY from the gathered
+    global params (same index-hash keying as CompiledHealth.digest) — the
+    oracle the sharded in-executable digest must reproduce."""
+    import jax
+    from paddle_tpu.monitor.health import probe_salt
+    leaves = [np.asarray(jax.device_get(p.value()), np.float32)
+              for p in step._params if p.trainable]
+
+    def probe(n, j, d):
+        x = np.arange(n, dtype=np.uint32) ^ np.uint32(probe_salt(j, d))
+        with np.errstate(over="ignore"):
+            x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+            x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+        x = x ^ (x >> np.uint32(16))
+        return (1.0 - 2.0 * (x & 1)).astype(np.float32)
+
+    out = []
+    for d in range(n_probes):
+        acc = 0.0
+        for j, x in enumerate(leaves):
+            acc += float(np.dot(x.reshape(-1).astype(np.float64),
+                                probe(x.size, j, d).astype(np.float64)))
+        out.append(acc)
+    return out
+
+
+# --------------------------------------------------- compiled-in, no buckets
+
+
+def test_health_rides_compiled_step_without_extra_buckets(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("PADDLE_HEALTH_SAMPLE", "2")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+    m, opt = _make()
+    step = paddle.jit.TrainStep(m, opt)
+    losses = [float(step(_inputs(seed=s))) for s in range(6)]
+    assert all(math.isfinite(l) for l in losses)
+    # the stat block is just more output buffers: one executable, ever
+    # (the recompile counter counts the initial mint, then stays flat)
+    assert step.num_compiles == 1
+    assert mon.registry.counter("train_step/recompiles").value == 1
+
+    snap = mon.registry.snapshot()
+    g = snap["gauges"]
+    assert g["health/sample_every"] == 2
+    assert g["health/groups"] == 2
+    assert g["health/loss"] == pytest.approx(losses[5], rel=1e-5)
+    for grp in ("a", "b"):
+        assert g[f"health/grad_norm.{grp}"] > 0
+        assert g[f"health/grad_max.{grp}"] > 0
+        assert g[f"health/update_ratio.{grp}"] > 0
+    # digest channel: probes published with the step they describe
+    assert g["health/digest_step"] == 6
+    assert math.isfinite(g["health/digest/p0"])
+    assert math.isfinite(g["health/digest/g1"])
+    # digest == digest of the (trivially) gathered weights
+    want = _expected_digest(step)
+    assert g["health/digest/p0"] == pytest.approx(want[0], rel=1e-4)
+    assert g["health/digest/p1"] == pytest.approx(want[1], rel=1e-4)
+    # nothing tripped on a healthy run
+    assert mon.health.nan_trips == 0 and mon.health.overflow_trips == 0
+
+    # a second shape bucket costs exactly one more compile, same program set
+    float(step(_inputs(seed=9, bs=8)))
+    assert step.num_compiles == 2
+
+
+def test_health_opt_out_keeps_plain_loss_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_HEALTH", "0")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+    assert not mon.health.enabled
+    m, opt = _make()
+    step = paddle.jit.TrainStep(m, opt)
+    for s in range(3):
+        float(step(_inputs(seed=s)))
+    assert step._health_spec is None
+    assert step.num_compiles == 1
+    assert not any(k.startswith("health/")
+                   for k in mon.registry.snapshot()["gauges"])
+
+
+# ------------------------------------------------------------ chaos tripwire
+
+
+def test_chaos_nan_detected_within_one_sample_interval(tmp_path, monkeypatch):
+    """nan@param:3 with SAMPLE=2: the poison lands before call 3, the very
+    next sampled step (4) must trip — WARN naming the leaf group, the exact
+    poisoned leaves and the step's trace id; no recompile from the
+    host-side device_put re-adoption."""
+    monkeypatch.setenv("PADDLE_HEALTH_SAMPLE", "2")
+    monkeypatch.setenv("PADDLE_HEALTH_FAULT", "nan@param:3")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"), trace=True)
+    m, opt = _make()
+    step = paddle.jit.TrainStep(m, opt)
+    for s in range(2):
+        assert math.isfinite(float(step(_inputs(seed=s))))
+    assert mon.health.nan_trips == 0
+    with pytest.warns(RuntimeWarning, match="non-finite values") as rec:
+        float(step(_inputs(seed=2)))          # fault fires, step 3 unsampled
+        float(step(_inputs(seed=3)))          # step 4: first sample -> trip
+    msgs = [str(w.message) for w in rec
+            if "non-finite values" in str(w.message)]
+    assert msgs, "no health WARN"
+    # the WARN names the offending group, a poisoned leaf, and the trace
+    assert "a" in msgs[0] and "a.weight" in msgs[0]
+    assert "[trace " in msgs[0]
+    assert mon.health.nan_trips == 1
+    assert mon.registry.counter("health/nan_trips").value == 1
+    assert mon.registry.counter("health/nan_trips.a").value == 1
+    assert step.num_compiles == 1             # re-adopted, not rebuilt
+    monitor.disable()
+
+    recs = _read_jsonl(str(tmp_path / "run.jsonl"))
+    fault = [r for r in recs if r["kind"] == "health_fault"]
+    assert fault and fault[0]["call"] == 3 and fault[0]["action"] == "nan"
+    trips = [r for r in recs if r["kind"] == "health_nan"]
+    assert len(trips) == 1
+    t = trips[0]
+    assert t["step"] == 4, "not detected within one sample interval"
+    assert "a" in t["groups"] and t["loss_nonfinite"]
+    assert any(b["leaf"] == "a.weight" for b in t["leaves"])
+    assert t.get("trace")
+
+
+def test_overflow_tripwire(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_HEALTH_SAMPLE", "2")
+    monkeypatch.setenv("PADDLE_HEALTH_OVERFLOW", "1e-12")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+    m, opt = _make()
+    step = paddle.jit.TrainStep(m, opt)
+    with pytest.warns(RuntimeWarning, match="overflow threshold"):
+        for s in range(2):
+            float(step(_inputs(seed=s)))
+    assert mon.health.overflow_trips >= 1
+    assert mon.registry.counter("health/overflow_trips").value >= 1
+
+
+# ------------------------------------------------------ spike rollback (e2e)
+
+
+def test_spike_rollback_resumes_matching_control(tmp_path, monkeypatch):
+    """THE rollback acceptance gate: a planted loss spike (scale@param:8)
+    rolls back to the last committed snapshot (step 6), quarantining the
+    spiked step AND the uncommitted step 7; training resumed on the NEXT
+    batches matches an uninterrupted control over the same effective
+    schedule (batches 1..6, then 9..11 — the data stream does not rewind).
+    """
+    ckdir = str(tmp_path / "ck")
+
+    # control: no monitor, no fault — steps on seeds 0..5, then 8..10
+    m_c, opt_c = _make(seed=0)
+    step_c = paddle.jit.TrainStep(m_c, opt_c)
+    for s in range(6):
+        float(step_c(_inputs(seed=s)))
+    control_tail = [float(step_c(_inputs(seed=s))) for s in (8, 9, 10)]
+    w_control = {n: np.asarray(p.value(), np.float32)
+                 for n, p in m_c.named_parameters()}
+
+    # faulted run: every step sampled, spike planted before call 8
+    monkeypatch.setenv("PADDLE_HEALTH_SAMPLE", "1")
+    monkeypatch.setenv("PADDLE_HEALTH_SPIKE_MIN", "4")
+    monkeypatch.setenv("PADDLE_HEALTH_FAULT", "scale@param:8:8")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+    m, opt = _make(seed=0)
+    step = paddle.jit.TrainStep(m, opt)
+    mon.health.rollback_hook = lambda sn, info: \
+        step.rollback_last_commit(ckdir, before_step=sn)
+
+    w6 = None
+    with pytest.warns(RuntimeWarning, match="loss spike"):
+        for s in range(8):                    # steps 1..8 on seeds 0..7
+            float(step(_inputs(seed=s)))
+            n = s + 1
+            if n in (2, 4, 6):
+                step.save_checkpoint(ckdir, step=n, block=True)
+                if n == 6:
+                    w6 = {nm: np.asarray(p.value(), np.float32)
+                          for nm, p in m.named_parameters()}
+    assert mon.health.spikes == 1
+    assert mon.registry.counter("health/rollbacks").value == 1
+    # the rollback left the exact step-6 weights live (re-adopted arrays)
+    for nm in w6:
+        np.testing.assert_array_equal(
+            np.asarray(dict(m.named_parameters())[nm].value(), np.float32),
+            w6[nm], err_msg=nm)
+
+    # resume on the post-spike batches: trajectory == control
+    tail = [float(step(_inputs(seed=s))) for s in (8, 9, 10)]
+    assert step.num_compiles == 1             # rollback minted nothing
+    np.testing.assert_allclose(tail, control_tail, rtol=1e-4)
+    for nm, p in m.named_parameters():
+        np.testing.assert_allclose(np.asarray(p.value(), np.float32),
+                                   w_control[nm], rtol=1e-4, atol=1e-6,
+                                   err_msg=nm)
+    monitor.disable()
+
+    recs = _read_jsonl(str(tmp_path / "run.jsonl"))
+    rb = [r for r in recs if r["kind"] == "health_rollback"]
+    assert rb and rb[0]["spike_step"] == 8 and rb[0]["restored_step"] == 6
+    sp = [r for r in recs if r["kind"] == "health_spike"]
+    assert sp and sp[0]["step"] == 8 and not sp[0]["nonfinite"]
+
+
+def test_autocheckpoint_rollback_on_spike_standalone(tmp_path, monkeypatch):
+    """AutoCheckpoint(rollback_on_spike=True) without any monitor session:
+    the standalone detector catches a poisoned batch at global step 10 and
+    restores the step-8 snapshot; the spiked step never snapshots."""
+    monkeypatch.setenv("PADDLE_HEALTH_SPIKE_MIN", "4")
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.hapi.callbacks import AutoCheckpoint
+
+    paddle.seed(3)
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=lambda o, y: ((o - y) ** 2).mean())
+
+    rng = np.random.RandomState(42)
+    data = [(rng.randn(2, 4).astype("float32"),
+             rng.randn(2, 2).astype("float32")) for _ in range(12)]
+    data[9] = (data[9][0] * 100.0, data[9][1])   # spike at global step 10
+
+    cb = AutoCheckpoint(str(tmp_path), save_steps=2, asynchronous=False,
+                        watch_signals=False, rollback_on_spike=True,
+                        verbose=0)
+    with pytest.warns(RuntimeWarning, match="loss spike"):
+        model.fit(data, epochs=1, verbose=0, shuffle=False, callbacks=[cb])
+    assert cb.rollbacks == 1
+    # rollback restored step 8 (max committed < 10); the poisoned weights
+    # never reached disk and training continued to a finite loss
+    assert ckpt.load_checkpoint(str(tmp_path)) is not None
+    assert all(np.isfinite(net.weight.numpy()).all()
+               for _ in range(1))
+
+
+# ------------------------------------------------- sharded meshes (ZeRO, TP)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_health_shard_correct_under_zero(tmp_path, monkeypatch, k):
+    """ZeRO stage-2 (+ accumulation): still one executable per bucket with
+    health on, and the in-executable digest of the SHARD-placed params
+    equals the eager digest of the gathered global weights."""
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 8, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    monkeypatch.setenv("PADDLE_HEALTH_SAMPLE", "1")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+
+    paddle.seed(0)
+    m = _WithLoss(din=16, hid=32)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    m2, opt2, _ = dist.group_sharded_parallel(m, opt, level="os_g")
+    step = paddle.jit.TrainStep(m2, opt2, accumulate_steps=k)
+
+    def batch(seed):
+        rng = np.random.RandomState(seed)
+        shape = (k, 4, 16) if k > 1 else (4, 16)
+        return paddle.to_tensor(rng.randn(*shape).astype("float32"))
+
+    for s in range(3):
+        assert math.isfinite(float(step(batch(s))))
+    assert step.num_compiles == 1
+    assert mon.registry.counter("train_step/recompiles").value == 1
+
+    g = mon.registry.snapshot()["gauges"]
+    assert g["health/groups"] == 2
+    assert g["health/grad_norm.a"] > 0 and g["health/update_ratio.b"] > 0
+    want = _expected_digest(step)
+    assert g["health/digest/p0"] == pytest.approx(want[0], rel=1e-3)
+    assert g["health/digest/p1"] == pytest.approx(want[1], rel=1e-3)
+    assert mon.health.nan_trips == 0
+
+
+def test_health_shard_correct_under_tp2(tmp_path, monkeypatch):
+    """TP=2 virtual mesh: model-parallel Column/Row layers train through
+    the health-instrumented step; flags and digests reduce the sharded
+    leaves to the correct GLOBAL figures; one executable."""
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+    monkeypatch.setenv("PADDLE_HEALTH_SAMPLE", "1")
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+
+    class TP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = ColumnParallelLinear(8, 16, gather_output=False)
+            self.row = RowParallelLinear(16, 8, input_is_parallel=True)
+
+        def forward(self, x):
+            return ((self.row(self.col(x))) ** 2).mean()
+
+    paddle.seed(0)
+    m = TP()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, opt)
+    for s in range(3):
+        assert math.isfinite(float(step(_inputs(seed=s))))
+    assert step.num_compiles == 1
+
+    g = mon.registry.snapshot()["gauges"]
+    assert g["health/grad_norm.col.linear"] > 0
+    assert g["health/grad_norm.row.linear"] > 0
+    want = _expected_digest(step)
+    assert g["health/digest/p0"] == pytest.approx(want[0], rel=1e-3)
+    assert g["health/digest/p1"] == pytest.approx(want[1], rel=1e-3)
+    assert mon.health.nan_trips == 0
+
+
+# ------------------------------------------------------------------- serving
+
+
+def _tiny_gpt(seed=0):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0, use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_paged_engine_zero_recompile_with_health_on(tmp_path):
+    """The serving half of the zero-recompile gate: a monitor session with
+    the health plane up changes nothing about the paged engine's
+    executable set under slot churn."""
+    from paddle_tpu.serving import DecodeEngine
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+    assert mon.health.enabled
+    eng = DecodeEngine(_tiny_gpt(), max_slots=4, max_len=48, block_size=8,
+                       prefill_chunk=8)
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    eng.run()
+    base = eng.compile_count
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [1, 2], [3, 4, 5, 6]]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=3)
+    done = eng.run(max_steps=200)
+    assert all(r.status == "done" for r in done)
+    assert eng.compile_count == base, "health plane minted serving programs"
+    assert eng.nan_logits == 0
+
+
+def test_serving_nan_logits_terminalizes_failed(tmp_path):
+    """Poisoned weights -> non-finite logits: the request ends ``failed``
+    (never an uncaught crash, never a poisoned sample loop) and the
+    ``serve/nan_logits`` counter + event record where."""
+    from paddle_tpu.serving import DecodeEngine
+    mon = monitor.enable(str(tmp_path / "run.jsonl"))
+    m = _tiny_gpt()
+    p = next(iter(m.parameters()))
+    bad = np.asarray(p.numpy(), np.float32).copy()
+    bad.flat[0] = np.nan
+    p.set_value(bad)
+    eng = DecodeEngine(m, max_slots=2, max_len=32, block_size=8,
+                       prefill_chunk=8)
+    req = eng.submit([1, 2, 3], max_new_tokens=4)
+    done = eng.run(max_steps=100)
+    assert req in done
+    assert req.status == "failed"
+    assert "non-finite logits" in (req.error or "")
+    assert eng.nan_logits >= 1
+    assert eng.stats()["guardrails"]["nan_logits"] >= 1
+    assert mon.registry.counter("serve/nan_logits").value >= 1
+    monitor.disable()
+    recs = _read_jsonl(str(tmp_path / "run.jsonl"))
+    evs = [r for r in recs if r["kind"] == "serve_nan_logits"]
+    assert evs and evs[0]["where"] in ("prefill", "chunk", "decode")
+
+
+# ------------------------------------------------------------ gated microbench
+
+
+@pytest.mark.skipif(not os.environ.get("PADDLE_MONITOR_BENCH"),
+                    reason="microbench: set PADDLE_MONITOR_BENCH=1")
+def test_health_overhead_bounded(tmp_path, monkeypatch):
+    """Disabled-path gate: monitor-on with health OFF stays >= 0.8x the
+    monitor-off step rate; health ON at the default cadence stays >= 0.5x
+    (the sampled device_get amortizes over PADDLE_HEALTH_SAMPLE steps)."""
+    N = 60
+
+    def rate(env_health, enable):
+        monitor.disable()
+        for k in _HEALTH_ENV:
+            monkeypatch.delenv(k, raising=False)
+        if env_health is not None:
+            monkeypatch.setenv("PADDLE_HEALTH", env_health)
+        if enable:
+            monitor.enable(str(tmp_path / f"b{env_health}.jsonl"))
+        m, opt = _make(din=32, hid=64)
+        step = paddle.jit.TrainStep(m, opt)
+        x = _inputs(seed=0, bs=8, din=32)
+        float(step(x))                        # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(N):
+            step(x)
+        float(step(x))                        # sync the tail
+        dt = time.perf_counter() - t0
+        monitor.disable()
+        return N / dt
+
+    base = rate(None, enable=False)
+    off = rate("0", enable=True)
+    on = rate(None, enable=True)
+    assert off >= 0.8 * base, f"health-off path too slow: {off} vs {base}"
+    assert on >= 0.5 * base, f"health-on sampled overhead unbounded: " \
+                             f"{on} vs {base}"

@@ -150,7 +150,7 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
     hdr = (f"{'rank':>4} {steps_col:>9} {'steps/s':>8} {'step p50':>10} "
            f"{'step p95':>10} {'goodput':>8} {'recomp':>7} {'skip':>5} "
            f"{'ckpt':>5} {'reshard':>8} {'tok/s':>8} {'kv_util':>8} "
-           f"{'queue':>6}")
+           f"{'queue':>6} {'health':>8}")
     out.append(hdr)
 
     def counter(name, rank):
@@ -159,6 +159,30 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
         if window and prev is not None:
             return _windowed(cur, prev, "counters", name, rank)
         return _pick(cur, "counters", name, rank)
+
+    def health_cell(rank, is_stale):
+        """Compact model-health state: N<nan trips> O<overflow> S<spikes>,
+        DIV when the aggregator flagged this rank's weight digest, ``ok``
+        when the plane publishes and nothing tripped, ``-`` when the rank
+        publishes no health gauges at all. A stale rank's cell is tagged
+        ``*`` — it reflects the last blob heard, not the present."""
+        parts = []
+        for name, mark in (("health/nan_trips", "N"),
+                           ("health/overflow_trips", "O"),
+                           ("health/spikes", "S")):
+            v = counter(name, rank)
+            if v:
+                parts.append(f"{mark}{int(v)}")
+        if d.get("fleet/weight_diverged_rank") == rank:
+            parts.append("DIV")
+        if parts:
+            cell = ",".join(parts)
+        else:
+            seen = _pick(cur, "gauges", "health/loss", rank) is not None \
+                or _pick(cur, "gauges", "health/digest_step", rank) \
+                is not None
+            cell = "ok" if seen else "-"
+        return cell + ("*" if is_stale and cell != "-" else "")
 
     for r in cur.get("ranks") or []:
         h = _pick(cur, "histograms", "train_step/dispatch_s", r) or {}
@@ -175,7 +199,8 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
                f" {_fmt(counter('reshard/loads', r), '{:.0f}'):>8}"
                f" {_fmt(_rate(cur, prev, 'counters', 'serve/tokens', r)):>8}"
                f" {_fmt(srv_h, '{:.0%}'):>8}"
-               f" {_fmt(_pick(cur, 'gauges', 'serve/queue_depth', r), '{:.0f}'):>6}")
+               f" {_fmt(_pick(cur, 'gauges', 'serve/queue_depth', r), '{:.0f}'):>6}"
+               f" {health_cell(r, r in stale):>8}")
         if r in stale:
             row += "   << STALE"
         out.append(row)

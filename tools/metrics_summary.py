@@ -631,6 +631,111 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       f"contract is broken (a shape depends on the "
                       f"live-slot set)", file=out)
 
+    # model-health plane (monitor/health.py): the numerics post-mortem next
+    # to the time/throughput ones above — trip timeline, per-layer tensor
+    # stats, divergence flags, and the two signatures worth shouting about
+    health_kinds = ("health_nan", "health_overflow", "health_spike",
+                    "health_rollback", "health_fault", "serve_nan_logits")
+    health_events = [r for k in health_kinds for r in by_kind.get(k, [])]
+    health_on = health_events or any(
+        k.startswith("health/") for k in list(counters_m) + list(gauges_m))
+    if health_on:
+        health_events.sort(key=lambda r: r.get("ts", 0))
+        nan_trips = int(counters_m.get("health/nan_trips", 0))
+        print(f"\n== health ==", file=out)
+        print(f"  nan trips {nan_trips}  overflow trips "
+              f"{int(counters_m.get('health/overflow_trips', 0))}  spikes "
+              f"{int(counters_m.get('health/spikes', 0))}  rollbacks "
+              f"{int(counters_m.get('health/rollbacks', 0))}  found_inf "
+              f"{int(counters_m.get('health/found_inf', 0))}  nan logits "
+              f"{int(counters_m.get('serve/nan_logits', 0))}", file=out)
+        if health_events:
+            shown = health_events[:24]
+            print(f"  trip timeline ({len(health_events)}):", file=out)
+            for r in shown:
+                dt = r.get("ts", t0) - t0
+                kind = r.get("kind")
+                if kind == "health_nan":
+                    where = ", ".join(r.get("groups") or []) or "forward loss"
+                    leaves = [b.get("leaf") for b in r.get("leaves") or []]
+                    detail = f"non-finite in [{where}]" \
+                        + (f"  leaves {leaves}" if leaves else "")
+                elif kind == "health_overflow":
+                    detail = (f"|grad| {r.get('max_abs', 0):.3e} > "
+                              f"{r.get('threshold', 0):.1e} in "
+                              f"[{', '.join(r.get('groups') or [])}]")
+                elif kind == "health_spike":
+                    med = r.get("median")
+                    detail = ((f"loss {r.get('loss'):.6g} vs median "
+                               f"{med:.6g}") if med is not None
+                              else "non-finite loss") \
+                        + f" ({r.get('source', '?')})"
+                elif kind == "health_rollback":
+                    detail = (f"rolled back to step "
+                              f"{r.get('restored_step')} after spike at "
+                              f"step {r.get('spike_step')}")
+                elif kind == "health_fault":
+                    detail = (f"chaos fault {r.get('action')} on "
+                              f"{r.get('leaf')} (call {r.get('call')})")
+                else:
+                    detail = (f"non-finite logits in "
+                              f"{r.get('where', '?')} — request failed")
+                step = f" step {r['step']}" if r.get("step") is not None \
+                    else ""
+                tr_id = f"  [trace {r['trace']}]" if r.get("trace") else ""
+                print(f"  +{dt:9.3f}s  {tag(r)}{kind}{step}: "
+                      f"{detail}{tr_id}", file=out)
+            if len(health_events) > len(shown):
+                print(f"  ... {len(health_events) - len(shown)} more "
+                      f"(use --events)", file=out)
+        layer_stats = {}
+        for k, v in gauges_m.items():
+            for fam, col in (("health/grad_norm.", 0),
+                             ("health/grad_max.", 1),
+                             ("health/update_ratio.", 2)):
+                if k.startswith(fam):
+                    layer_stats.setdefault(k[len(fam):], [0.0] * 3)[col] = v
+        if layer_stats:
+            print(f"  {'layer group':<32}{'grad_norm':>12}{'grad_max':>12}"
+                  f"{'upd/w':>12}", file=out)
+            for gname in sorted(layer_stats):
+                gn, gm, ur = layer_stats[gname]
+                print(f"  {gname:<32}{gn:>12.4g}{gm:>12.4g}{ur:>12.3g}",
+                      file=out)
+        acts = {k[len("health/act_rms."):]: v for k, v in gauges_m.items()
+                if k.startswith("health/act_rms.")}
+        if acts:
+            print("  act rms: " + "  ".join(
+                f"{n}={v:.4g}" for n, v in sorted(acts.items())), file=out)
+        div_warns = [w for w in by_kind.get("fleet_warn", [])
+                     if w.get("warn") == "weight_divergence"]
+        if gauges_m.get("fleet/weight_divergence", 0) or div_warns:
+            ranks_div = sorted({w.get("rank") for w in div_warns
+                                if w.get("rank") is not None})
+            print(f"  weight divergence: FLAGGED"
+                  + (f" — rank(s) {ranks_div}" if ranks_div else "")
+                  + (f" [trace {div_warns[-1]['trace']}]"
+                     if div_warns and div_warns[-1].get("trace") else ""),
+                  file=out)
+            # a resumed/elastic rank can legitimately lag a few steps; a
+            # fork with NO restart churn anywhere in the record cannot
+            restarts = [r for r in by_kind.get("fleet_rank", [])
+                        if (r.get("inc") or {}).get("gen", 0)]
+            if not restarts and not by_kind.get("elastic_scale", []):
+                print("  WARNING: a rank's weight digest forked with ZERO "
+                      "elastic/restart events in the record — not "
+                      "explainable as a stale resume; treat as silent "
+                      "corruption or a desynced optimizer on that rank",
+                      file=out)
+        # the scaler-protection cross-check: a NaN trip while the scaler
+        # skipped nothing means the poisoned grads reached the weights
+        if nan_trips and not counters_m.get("train_step/skipped_updates", 0):
+            print(f"  WARNING: {nan_trips} non-finite trip(s) with ZERO "
+                  f"scaler-skipped updates — the tripped step's update was "
+                  f"NOT protected (no GradScaler in the loop, or it never "
+                  f"saw these grads); assume the weights already carry the "
+                  f"NaN and roll back", file=out)
+
     # fleet stream (run.fleet.jsonl — monitor/collector.py's online
     # aggregation): the same tool reads the live plane's output post-mortem
     fleet_recs = by_kind.get("fleet", [])
