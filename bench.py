@@ -264,6 +264,130 @@ def main(argv=()):
         report(best, w)
 
 
+def _decode_router(n_engines):
+    """Fleet decode lane (``bench.py decode --router N``): N in-process
+    paged engines on a LocalDirectory behind the Router, affinity policy.
+    Prompts open with one of a few shared system prefixes — cache-aware
+    placement pins each prefix to the engine whose pager already holds its
+    blocks, which is the number ``affinity_hit_rate`` reports."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import (DecodeEngine, EngineEndpoint,
+                                    LocalDirectory, LocalEngineClient,
+                                    Router)
+
+    tiny = bool(os.environ.get("BENCH_TINY"))
+    paddle.seed(0)
+    size = (dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                 max_position_embeddings=128) if tiny else
+            dict(vocab_size=50304, hidden_size=1024, num_layers=16,
+                 num_heads=8, max_position_embeddings=1024))
+    cfg = GPTConfig(hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    **size)
+    model = GPTForCausalLM(cfg)
+    for _, p in model.named_parameters():
+        p._data = p.value().astype("bfloat16")
+
+    slots, horizon = (2, 64) if tiny else (8, 256)
+    block = 16
+    directory = LocalDirectory()
+    router = Router(directory, policy="affinity", stale_after=1e9)
+    engines, endpoints = {}, {}
+    for i in range(n_engines):
+        name = f"eng{i}"
+        eng = DecodeEngine(model, max_slots=slots, max_len=horizon,
+                           paged=True, block_size=block,
+                           prefill_chunk=16 if tiny else 32)
+        engines[name] = eng
+        endpoints[name] = EngineEndpoint(eng, name, directory, ttl_s=30.0)
+        endpoints[name].publish()
+        router.attach(name, LocalEngineClient(eng))
+
+    rng = np.random.RandomState(0)
+    # one shared system prefix per engine, each exactly one block long, so
+    # a placement either lands on the engine already holding those blocks
+    # (affinity hit) or pays a fresh prefill elsewhere (spill)
+    prefixes = [rng.randint(0, cfg.vocab_size, block).tolist()
+                for _ in range(n_engines)]
+    lo, hi = block + 4, horizon // 2
+
+    def mk_prompt():
+        g = int(rng.randint(len(prefixes)))
+        n = int(rng.randint(lo, hi + 1))
+        return prefixes[g] + rng.randint(
+            0, cfg.vocab_size, n - block).tolist()
+
+    # warm every engine before the first window: one request through
+    # prefill + first decode mints the chunk and decode executables, and
+    # seeds each pager's prefix registry with one of the shared prefixes
+    for i, (name, eng) in enumerate(sorted(engines.items())):
+        n = int(rng.randint(lo, hi + 1))
+        eng.submit(prefixes[i % len(prefixes)] + rng.randint(
+            0, cfg.vocab_size, n - block).tolist(), max_new_tokens=4)
+        while eng.decode_steps == 0:
+            eng.step()
+        endpoints[name].publish()
+    warm = {name: eng.compile_count for name, eng in engines.items()}
+
+    def step_fleet():
+        for name, eng in engines.items():
+            if eng.queue_depth + eng.active_count:
+                eng.step()
+            endpoints[name].publish()
+        router.poll()
+
+    cap = n_engines * slots
+    tickets = []
+
+    def refill():
+        while sum(e.queue_depth + e.active_count
+                  for e in engines.values()) < cap:
+            tickets.append(router.route(
+                mk_prompt(),
+                max_new_tokens=int(rng.randint(horizon // 4,
+                                               horizon // 2))))
+
+    kind = jax.devices()[0].device_kind
+    iters, windows = (4, 2) if tiny else (20, 6)
+    best = 0.0
+    for w in range(windows):
+        _heartbeat("decode_router_window_open", w)
+        tok0 = sum(e.tokens_generated for e in engines.values())
+        t0 = time.time()
+        for _ in range(iters):
+            refill()
+            step_fleet()
+        dt = time.time() - t0
+        best = max(best,
+                   (sum(e.tokens_generated for e in engines.values())
+                    - tok0) / dt)
+        c = dict(router.counters)
+        placed = c.get("affinity_hits", 0) + c.get("spills", 0)
+        print(json.dumps(dict(_fleet_fields(), **_trace_fields(),
+                              **_health_fields(), **{
+            "metric": "gpt_medium_decode_router_tokens_per_sec",
+            "value": round(best, 1),
+            "unit": "tokens/s (decode, fleet total)",
+            "engines": n_engines,
+            "routed": c.get("routed", 0),
+            "affinity_hit_rate": (round(c.get("affinity_hits", 0)
+                                        / placed, 3) if placed else None),
+            "requeues": c.get("requeues", 0),
+            "ejections": c.get("ejections", 0),
+            "rejected": c.get("rejected", 0),
+            "prefix_hits": sum(int(e._pager.prefix_hits)
+                               for e in engines.values()),
+            "steady_state_recompiles": sum(
+                e.compile_count - warm[name]
+                for name, e in engines.items()),
+            "device_kind": kind,
+            "window": w,
+        })))
+        sys.stdout.flush()
+    router.emit_state()
+
+
 def main_decode(argv=()):
     """Serving decode throughput: a DecodeEngine over the GPT-medium
     config, every slot kept hot with staggered requests so admissions and
@@ -306,7 +430,15 @@ def main_decode(argv=()):
     acceptance rate while greedy output stays bitwise identical. The
     shared-prefix workload is exactly where prompt-lookup shines (the
     output keeps re-quoting the repetitive context). The best-so-far line
-    gains ``spec``/``accepted_per_step``/``draft_hit_rate``."""
+    gains ``spec``/``accepted_per_step``/``draft_hit_rate``.
+
+    ``--router N`` measures the FLEET lane instead: N in-process paged
+    engines registered on a LocalDirectory behind the serving Router
+    (cache-aware placement). The workload interleaves a handful of shared
+    system prefixes, so affinity placement keeps each prefix's blocks hot
+    on one engine. The best-so-far line reports fleet-summed tokens/s
+    plus ``affinity_hit_rate``/``requeues``; ``steady_state_recompiles``
+    (summed over engines) must still be 0 with the router in the loop."""
     tpf = _cli_flag(argv, "tp")
     if tpf == "":
         # space-separated form: --tp N (the = form is --tp=N)
@@ -331,6 +463,21 @@ def main_decode(argv=()):
         jax.config.update("jax_compilation_cache_dir",
                           "/root/.cache/jax_bench")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+    routerf = _cli_flag(argv, "router")
+    if routerf == "":
+        argl = list(argv)
+        i = argl.index("--router")
+        routerf = argl[i + 1] if i + 1 < len(argl) \
+            and argl[i + 1].isdigit() else ""
+        if not routerf:
+            raise SystemExit("--router needs a fleet size: "
+                             "--router N or --router=N")
+    if routerf is not None:
+        n = int(routerf)
+        if n < 2:
+            raise SystemExit(f"--router={n}: a fleet needs >= 2 engines")
+        return _decode_router(n)
 
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM, shard_gpt_tp

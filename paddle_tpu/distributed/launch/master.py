@@ -70,21 +70,28 @@ class KVServer:
 
 
 class KVClient:
-    def __init__(self, endpoint: str):
+    """HTTP client for KVServer. ``timeout`` is per-call: rendezvous can
+    afford the lazy default, but the serving router polls this store on
+    its health cadence and needs a short bound so one slow master never
+    stalls placement (serving/endpoint.py passes ~1s)."""
+
+    def __init__(self, endpoint: str, timeout: float = 5.0):
         self._base = f"http://{endpoint}"
+        self._timeout = float(timeout)
 
     def put(self, key: str, value: str) -> bool:
         req = urllib.request.Request(f"{self._base}{key}", data=value.encode(),
                                      method="PUT")
         try:
-            with urllib.request.urlopen(req, timeout=5) as r:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 return r.status == 200
         except OSError:
             return False
 
     def get(self, key: str) -> Optional[str]:
         try:
-            with urllib.request.urlopen(f"{self._base}{key}", timeout=5) as r:
+            with urllib.request.urlopen(f"{self._base}{key}",
+                                        timeout=self._timeout) as r:
                 return r.read().decode()
         except OSError:
             return None
@@ -92,14 +99,15 @@ class KVClient:
     def delete(self, key: str) -> bool:
         req = urllib.request.Request(f"{self._base}{key}", method="DELETE")
         try:
-            with urllib.request.urlopen(req, timeout=5) as r:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 return r.status == 200
         except OSError:
             return False
 
     def get_prefix(self, prefix: str) -> Dict[str, str]:
         try:
-            with urllib.request.urlopen(f"{self._base}{prefix}", timeout=5) as r:
+            with urllib.request.urlopen(f"{self._base}{prefix}",
+                                        timeout=self._timeout) as r:
                 return json.loads(r.read().decode())
         except OSError:
             return {}

@@ -577,12 +577,18 @@ class Monitor:
             fields["trace"] = trace_id
         self.emit("serve_nan_logits", **fields)
 
-    def serve_paged(self, pager_stats, kv_util: float):
+    def serve_paged(self, pager_stats, kv_util: float, engine_id=None):
         """Per-decode-step paged-pool gauges (cheap sets, no event). The
         cumulative preemption count lives in the serve/preemptions COUNTER
         (serve_preempted), not a gauge here — a same-named gauge tripped
-        the registry's no-silent-shadowing check."""
+        the registry's no-silent-shadowing check. ``engine_id`` adds a
+        per-engine ``serve/prefix_hits.eng<id>`` mirror so a multi-engine
+        process (router bench/e2e) can attribute cache wins per replica —
+        the affinity-beats-round-robin gate sums exactly these."""
         g = self.registry.gauge
+        if engine_id is not None:
+            g(f"serve/prefix_hits.eng{engine_id}").set(
+                pager_stats.prefix_hits)
         g("serve/blocks_free").set(pager_stats.blocks_free)
         g("serve/blocks_used").set(pager_stats.blocks_used)
         g("serve/blocks_shared").set(pager_stats.blocks_shared)
@@ -756,6 +762,51 @@ class Monitor:
         self.emit("serve_hang", path=kind, bucket=bucket,
                   elapsed_s=elapsed_s, hang_s=hang_s, engine=engine_id,
                   traces=list(trace_ids))
+
+    # ---------------------------------------------- integration: fleet router
+
+    def route_placed(self, engine, affinity: bool):
+        """The router placed one request: ``affinity`` means its prompt's
+        first-block digest matched a key the chosen engine advertised
+        (cache-aware hit); otherwise it spilled to least-loaded. Counters
+        only — placement happens per request, an event per call would
+        swamp the sink."""
+        if affinity:
+            self.registry.counter("route/affinity_hits").inc()
+        else:
+            self.registry.counter("route/spills").inc()
+
+    def route_reject(self, why: str):
+        """No engine could take the request (every door draining/stale or
+        the fleet is empty) — the router's own saturation signal."""
+        self.registry.counter("route/rejected").inc()
+        self.emit("route_reject", why=why)
+
+    def route_requeue(self, request_id, from_engine, to_engine,
+                      why: str, trace_id=None):
+        """A request moved to a different engine (its first engine died or
+        bounced it draining). The engine-side id dedup makes this
+        idempotent, so a requeue is bookkeeping, never a duplicate
+        generation."""
+        self.registry.counter("route/requeues").inc()
+        fields = dict(request=str(request_id), src=str(from_engine),
+                      dst=str(to_engine), why=why)
+        if trace_id:
+            fields["trace"] = trace_id
+        self.emit("route_requeue", **fields)
+
+    def route_eject(self, engine, why: str):
+        """The router declared one engine dead (stale heartbeat, transport
+        failure past retry, or chaos kill) and removed it from placement;
+        only a strictly NEWER incarnation re-admits that name."""
+        self.registry.counter("route/ejections").inc()
+        self.emit("route_eject", engine=str(engine), why=why)
+
+    def route_state(self, doors, counters):
+        """Periodic router fleet view (per-engine door state + router
+        counters) — tools/fleet_top.py's router panel renders the latest
+        of these."""
+        self.emit("route_state", doors=doors, counters=dict(counters))
 
     # -------------------------------------------------- integration: profiler
 

@@ -38,15 +38,34 @@ history, no model), ``DraftModelDrafter`` (a small causal LM), and
 K/V writes land in pager-reserved blocks and roll back exactly on
 rejection.
 
+Fleet front door (router.py + endpoint.py): N engine replicas behind a
+stdlib ``Router`` — discovery over the launch KV master (TTL'd
+``/{job}/serve/{engine}`` registrations carrying each engine's
+``door_state()``), cache-aware placement (prefix-digest affinity first,
+least-loaded spill, draining doors excluded), retry with exponential
+backoff, heartbeat-staleness + incarnation-ordered health checks,
+idempotent requeue-elsewhere on engine death (engine-side request-id
+dedup guarantees one id never generates twice), and ``rolling_restart()``
+chaining per-engine drains so a fleet upgrade drops nothing. The
+``PADDLE_ROUTE_FAULT`` chaos seam (drop/slow/kill at exact route/submit/
+status counts) makes the failover contract deterministically testable.
+
 Telemetry: ``serve/*`` counters/gauges/histograms in ``paddle_tpu.monitor``
 (QPS, TTFT, per-token latency, slot occupancy, executable mints,
-expired/cancelled/drained/hang_warns, spec accepted-per-step/hit-rate).
+expired/cancelled/drained/hang_warns, spec accepted-per-step/hit-rate)
+plus ``route/*`` router counters (affinity_hits, spills, requeues,
+ejections) and per-engine ``serve/prefix_hits.eng<id>`` attribution.
 """
+from .endpoint import (DoorServer, EngineEndpoint, KVDirectory,
+                       LocalDirectory)
 from .engine import (DecodeEngine, Request, generate_via_engine,
                      quantize_for_serving)
 from .guardrails import (DispatchWatchdog, EngineHangError, FaultSchedule,
-                         InjectedFault)
-from .pager import BlockPager
+                         InjectedFault, InjectedRouteFault,
+                         RouteFaultSchedule)
+from .pager import BlockPager, prefix_digest
+from .router import (EngineDown, HTTPEngineClient, LocalEngineClient,
+                     NoEngineAvailable, Router, RouteTicket)
 from .scheduler import TERMINAL_STATUSES, AdmissionQueue, SlotAllocator
 from .spec import (Drafter, DraftModelDrafter, EarlyExitDrafter,
                    PromptLookupDrafter)
@@ -56,4 +75,8 @@ __all__ = ["DecodeEngine", "Request", "generate_via_engine",
            "BlockPager", "TERMINAL_STATUSES", "FaultSchedule",
            "InjectedFault", "DispatchWatchdog", "EngineHangError",
            "Drafter", "PromptLookupDrafter", "DraftModelDrafter",
-           "EarlyExitDrafter"]
+           "EarlyExitDrafter",
+           "Router", "RouteTicket", "LocalEngineClient", "HTTPEngineClient",
+           "EngineDown", "NoEngineAvailable", "RouteFaultSchedule",
+           "InjectedRouteFault", "EngineEndpoint", "DoorServer",
+           "LocalDirectory", "KVDirectory", "prefix_digest"]

@@ -66,7 +66,7 @@ from __future__ import annotations
 import itertools
 import os
 import time
-from collections import namedtuple
+from collections import OrderedDict, namedtuple
 from typing import List, Optional, Sequence
 
 import jax
@@ -89,6 +89,10 @@ from .scheduler import (TERMINAL_STATUSES, AdmissionQueue, Request,
 __all__ = ["DecodeEngine", "Request", "generate_via_engine",
            "quantize_for_serving", "EngineHangError", "TERMINAL_STATUSES"]
 
+
+# terminal caller-supplied request ids remembered per engine for dedup
+# (a requeue retry arriving AFTER completion still returns the original)
+DEDUP_WINDOW = 1024
 
 ModelSpec = namedtuple("ModelSpec", [
     "backbone", "num_layers", "n_kv_heads", "head_dim", "max_pos",
@@ -501,6 +505,12 @@ class DecodeEngine:
         # O(queue + slots) per step, so it early-outs when this is empty
         # (the common no-deadline workload pays one set check per step)
         self._deadline_reqs: set = set()
+        # requeue idempotency: caller-supplied request ids this engine has
+        # admitted, live plus a bounded window of terminal ones. A router
+        # retrying a submit it isn't sure landed gets the EXISTING Request
+        # back — one id can never generate twice on one engine.
+        self._by_id: dict = {}
+        self._done_ids: "OrderedDict" = OrderedDict()
         self._draining = False
         self._drain_t0: Optional[float] = None
         self._drain_deadline: Optional[float] = None
@@ -835,7 +845,21 @@ class DecodeEngine:
         ``ttft_deadline_s`` bounds submit -> first token; ``deadline_s``
         bounds the whole request. Both are enforced at step boundaries —
         expiry releases the slot and KV blocks exactly once and the
-        request ends ``expired``."""
+        request ends ``expired``.
+
+        A caller-supplied ``request_id`` makes submission IDEMPOTENT on
+        this engine: a duplicate id returns the existing Request (live,
+        or terminal within the dedup window) instead of admitting twice —
+        the router's requeue/retry contract depends on one id never
+        producing two token streams. Door bounces (``rejected_draining``
+        / ``rejected_overload``) are not remembered: a bounced id must
+        stay resubmittable."""
+        if request_id is not None:
+            dup = self._by_id.get(request_id)
+            if dup is None:
+                dup = self._done_ids.get(request_id)
+            if dup is not None:
+                return dup
         try:
             req = Request(prompt, max_new_tokens=max_new_tokens,
                           eos_token_id=eos_token_id, request_id=request_id,
@@ -903,6 +927,8 @@ class DecodeEngine:
         else:
             if req.ttft_deadline_s is not None or req.deadline_s is not None:
                 self._deadline_reqs.add(req)
+            if request_id is not None:
+                self._by_id[req.id] = req
             mon = _monitor._active
             if mon is not None:
                 mon.serve_request(queued=True)
@@ -1046,6 +1072,19 @@ class DecodeEngine:
                                  trace_id=req._trace.trace_id
                                  if req._trace is not None else None)
 
+    def _retire_id(self, req: Request):
+        """Dedup bookkeeping at terminalization: a tracked id moves from
+        the live map to the bounded terminal window — EXCEPT a drain
+        bounce (``rejected_draining``), which generated nothing and must
+        stay resubmittable so the router can park-and-requeue it."""
+        if self._by_id.pop(req.id, None) is None:
+            return
+        if req.status == "rejected_draining":
+            return
+        self._done_ids[req.id] = req
+        while len(self._done_ids) > DEDUP_WINDOW:
+            self._done_ids.popitem(last=False)
+
     def _terminalize(self, req: Request, status: str, why: str,
                      finished: Optional[List[Request]], where: str = None):
         """Move ``req`` (queue position / slot already released by the
@@ -1055,6 +1094,7 @@ class DecodeEngine:
         assert status in TERMINAL_STATUSES and not req.finished
         self._deadline_reqs.discard(req)
         req.status, req.error = status, why
+        self._retire_id(req)
         req.slot = None
         req.t_done = time.time()
         (self._terminal_buf if finished is None else finished).append(req)
@@ -1715,7 +1755,8 @@ class DecodeEngine:
             mon.serve_step(dt, live, len(self._queue),
                            engine_id=self.engine_id)
             if self.paged:
-                mon.serve_paged(self._pager.stats(), self.kv_util())
+                mon.serve_paged(self._pager.stats(), self.kv_util(),
+                                engine_id=self.engine_id)
 
     def _decode_spec(self, finished: List[Request]):
         """Speculative decode step: per live slot, draft up to
@@ -1836,12 +1877,14 @@ class DecodeEngine:
         self.decode_steps += 1
         mon = _monitor._active
         if mon is not None:
-            mon.serve_paged(self._pager.stats(), self.kv_util())
+            mon.serve_paged(self._pager.stats(), self.kv_util(),
+                                engine_id=self.engine_id)
 
     def _finish(self, req: Request, finished: List[Request]):
         self._release_slot_state(req.slot)
         self._deadline_reqs.discard(req)
         req.status, req.t_done = "done", time.time()
+        self._retire_id(req)
         finished.append(req)
         mon = _monitor._active
         if mon is not None:
@@ -1873,6 +1916,35 @@ class DecodeEngine:
         else:
             cap = self.max_slots * self.max_len
         return cached / cap if cap else 0.0
+
+    def door_state(self, top_prefixes: int = 8) -> dict:
+        """Cheap, JSON-safe snapshot of this engine's front door — the
+        blob an EngineEndpoint publishes to the discovery plane so the
+        router places/ejects without ever reaching into engine internals.
+        ``state`` is accepting / draining / drained; load is free slots +
+        queue depth + active count; ``prefix_keys`` are digests of the
+        most recently registered first-block prefixes (cache-aware
+        placement matches a new prompt's first block against these)."""
+        state = "accepting"
+        if self._draining:
+            state = "drained" if self.drained else "draining"
+        out = {
+            "state": state,
+            "engine_id": int(self.engine_id),
+            "free_slots": int(self._slots.n_free),
+            "queue_depth": int(self.queue_depth),
+            "active": int(self.active_count),
+            "free_blocks": 0,
+            "block_size": int(self.block_size) if self.paged else 0,
+            "prefix_keys": [],
+            "prefix_hits": 0,
+        }
+        if self.paged:
+            out["free_blocks"] = int(self._pager.free_blocks
+                                     + self._pager.lru_blocks)
+            out["prefix_hits"] = int(self._pager.prefix_hits)
+            out["prefix_keys"] = self._pager.prefix_digests(top_prefixes)
+        return out
 
     def stats(self) -> dict:
         out = {

@@ -57,7 +57,9 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["FaultSchedule", "InjectedFault", "DispatchWatchdog",
-           "EngineHangError", "FAULT_SITES", "FAULT_ENV", "HANG_ENV"]
+           "EngineHangError", "FAULT_SITES", "FAULT_ENV", "HANG_ENV",
+           "RouteFaultSchedule", "InjectedRouteFault", "ROUTE_FAULT_ENV",
+           "ROUTE_FAULT_SITES"]
 
 FAULT_ENV = "PADDLE_SERVE_FAULT"
 HANG_ENV = "PADDLE_SERVE_HANG_S"
@@ -67,9 +69,20 @@ FAULT_SITES = ("decode", "chunk", "admit", "alloc", "verify",
 _ACTIONS = ("raise", "slow")
 _DEFAULT_SLOW_S = 0.05
 
+ROUTE_FAULT_ENV = "PADDLE_ROUTE_FAULT"
+ROUTE_FAULT_SITES = ("route", "submit", "status")
+_ROUTE_ACTIONS = ("drop", "slow", "kill")
+
 
 class InjectedFault(RuntimeError):
     """A scripted PADDLE_SERVE_FAULT fired. Never raised by real traffic."""
+
+
+class InjectedRouteFault(OSError):
+    """A scripted PADDLE_ROUTE_FAULT ``drop`` fired — the router-side
+    stand-in for a connection falling on the floor. Subclasses OSError so
+    the default RetryPolicy (retry_on=(OSError,)) retries it exactly like
+    a real transport error."""
 
 
 class EngineHangError(RuntimeError):
@@ -143,6 +156,95 @@ class FaultSchedule:
 
     def __repr__(self):
         return (f"FaultSchedule({', '.join(f'{a}@{s}:{n}' for a, s, n, _ in self.entries)})")
+
+
+class RouteFaultSchedule:
+    """The router's chaos seam — ``PADDLE_ROUTE_FAULT``, mirroring the
+    engine's ``PADDLE_SERVE_FAULT`` (same ``<action>@<site>:<nth>[:<arg>]``
+    syntax, per-router 1-based counters) with router-shaped sites and
+    actions::
+
+        PADDLE_ROUTE_FAULT="drop@submit:2,kill@route:5,slow@status:3:0.2"
+
+    | site   | counts                              |
+    |--------|-------------------------------------|
+    | route  | Nth placement decision              |
+    | submit | Nth submit dispatch to an engine    |
+    | status | Nth health/door poll                |
+
+    ``drop`` raises InjectedRouteFault at the site (an OSError, so the
+    retry policy backs off and retries — the dropped-connection drill);
+    ``slow`` sleeps ``<arg>`` seconds (default 0.05); ``kill`` returns
+    ``"kill"`` for the caller to kill the chosen engine — the router
+    chaos-kills the target so ejection + requeue-elsewhere run through
+    the same code paths a SIGKILL'd process would exercise."""
+
+    def __init__(self, entries: List[Tuple[str, str, int, float]]):
+        self.entries = entries
+        self._counts: Dict[str, int] = {s: 0 for s in ROUTE_FAULT_SITES}
+
+    @classmethod
+    def parse(cls, spec: str) -> "RouteFaultSchedule":
+        entries = []
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                action, rest = raw.split("@", 1)
+                parts = rest.split(":")
+                site, nth = parts[0], int(parts[1])
+                arg = float(parts[2]) if len(parts) > 2 else _DEFAULT_SLOW_S
+            except (ValueError, IndexError):
+                raise ValueError(
+                    f"{ROUTE_FAULT_ENV} entry {raw!r} is not "
+                    f"<action>@<site>:<nth>[:<arg>]") from None
+            if action not in _ROUTE_ACTIONS:
+                raise ValueError(f"{ROUTE_FAULT_ENV} action {action!r} not "
+                                 f"in {_ROUTE_ACTIONS} ({raw!r})")
+            if site not in ROUTE_FAULT_SITES:
+                raise ValueError(f"{ROUTE_FAULT_ENV} site {site!r} not in "
+                                 f"{ROUTE_FAULT_SITES} ({raw!r})")
+            if nth < 1:
+                raise ValueError(f"{ROUTE_FAULT_ENV} nth must be >= 1 "
+                                 f"({raw!r})")
+            entries.append((action, site, nth, arg))
+        return cls(entries)
+
+    @classmethod
+    def from_env(cls) -> Optional["RouteFaultSchedule"]:
+        spec = os.environ.get(ROUTE_FAULT_ENV, "")
+        return cls.parse(spec) if spec else None
+
+    def fired(self, site: str) -> int:
+        """How many times ``site`` has been hit so far."""
+        return self._counts[site]
+
+    def fire(self, site: str) -> Optional[str]:
+        """Record one occurrence of ``site``: ``slow`` sleeps in place,
+        ``drop`` raises InjectedRouteFault, ``kill`` returns ``"kill"``
+        (slow composes with either — the sleep runs first)."""
+        self._counts[site] += 1
+        n = self._counts[site]
+        verdict = None
+        boom = None
+        for action, s, nth, arg in self.entries:
+            if s != site or nth != n:
+                continue
+            if action == "slow":
+                time.sleep(arg)
+            elif action == "drop":
+                boom = InjectedRouteFault(
+                    f"injected {site} drop #{n} ({ROUTE_FAULT_ENV})")
+            else:
+                verdict = "kill"
+        if boom is not None:
+            raise boom
+        return verdict
+
+    def __repr__(self):
+        return (f"RouteFaultSchedule("
+                f"{', '.join(f'{a}@{s}:{n}' for a, s, n, _ in self.entries)})")
 
 
 class DispatchWatchdog:

@@ -46,14 +46,25 @@ Mechanics:
 """
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["BlockPager", "PagerStats"]
+__all__ = ["BlockPager", "PagerStats", "prefix_digest"]
 
 TRASH_BLOCK = 0
+
+
+def prefix_digest(tokens: Sequence[int]) -> str:
+    """Stable cross-process digest of a token prefix. The router and the
+    engine door compute this over the SAME tokens (the first
+    ``block_size`` of a prompt) to match traffic to the replica whose
+    prefix cache already holds those blocks — only digests travel over
+    the discovery plane, never token ids."""
+    raw = ",".join(str(int(t)) for t in tokens).encode("ascii")
+    return hashlib.blake2b(raw, digest_size=8).hexdigest()
 
 # bound on the shadow set share_prefix uses to notice REPEATED prefixes
 # independently of the adoption walk (the 0%-hit-rate-with-repeats WARN in
@@ -154,6 +165,18 @@ class BlockPager:
     @property
     def blocks_used(self) -> int:
         return self.usable_blocks - len(self._free) - len(self._lru)
+
+    def prefix_digests(self, top: int = 8) -> List[str]:
+        """Digests of the most recently registered FIRST-block prefix keys
+        (length == block_size — the granularity a router can match a new
+        prompt against before placement). Newest first, at most ``top``.
+        Registry insertion order is registration recency, so this is a
+        cheap tail walk, not a scan of block contents."""
+        if top < 1:
+            return []
+        bs = self.block_size
+        keys = [k for k in self._registry if len(k) == bs]
+        return [prefix_digest(k) for k in reversed(keys[-int(top):])]
 
     def stats(self) -> PagerStats:
         used = self._ref > 0

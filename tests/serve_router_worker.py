@@ -1,0 +1,103 @@
+"""Subprocess worker for the fleet-router e2e (tests/test_router_e2e.py).
+
+One engine replica as it would run in a real fleet: tiny GPT behind a
+DoorServer (HTTP front door), registered on the shared launch-KV master
+via EngineEndpoint with a daemon heartbeat. The worker owns its step
+loop; the ROUTER lives in the parent test and only ever talks to this
+process through the directory blobs and the door.
+
+Protocol: prints ``READY <door-addr>`` once warmed and registered, then
+steps until drained (the router's rolling_restart POSTs /drain) and
+exits rc=0 with a JSON summary on the last line. A SIGKILLed worker
+prints nothing more — its heartbeat just stops, which is exactly the
+staleness/transport signal the failover phase tests.
+
+usage: serve_router_worker.py <name> <kv-endpoint> [deadline_s]
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    name = sys.argv[1]
+    kv_endpoint = sys.argv[2]
+    deadline_s = float(sys.argv[3]) if len(sys.argv) > 3 else 600.0
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import DecodeEngine, DoorServer, EngineEndpoint
+    from paddle_tpu.serving.endpoint import KVDirectory
+
+    # seed 0 everywhere: every replica serves the SAME weights, so a
+    # requeued request finishes with the tokens the dead engine would
+    # have produced
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    eng = DecodeEngine(m, max_slots=2, max_len=48, block_size=8,
+                       prefill_chunk=8, kv_blocks=24)
+
+    # warm the chunk + decode executables BEFORE announcing READY, so the
+    # parent's serialized phases measure placement, not jit latency
+    warm = eng.submit([60, 61, 62, 63, 60], max_new_tokens=2)
+    eng.run()
+    assert warm.status == "done", warm.status
+
+    lock = threading.Lock()
+    directory = KVDirectory(endpoint=kv_endpoint, job_id="router-e2e")
+    ep = EngineEndpoint(eng, name, directory, ttl_s=3.0)
+    door = DoorServer(eng, lock=lock, endpoint=ep)
+    ep.addr = door.address
+    door.start()
+    ep.publish()
+    ep.start_publishing(lock=lock)
+    print(f"READY {door.address}", flush=True)
+
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        with lock:
+            eng.step()
+            done = eng.drained
+        if done:
+            break
+        time.sleep(0.002)
+    else:
+        print(json.dumps({"error": "never drained"}), flush=True)
+        return 3
+
+    # linger so the router's drain-wait observes the drained door before
+    # this process (and its heartbeat) goes away
+    t_end = time.time() + 1.0
+    while time.time() < t_end:
+        with lock:
+            eng.step()
+        time.sleep(0.01)
+
+    ep.close()                      # explicit goodbye: clean shutdown
+    door.stop()
+    with lock:
+        eng._pager.check_invariants()
+        summary = {
+            "name": name,
+            "drained": eng.drained,
+            "prefix_hits": int(eng._pager.prefix_hits),
+            "decode_steps": int(eng.decode_steps),
+            "invariants": "ok",
+        }
+    eng.close()
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+"""Fleet-router e2e (ISSUE 19 acceptance, slow lane): a REAL fleet — two
+engine subprocesses (tests/serve_router_worker.py) with HTTP doors and
+KV-master registrations — driven by the router over actual sockets.
+
+Three gates, in order, on one fleet:
+
+1. **Affinity gate** — the same serialized prefix workload runs once
+   under ``round_robin`` and once under ``affinity``; the summed
+   per-engine ``prefix_hits`` delta must be STRICTLY greater under
+   affinity (cache-aware placement converts cross-request prefix reuse
+   into parked-block adoptions instead of splitting it across replicas).
+
+2. **Failover gate** — SIGKILL one worker mid-decode: ZERO requests
+   lost (every ticket terminalizes ``done`` on the survivor with a full
+   token stream) and ZERO duplicate completions (resubmitting a finished
+   id answers from the survivor's dedup window with identical tokens).
+
+3. **Rolling-restart gate** — ``rolling_restart`` drains and replaces
+   every worker; each drained worker exits rc=0 with a clean-invariants
+   summary, and every name re-registers under a strictly newer
+   incarnation.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_worker(name: str, kv_endpoint: str, env: dict):
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "serve_router_worker.py"),
+         name, kv_endpoint],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    t0 = time.time()
+    while time.time() - t0 < 180:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            return proc
+        if proc.poll() is not None:
+            break
+    proc.kill()
+    out, _ = proc.communicate()
+    raise AssertionError(f"worker {name} never reached READY:\n{out}")
+
+
+def _drain_output(proc, timeout=60) -> dict:
+    """Wait for a worker's clean exit and parse its JSON summary line."""
+    out, _ = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, f"worker rc={proc.returncode}:\n{out}"
+    tail = [l for l in out.splitlines() if l.startswith("{")]
+    assert tail, out
+    return json.loads(tail[-1])
+
+
+@pytest.mark.slow
+def test_router_fleet_affinity_failover_rolling_restart():
+    from paddle_tpu.distributed.launch.master import KVServer
+    from paddle_tpu.serving import RouteFaultSchedule, Router, prefix_digest
+    from paddle_tpu.serving.endpoint import KVDirectory
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("PADDLE_MONITOR", "PADDLE_SERVE_FAULT", "PADDLE_ROUTE_FAULT",
+              "PADDLE_ELASTIC_RESTART"):
+        env.pop(k, None)
+    no_faults = RouteFaultSchedule.parse("")
+
+    port = _free_port()
+    srv = KVServer(port)
+    srv.start()
+    kv = f"127.0.0.1:{port}"
+    procs = {}
+    sleep_step = lambda: time.sleep(0.02)
+    try:
+        for n in ("w0", "w1"):
+            procs[n] = _spawn_worker(n, kv, env)
+
+        def mk_router(policy):
+            r = Router(KVDirectory(endpoint=kv, job_id="router-e2e"),
+                       policy=policy, fault_schedule=no_faults)
+            deadline = time.time() + 30
+            while sorted(r.refresh()) != ["w0", "w1"]:
+                assert time.time() < deadline, r.refresh()
+                time.sleep(0.2)
+            return r
+
+        def fleet_prefix_hits(r) -> int:
+            r.refresh()
+            total = 0
+            for name, rec in r._seen.items():
+                client = r._client_for(name, rec["blob"])
+                total += int(client.door().get("prefix_hits", 0))
+            return total
+
+        def run_group(r, prefix, n_reqs, wait_key=False):
+            """Serialized same-prefix requests: each completes (parking
+            its blocks) before the next admits, so co-location shows up
+            as parked-block adoptions — the ``prefix_hits`` counter."""
+            rng = np.random.RandomState(sum(prefix))
+            for i in range(n_reqs):
+                prompt = list(prefix) + rng.randint(1, 60, 4).tolist()
+                t = r.route(prompt, max_new_tokens=4)
+                r.join([t], step=sleep_step, timeout_s=90)
+                assert t.status == "done", (t.status, t.error)
+                if wait_key:
+                    # next placement must SEE this engine advertising the
+                    # prefix — wait out one heartbeat republish
+                    digest = prefix_digest(prompt[:8])
+                    deadline = time.time() + 15
+                    while time.time() < deadline:
+                        rec = r.refresh().get(t.engine) or {}
+                        keys = ((rec.get("blob") or {}).get("door")
+                                or {}).get("prefix_keys", [])
+                        if digest in keys:
+                            break
+                        time.sleep(0.2)
+                    else:
+                        raise AssertionError(
+                            f"{t.engine} never advertised {digest}; "
+                            f"last keys={keys} blob={rec.get('blob')}")
+
+        # ---- gate 1: affinity beats round-robin on summed prefix_hits.
+        # Same shape both arms: 2 prefix groups x 4 requests; disjoint
+        # token ranges so neither arm warms the other's prefixes.
+        rr = mk_router("round_robin")
+        base = fleet_prefix_hits(rr)
+        for prefix in ([1, 2, 3, 4, 5, 6, 7, 8], [9, 10, 11, 12, 13, 14, 15, 16]):
+            run_group(rr, prefix, 4)
+        rr_hits = fleet_prefix_hits(rr) - base
+
+        aff = mk_router("affinity")
+        base = fleet_prefix_hits(aff)
+        for prefix in ([21, 22, 23, 24, 25, 26, 27, 28],
+                       [31, 32, 33, 34, 35, 36, 37, 38]):
+            run_group(aff, prefix, 4, wait_key=True)
+        aff_hits = fleet_prefix_hits(aff) - base
+        assert aff_hits > rr_hits, (
+            f"affinity must strictly beat round-robin on parked-prefix "
+            f"adoptions: affinity={aff_hits} round_robin={rr_hits}")
+        assert aff.counters["affinity_hits"] >= 1
+
+        # ---- gate 2: SIGKILL one worker mid-decode; zero lost, zero dup.
+        rng = np.random.RandomState(7)
+        tickets = [aff.route(rng.randint(1, 60, 6).tolist(),
+                             max_new_tokens=12, request_id=f"e2e-{i}")
+                   for i in range(4)]
+        assert all(t.engine for t in tickets)
+        time.sleep(0.5)             # let decode start somewhere
+        by_eng = {}
+        for t in tickets:
+            by_eng.setdefault(t.engine, []).append(t)
+        victim = max(by_eng, key=lambda n: len(by_eng[n]))
+        survivor = "w1" if victim == "w0" else "w0"
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=30)
+        aff.join(tickets, step=sleep_step, timeout_s=180)
+        assert [t.status for t in tickets] == ["done"] * 4, \
+            [(t.status, t.error) for t in tickets]
+        assert all(len(t.tokens) == 12 for t in tickets)
+        assert all(t.engine == survivor for t in by_eng[victim])
+        assert sum(t.requeues for t in tickets) >= len(by_eng[victim])
+        assert aff.counters["rejected"] == 0
+        # duplicate resubmit of a finished id, straight at the survivor's
+        # DOOR (router.route would answer from its own ticket table): the
+        # engine dedup window replies done with the SAME stream — no
+        # second generation
+        t0 = next(t for t in tickets if t.requeues)
+        view = aff._clients[survivor].submit(t0.prompt, 12, None, t0.id)
+        assert view["status"] == "done" and view["tokens"] == t0.tokens
+
+        # ---- gate 3: rolling restart replaces every worker, rc=0 each.
+        procs[victim] = _spawn_worker(victim, kv, env)   # restore fleet
+        deadline = time.time() + 30
+        while victim in aff._ejected:
+            assert time.time() < deadline, "new incarnation never readmitted"
+            aff.refresh()
+            time.sleep(0.2)
+
+        worker_summaries = {}
+
+        def restart(name):
+            worker_summaries[name] = _drain_output(procs[name], timeout=60)
+            procs[name] = _spawn_worker(name, kv, env)
+
+        aff.rolling_restart(grace_s=20.0, restart=restart,
+                            step=sleep_step, wait_s=120.0)
+        assert sorted(worker_summaries) == ["w0", "w1"]
+        for name, summ in worker_summaries.items():
+            assert summ["drained"] is True and summ["invariants"] == "ok"
+        assert aff.counters["rejected"] == 0
+
+        # the upgraded fleet serves: one more routed request lands done
+        t = aff.route(rng.randint(1, 60, 6).tolist(), max_new_tokens=4)
+        aff.join([t], step=sleep_step, timeout_s=90)
+        assert t.status == "done"
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        srv.stop()

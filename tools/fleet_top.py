@@ -9,6 +9,12 @@ activity, serving tokens/s + kv_util + queue depth when present) and the
 most recent WARN events. Stdlib only — it runs wherever the stream file
 is visible (rank 0's host, or anywhere the log dir is mounted).
 
+When the stream carries ``route_state`` records (a fleet router —
+serving/router.py — sharing the monitor sink), a router panel renders
+under the dashboard: per-engine door state, live requests, affinity-hit
+rate and the requeue/ejection tallies. A serving-only stream (no fleet
+records at all) renders the router panel alone.
+
 Usage:
     python tools/fleet_top.py run.fleet.jsonl            # live, 2s refresh
     python tools/fleet_top.py run.fleet.jsonl --interval 0.5
@@ -24,18 +30,23 @@ import time
 CLEAR = "\x1b[2J\x1b[H"
 
 
-def load_stream(path, keep=None):
+def load_stream(path, keep=None, routes=False):
     """Parse the whole stream -> (meta, fleet_records, warns). Small files
     (one record per publish interval) make a full re-parse per frame the
     simple, torn-tail-tolerant choice. ``keep`` bounds the retained fleet
     records (the newest N+1): a --window view of a long job never holds
-    hours of rounds in memory just to diff the last few."""
-    meta, fleets, warns = {}, [], []
+    hours of rounds in memory just to diff the last few.
+
+    ``routes=True`` widens the return to (meta, fleets, warns, route_states)
+    — the ``route_state`` records a fleet router (serving/router.py) emits
+    into the same monitor stream; the newest one drives the router panel."""
+    meta, fleets, warns, route_states = {}, [], [], []
     try:
         with open(path) as f:
             text = f.read()
     except OSError:
-        return meta, fleets, warns
+        return (meta, fleets, warns, route_states) if routes \
+            else (meta, fleets, warns)
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -55,7 +66,54 @@ def load_stream(path, keep=None):
             warns.append(r)
             if keep is not None and len(warns) > 50:
                 del warns[0]
-    return meta, fleets, warns
+        elif kind == "route_state":
+            route_states.append(r)
+            if len(route_states) > 2:
+                del route_states[0]
+    return (meta, fleets, warns, route_states) if routes \
+        else (meta, fleets, warns)
+
+
+def render_router(route_states, now=None, width=100):
+    """Router panel (the testable unit): per-engine door table + placement/
+    failover counters from the newest ``route_state`` record. Rendered
+    standalone when the stream has no fleet records (a serving-only job),
+    appended under the fleet dashboard otherwise."""
+    if not route_states:
+        return ""
+    now = time.time() if now is None else now
+    cur = route_states[-1]
+    prev = route_states[-2] if len(route_states) > 1 else None
+    c = cur.get("counters") or {}
+    doors = cur.get("doors") or {}
+    out = []
+    age = now - cur.get("ts", now)
+    aff = c.get("affinity_hits", 0)
+    placed = aff + c.get("spills", 0)
+    head = (f"router: {len(doors)} engines  live requests "
+            f"{int(c.get('live_tickets', 0))}  placed {int(placed)}  "
+            f"affinity {aff / placed if placed else 0:.0%}  requeues "
+            f"{int(c.get('requeues', 0))}  ejections "
+            f"{int(c.get('ejections', 0))}  rejected "
+            f"{int(c.get('rejected', 0))}  age={age:.1f}s")
+    if prev is not None:
+        dreq = c.get("requeues", 0) - (prev.get("counters") or {}) \
+            .get("requeues", 0)
+        if dreq > 0 and not c.get("ejections", 0):
+            head += f"  [REQUEUE STORM? +{int(dreq)} with 0 ejections]"
+    out.append(head)
+    out.append("-" * min(width, 100))
+    out.append(f"{'engine':<12} {'door':<10} {'queue':>6} {'active':>7} "
+               f"{'free_slots':>11} {'free_blocks':>12} {'prefix_hits':>12}")
+    for name in sorted(doors):
+        d = doors[name]
+        out.append(f"{name:<12} {d.get('state', '?'):<10} "
+                   f"{int(d.get('queue_depth', 0)):>6} "
+                   f"{int(d.get('active', 0)):>7} "
+                   f"{int(d.get('free_slots', 0)):>11} "
+                   f"{int(d.get('free_blocks', 0)):>12} "
+                   f"{int(d.get('prefix_hits', 0)):>12}")
+    return "\n".join(out)
 
 
 def _pick(rec, kind, name, rank):
@@ -94,16 +152,21 @@ def _windowed(cur, basis, kind, name, rank):
     return b - a
 
 
-def render(meta, fleets, warns, now=None, width=100, window=None):
+def render(meta, fleets, warns, now=None, width=100, window=None,
+           routes=None):
     """One dashboard frame as a string (the testable unit).
 
     ``window=N`` switches every rate AND counter column to a rolling view
     over the last N fleet rounds (long jobs: a counter that has summed for
     six hours says nothing about the last minute); default keeps rates over
-    the newest round and counters cumulative-since-start."""
+    the newest round and counters cumulative-since-start. ``routes``:
+    route_state records (load_stream(..., routes=True)) — appends the
+    router panel, or renders it alone for a serving-only stream."""
     now = time.time() if now is None else now
     out = []
     if not fleets:
+        if routes:
+            return render_router(routes, now=now, width=width)
         out.append("fleet_top: no fleet records yet "
                    "(aggregator publishes every "
                    f"{meta.get('publish_s', '?')}s)" if meta else
@@ -212,6 +275,9 @@ def render(meta, fleets, warns, now=None, width=100, window=None):
         for w in warns[-5:]:
             out.append(f"  +{w.get('ts', t0) - t0:8.1f}s  "
                        f"[{w.get('warn', '?'):<12}] {w.get('msg', '')}")
+    if routes:
+        out.append("-" * min(width, 100))
+        out.append(render_router(routes, now=now, width=width))
     return "\n".join(out)
 
 
@@ -243,13 +309,16 @@ def main(argv=None):
     args = ap.parse_args(argv)
     keep = (args.window + 1) if args.window else None
     if args.once:
-        meta, fleets, warns = load_stream(args.path, keep=keep)
-        print(render(meta, fleets, warns, window=args.window))
-        return 0 if fleets else 1
+        meta, fleets, warns, routes = load_stream(args.path, keep=keep,
+                                                  routes=True)
+        print(render(meta, fleets, warns, window=args.window, routes=routes))
+        return 0 if (fleets or routes) else 1
     try:
         while True:
-            meta, fleets, warns = load_stream(args.path, keep=keep)
-            frame = render(meta, fleets, warns, window=args.window)
+            meta, fleets, warns, routes = load_stream(args.path, keep=keep,
+                                                      routes=True)
+            frame = render(meta, fleets, warns, window=args.window,
+                           routes=routes)
             if not args.no_clear:
                 sys.stdout.write(CLEAR)
             print(frame)

@@ -631,6 +631,56 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       f"contract is broken (a shape depends on the "
                       f"live-slot set)", file=out)
 
+    # fleet router (serving/router.py): placement mix, failover activity,
+    # and the requeue-storm signature — requeues climbing while the router
+    # never ejected anything means requests are BOUNCING between live
+    # engines (flapping transport / drain loop / chaos drops), not failing
+    # over from a dead one
+    route_counters = {k: v for k, v in counters_m.items()
+                      if k.startswith("route/")}
+    route_states = by_kind.get("route_state", [])
+    if route_counters or route_states:
+        print(f"\n== router ==", file=out)
+        aff = route_counters.get("route/affinity_hits", 0)
+        spills = route_counters.get("route/spills", 0)
+        placed = aff + spills
+        requeues = route_counters.get("route/requeues", 0)
+        ejections = route_counters.get("route/ejections", 0)
+        rejected = route_counters.get("route/rejected", 0)
+        line = (f"  placed {int(placed)}  affinity {int(aff)}"
+                + (f" ({aff / placed:.0%})" if placed else "")
+                + f"  spills {int(spills)}  requeues {int(requeues)}  "
+                f"ejections {int(ejections)}  rejected {int(rejected)}")
+        print(line, file=out)
+        if route_states:
+            doors = route_states[-1].get("doors") or {}
+            for name in sorted(doors):
+                door = doors[name]
+                print(f"  engine {name}: {door.get('state', '?'):<10} "
+                      f"queue {int(door.get('queue_depth', 0))}  active "
+                      f"{int(door.get('active', 0))}  free_slots "
+                      f"{int(door.get('free_slots', 0))}  prefix_hits "
+                      f"{int(door.get('prefix_hits', 0))}", file=out)
+        ejs = by_kind.get("route_eject", [])
+        for r in ejs:
+            print(f"  +{r.get('ts', t0) - t0:9.3f}s  {tag(r)}ejected "
+                  f"{r.get('engine', '?')}: {r.get('why', '?')}", file=out)
+        reqs_by_why = {}
+        for r in by_kind.get("route_requeue", []):
+            reqs_by_why.setdefault(r.get("why", "?"), []).append(r)
+        for why, rs in sorted(reqs_by_why.items()):
+            print(f"  requeues[{why}] x{len(rs)} (e.g. "
+                  f"{rs[-1].get('request', '?')}: "
+                  f"{rs[-1].get('src', '?')} -> {rs[-1].get('dst', '?')})",
+                  file=out)
+        if requeues >= 3 and not ejections:
+            print(f"  WARNING: {int(requeues)} requeue(s) with ZERO "
+                  f"ejections — requeue-storm signature (requests bounce "
+                  f"between live engines instead of failing over from a "
+                  f"dead one: flapping transport, a drain/uncordon loop, "
+                  f"or injected chaos drops; nothing actually died)",
+                  file=out)
+
     # model-health plane (monitor/health.py): the numerics post-mortem next
     # to the time/throughput ones above — trip timeline, per-layer tensor
     # stats, divergence flags, and the two signatures worth shouting about
