@@ -432,6 +432,17 @@ def main_decode(argv=()):
     output keeps re-quoting the repetitive context). The best-so-far line
     gains ``spec``/``accepted_per_step``/``draft_hit_rate``.
 
+    ``--pool`` (requires ``--paged``) measures the cross-process
+    prefix-cache tier: a "previous incarnation" engine serves the shared
+    system prompt once and exports its parked blocks to a host pool
+    (serving/kvpool.py), then the MEASURED engine starts cold with the
+    pool attached — its first shared-prompt admission adopts the
+    exported blocks instead of re-prefilling them. The best-so-far line
+    gains ``pool_hit_rate`` / ``adopted_tokens`` / ``pool_fetch_hits``
+    next to the TTFT percentiles, and ``steady_state_recompiles`` must
+    stay 0 with adoption on the measured path (the splice is table data
+    + a device_put, never a new shape).
+
     ``--router N`` measures the FLEET lane instead: N in-process paged
     engines registered on a LocalDirectory behind the serving Router
     (cache-aware placement). The workload interleaves a handful of shared
@@ -485,6 +496,7 @@ def main_decode(argv=()):
 
     paged = _cli_flag(argv, "paged") is not None
     chaos = _cli_flag(argv, "chaos") is not None
+    pool_flag = _cli_flag(argv, "pool") is not None
     spec = _cli_flag(argv, "spec")
     if spec == "":
         spec = "prompt_lookup"     # bare --spec: the no-model drafter
@@ -504,6 +516,10 @@ def main_decode(argv=()):
     if spec and not paged:
         print("--spec requires --paged (speculative K/V lands in pager "
               "blocks); enabling --paged", file=sys.stderr)
+        paged = True
+    if pool_flag and not paged:
+        print("--pool requires --paged (exported blocks live in the "
+              "BlockPager); enabling --paged", file=sys.stderr)
         paged = True
 
     paddle.seed(0)
@@ -556,11 +572,16 @@ def main_decode(argv=()):
         from paddle_tpu.serving import EarlyExitDrafter
         drafter = EarlyExitDrafter(model, interval=2,
                                    ctx_len=horizon // 4, max_k=4)
+    kv_pool = None
+    if pool_flag:
+        from paddle_tpu.serving import LocalPool
+        kv_pool = LocalPool()
     if paged:
         engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
                               paged=True, block_size=16,
                               prefill_chunk=16 if tiny else 32,
-                              fault_schedule=faults, drafter=drafter)
+                              fault_schedule=faults, drafter=drafter,
+                              kv_pool=kv_pool)
     else:
         engine = DecodeEngine(model, max_slots=slots, max_len=horizon,
                               paged=False,
@@ -568,11 +589,31 @@ def main_decode(argv=()):
     rng = np.random.RandomState(0)
     # shared-prefix serving workload: a common "system prompt" opens every
     # request (half the prompt) — on --paged the pager serves it from
-    # shared blocks, which is the concurrency-at-fixed-bytes story
-    sys_prefix = rng.randint(0, cfg.vocab_size, horizon // 8).tolist()
+    # shared blocks, which is the concurrency-at-fixed-bytes story. The
+    # pool lane stretches it to cover full 16-token blocks: only whole
+    # blocks export/adopt across processes
+    sys_prefix = rng.randint(
+        0, cfg.vocab_size,
+        horizon // 4 if pool_flag else horizon // 8).tolist()
     lo = max(len(sys_prefix) + 4, horizon // 4)
     hi = horizon // 2
     ttfts = []
+    if pool_flag:
+        # previous incarnation: serve the shared prompt once, export its
+        # parked blocks, die. The measured engine below starts with a
+        # cold pager and a warm pool — the restart story under a clock.
+        prev = DecodeEngine(model, max_slots=2, max_len=horizon,
+                            paged=True, block_size=16,
+                            prefill_chunk=16 if tiny else 32,
+                            kv_pool=kv_pool)
+        pr = prev.submit(sys_prefix + rng.randint(
+            0, cfg.vocab_size, 4).tolist(), max_new_tokens=4)
+        prev.run()
+        assert pr.status == "done"
+        exported = prev.pool_stats()["exports"]
+        assert exported > 0, "pool lane: previous incarnation exported " \
+                             "nothing (shared prefix shorter than a block?)"
+        del prev
 
     def refill():
         # staggered prompt lengths and decode budgets: requests finish at
@@ -652,9 +693,21 @@ def main_decode(argv=()):
                             round(engine.spec_accepted
                                   / max(engine.spec_drafted, 1), 3)}
                        if spec else {})
+        pool_fields = {}
+        if pool_flag:
+            ps = engine.pool_stats()
+            pool_fields = {
+                "pool": True,
+                "pool_hit_rate": round(pager.pool_hits
+                                       / max(n_submitted[0], 1), 3),
+                "adopted_tokens": ps["adopted_tokens"],
+                "pool_fetch_hits": ps["fetch_hits"],
+                "pool_exports": ps["exports"],
+            }
         print(json.dumps(dict(_fleet_fields(), **_trace_fields(),
                               **_health_fields(),
-                              **chaos_fields, **spec_fields, **{
+                              **chaos_fields, **spec_fields,
+                              **pool_fields, **{
             "metric": "gpt_medium_decode_tokens_per_sec_per_chip",
             "value": round(best / chips, 1),
             "unit": "tokens/s (decode)",

@@ -48,7 +48,8 @@ from .plan import Index, ReshardPlan, normalize_index, target_indices
 
 __all__ = ["StagedArray", "stage", "is_sharded_array", "flatten_state",
            "unflatten_state", "save_sharded", "load_sharded", "read_index",
-           "coverage_problems", "ReshardStats", "SCHEMA_VERSION"]
+           "coverage_problems", "ReshardStats", "SCHEMA_VERSION",
+           "encode_block", "decode_block", "read_block"]
 
 SCHEMA_VERSION = 1
 _MARKER = "__reshard_array__"
@@ -250,6 +251,54 @@ def save_sharded(path: str, state, rank: int = 0,
         json.dump(index, f, indent=1, sort_keys=True)
     files += 1
     return {"files": files, "bytes": total}
+
+
+# ---------------------------------------------------------- block wire format
+
+def encode_block(arr) -> Tuple[bytes, Dict[str, Any]]:
+    """Raw C-order bytes + JSON-ready meta for ONE host array — the same
+    headerless ``.bin`` contract :func:`save_sharded` writes to disk, as an
+    in-memory pair. This is the KV block pool's wire format
+    (``serving/kvpool.py``): bfloat16-safe (``np.save`` is not) and
+    byte-comparable across processes, so a pool round-trip is bitwise."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return a.tobytes(), {"shape": [int(s) for s in a.shape],
+                         "dtype": _dtype_name(a.dtype)}
+
+
+def decode_block(data: bytes, meta: Dict[str, Any]) -> np.ndarray:
+    """Inverse of :func:`encode_block`. Size-validates against the meta
+    (``prod(()) == 1`` covers scalars) so a truncated or mis-keyed payload
+    raises instead of reshaping garbage into the KV cache."""
+    shape = tuple(int(s) for s in meta["shape"])
+    dtype = _resolve_dtype(meta["dtype"])
+    want = dtype.itemsize * int(math.prod(shape))
+    if len(data) != want:
+        raise ValueError(f"block payload is {len(data)} bytes, expected "
+                         f"{want} for shape {shape} dtype {meta['dtype']}")
+    return np.frombuffer(data, dtype=dtype).reshape(shape)
+
+
+def read_block(path: str, key: str, block_index,
+               index: Optional[Dict[str, Any]] = None) -> np.ndarray:
+    """Read ONE block of one array out of a sharded payload without
+    assembling the array (block-granular entry point; ``load_sharded``
+    reads whole arrays). ``block_index`` is the normalized per-dim
+    ``[[a, b], ...]`` region as recorded in the rank index; pass a
+    pre-merged ``read_index(path)`` result to amortize the index scan over
+    many block reads. Raises ``KeyError`` when the array or block is not
+    present in any rank's payload."""
+    if index is None:
+        index = read_index(path)
+    entry = index["arrays"].get(key)
+    if entry is None:
+        raise KeyError(f"{path}: no array {key!r} in index")
+    idx = tuple(tuple(int(x) for x in ab) for ab in block_index)
+    rel = _entry_indices(entry).get(idx)
+    if rel is None:
+        raise KeyError(f"{path}: {key!r} has no block {idx}")
+    dtype = _resolve_dtype(entry["dtype"])
+    return np.asarray(_make_reader(os.path.join(path, rel), dtype, idx)())
 
 
 # -------------------------------------------------------------------- loading

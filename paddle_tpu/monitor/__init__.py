@@ -543,15 +543,21 @@ class Monitor:
         self.registry.histogram("serve/queue_wait_s").observe(wait_s)
 
     def serve_page_reject(self, free_blocks: int, needed_blocks: int,
-                          trace_id=None):
+                          trace_id=None, pool_blocks: int = 0):
         """Paged admission refused for lack of KV blocks. ``free >=
         needed`` in this event is the allocator-bug signature (refusal
-        without real pressure) that metrics_summary WARNs on.
-        ``trace_id``: the refused REQUEST's trace (more precise than the
-        generic most-recent-trace tag)."""
+        without real pressure) that metrics_summary WARNs on — except
+        when ``pool_blocks > 0``: the admission adopted that many blocks
+        from the cross-process pool before refusing, so the adopted
+        blocks legitimately sit between "free" and "needed" and the WARN
+        predicate must skip the record. ``trace_id``: the refused
+        REQUEST's trace (more precise than the generic most-recent-trace
+        tag)."""
         self.registry.counter("serve/page_rejects").inc()
         fields = dict(free_blocks=int(free_blocks),
                       needed_blocks=int(needed_blocks))
+        if pool_blocks:
+            fields["pool_blocks"] = int(pool_blocks)
         if trace_id:
             fields["trace"] = trace_id
         self.emit("serve_page_reject", **fields)
@@ -609,6 +615,31 @@ class Monitor:
         g("serve/prefix_hit_tokens").set(pager_stats.prefix_hit_tokens)
         g("serve/prefix_repeats").set(pager_stats.prefix_repeats)
         g("serve/shared_hits").set(pager_stats.shared_hits)
+        # cross-process tier: splices that came from the shared pool
+        # rather than the in-process registry (a subset of prefix_hits)
+        g("serve/pool_hits").set(getattr(pager_stats, "pool_hits", 0))
+        g("serve/pool_hit_tokens").set(
+            getattr(pager_stats, "pool_hit_tokens", 0))
+
+    def serve_pool(self, pool_stats, engine_id=None):
+        """Per-step cross-process KV-pool gauges (cheap sets, no event).
+        ``pool_stats`` is ``DecodeEngine.pool_stats()``: cumulative
+        export/fetch counters plus the current generation — gauges, not
+        counters, because the engine owns the cumulative values and
+        re-emits them every step (the same pattern as serve_paged)."""
+        g = self.registry.gauge
+        g("pool/gen").set(pool_stats.get("gen", 0))
+        g("pool/exports").set(pool_stats.get("exports", 0))
+        g("pool/export_errors").set(pool_stats.get("export_errors", 0))
+        g("pool/fetches").set(pool_stats.get("fetches", 0))
+        g("pool/fetch_hits").set(pool_stats.get("fetch_hits", 0))
+        g("pool/fetch_misses").set(pool_stats.get("fetch_misses", 0))
+        g("pool/adopted_blocks").set(pool_stats.get("adopted_blocks", 0))
+        g("pool/adopted_tokens").set(pool_stats.get("adopted_tokens", 0))
+        g("pool/pending_exports").set(pool_stats.get("pending_exports", 0))
+        if engine_id is not None:
+            g(f"pool/fetch_hits.eng{engine_id}").set(
+                pool_stats.get("fetch_hits", 0))
 
     def serve_admitted(self, ttft_s: float, bucket: int, prefill_s: float):
         """A request's prefill folded into a free slot; its first token is
@@ -781,6 +812,14 @@ class Monitor:
         the fleet is empty) — the router's own saturation signal."""
         self.registry.counter("route/rejected").inc()
         self.emit("route_reject", why=why)
+
+    def route_queued(self, depth: int):
+        """Every live door was at capacity, so the router parked the
+        request in its bounded admission queue instead of rejecting it;
+        ``depth`` is the queue depth after the push. Saturation that
+        resolves itself shows up here, not in route/rejected."""
+        self.registry.counter("route/queued").inc()
+        self.registry.gauge("route/queue_depth").set(int(depth))
 
     def route_requeue(self, request_id, from_engine, to_engine,
                       why: str, trace_id=None):

@@ -49,6 +49,19 @@ dedup guarantees one id never generates twice), and ``rolling_restart()``
 chaining per-engine drains so a fleet upgrade drops nothing. The
 ``PADDLE_ROUTE_FAULT`` chaos seam (drop/slow/kill at exact route/submit/
 status counts) makes the failover contract deterministically testable.
+A bounded router-side admission queue (``max_queue=``) parks requests
+when every live door is at capacity instead of rejecting, and ``poll()``
+streams tokens incrementally (``/status?since=`` cursor).
+
+Cross-process prefix-cache tier (kvpool.py): a per-host shared pool of
+exported KV blocks over the launch KV master (``resolve_kv_pool()``;
+in-process ``LocalPool`` fallback). Pass ``kv_pool=`` to the engine and
+refcount-0 parked blocks export as raw-block snapshots keyed by their
+prefix-registry digests; a cold engine's registry miss falls through to
+the pool and splices fetched blocks via ``BlockPager.adopt_blocks`` —
+a restarted replica re-serves the fleet's shared system prompts without
+re-prefilling them. A weight swap (``drop_prefix_cache``) bumps the pool
+generation, atomically invalidating every stale entry.
 
 Telemetry: ``serve/*`` counters/gauges/histograms in ``paddle_tpu.monitor``
 (QPS, TTFT, per-token latency, slot occupancy, executable mints,
@@ -63,6 +76,7 @@ from .engine import (DecodeEngine, Request, generate_via_engine,
 from .guardrails import (DispatchWatchdog, EngineHangError, FaultSchedule,
                          InjectedFault, InjectedRouteFault,
                          RouteFaultSchedule)
+from .kvpool import KVPool, LocalPool, resolve_kv_pool
 from .pager import BlockPager, prefix_digest
 from .router import (EngineDown, HTTPEngineClient, LocalEngineClient,
                      NoEngineAvailable, Router, RouteTicket)
@@ -79,4 +93,5 @@ __all__ = ["DecodeEngine", "Request", "generate_via_engine",
            "Router", "RouteTicket", "LocalEngineClient", "HTTPEngineClient",
            "EngineDown", "NoEngineAvailable", "RouteFaultSchedule",
            "InjectedRouteFault", "EngineEndpoint", "DoorServer",
-           "LocalDirectory", "KVDirectory", "prefix_digest"]
+           "LocalDirectory", "KVDirectory", "prefix_digest",
+           "KVPool", "LocalPool", "resolve_kv_pool"]

@@ -220,7 +220,7 @@ class DoorServer:
     | route            | method | body / query          | returns          |
     |------------------|--------|-----------------------|------------------|
     | /submit          | POST   | prompt, max_new_tokens, eos_token_id, request_id | id, status, error, tokens |
-    | /status          | GET    | ?id=<request_id>      | id, status, error, tokens |
+    | /status          | GET    | ?id=<request_id>&since=<n> | id, status, error, tokens[n:], since, n_tokens |
     | /door            | GET    |                       | door, inc, name  |
     | /drain           | POST   | grace_s               | ok               |
     | /stats           | GET    |                       | engine.stats()   |
@@ -275,8 +275,15 @@ class DoorServer:
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path == "/status":
-                    rid = urllib.parse.parse_qs(parsed.query).get("id", [""])[0]
-                    out = outer._status(rid)
+                    qs = urllib.parse.parse_qs(parsed.query)
+                    rid = qs.get("id", [""])[0]
+                    since = None
+                    try:
+                        if "since" in qs:
+                            since = int(qs["since"][0])
+                    except (ValueError, IndexError):
+                        since = None
+                    out = outer._status(rid, since=since)
                     self._reply(200 if "error_code" not in out else 404, out)
                 elif parsed.path == "/door":
                     with outer._lock:
@@ -304,9 +311,23 @@ class DoorServer:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
-    def _req_view(self, req) -> dict:
-        return {"id": req.id, "status": req.status, "error": req.error,
-                "tokens": [int(t) for t in req.tokens]}
+    def _req_view(self, req, since: Optional[int] = None) -> dict:
+        """``since=None`` is the legacy full-token view. With a cursor,
+        only tokens past it ship — plus ``since`` (the EFFECTIVE cursor,
+        clamped to the current length: a preemption that reset the token
+        list replays from the clamp point, so the caller reconciles by
+        truncating to ``since`` before appending) and ``n_tokens`` (the
+        authoritative total)."""
+        tokens = [int(t) for t in req.tokens]
+        out = {"id": req.id, "status": req.status, "error": req.error}
+        if since is None:
+            out["tokens"] = tokens
+        else:
+            eff = min(max(0, int(since)), len(tokens))
+            out["tokens"] = tokens[eff:]
+            out["since"] = eff
+            out["n_tokens"] = len(tokens)
+        return out
 
     def _submit(self, body: dict) -> dict:
         prompt = body.get("prompt") or []
@@ -323,12 +344,12 @@ class DoorServer:
                 self._requests.popitem(last=False)
             return self._req_view(req)
 
-    def _status(self, rid: str) -> dict:
+    def _status(self, rid: str, since: Optional[int] = None) -> dict:
         with self._lock:
             req = self._requests.get(str(rid))
             if req is None:
                 return {"error_code": "unknown_request", "id": rid}
-            return self._req_view(req)
+            return self._req_view(req, since=since)
 
     def start(self):
         self._thread.start()

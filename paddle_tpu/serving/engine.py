@@ -80,9 +80,10 @@ from ..core.tensor import Tensor
 from ..distributed.env import get_mesh
 from ..models.gpt import (_lm_head_logits, _pick_token,
                           _resolve_decode_horizon, set_paged_kv_sharding)
+from ..distributed.reshard import snapshot as _snapshot
 from .guardrails import (HANG_ENV, DispatchWatchdog, EngineHangError,
                          FaultSchedule, InjectedFault)
-from .pager import TRASH_BLOCK, BlockPager
+from .pager import TRASH_BLOCK, BlockPager, prefix_digest
 from .scheduler import (TERMINAL_STATUSES, AdmissionQueue, Request,
                         SlotAllocator)
 
@@ -234,6 +235,13 @@ class DecodeEngine:
       fault_schedule   a guardrails.FaultSchedule, or None to read the
                        PADDLE_SERVE_FAULT env (the chaos seam; production
                        never sets either)
+      kv_pool          a ``serving.kvpool`` pool (LocalPool or KVPool over
+                       the launch KV master) — the cross-process prefix-
+                       cache tier: parked registered blocks export to it
+                       and registry-miss admissions fetch + adopt from it
+                       (``kvpool.resolve_kv_pool()`` picks by env). None
+                       (the default) disables the tier entirely; requires
+                       paged=True.
 
     ``submit()`` validates and queues; ``step()`` runs ONE scheduler
     iteration (admit into free slots, advance pending prefill chunks, then
@@ -270,7 +278,7 @@ class DecodeEngine:
                  temperature: float = 1.0, top_k: int = 0, seed: int = 0,
                  hang_s: Optional[float] = None,
                  fault_schedule: Optional[FaultSchedule] = None,
-                 drafter=None):
+                 drafter=None, kv_pool=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_len < 2:
@@ -443,6 +451,27 @@ class DecodeEngine:
                  jnp.zeros((self.max_slots, self.max_len, spec.n_kv_heads,
                             spec.head_dim), self._cache_dtype))
                 for _ in range(spec.num_layers)]
+        # ---- cross-process prefix-cache tier (serving/kvpool.py): parked
+        # registered blocks export to the pool, registry-miss admissions
+        # fetch + adopt. All host state; zero effect when kv_pool is None.
+        if kv_pool is not None and not self.paged:
+            raise ValueError("kv_pool requires paged=True (the pool moves "
+                             "page-table blocks)")
+        self._kv_pool = kv_pool
+        self._pool_gen = 0
+        self._exported: set = set()     # digests already in the pool (gen)
+        self._adopt_exe = None
+        self.pool_exports = 0
+        self.pool_export_errors = 0
+        self.pool_fetches = 0
+        self.pool_fetch_hits = 0
+        self.pool_fetch_misses = 0
+        self.pool_fetch_s = 0.0
+        self.pool_adopted_blocks = 0
+        self.pool_adopted_tokens = 0
+        if self._kv_pool is not None:
+            self._pager.export_enabled = True
+            self._pool_gen = int(self._kv_pool.generation())
         if prefill_buckets is None:
             buckets, b = [], 8
             while b < self.max_len:
@@ -783,6 +812,39 @@ class DecodeEngine:
         self._minted("verify", vw, time.time() - t0, exe=exe, tokens=vw)
         return exe
 
+    def _pool_geom(self) -> list:
+        """KV geometry fingerprint carried in every pool entry's meta: a
+        fetched block only adopts when the exporter's geometry matches
+        ours exactly (a mismatch is a MISS — heterogeneous engines sharing
+        a pool degrade to per-process caching, they never corrupt)."""
+        return [int(self.spec.num_layers), int(self.block_size),
+                int(self.spec.n_kv_heads), int(self.spec.head_dim)]
+
+    def _build_adopt(self):
+        """Pool-block splice: write one physical block row of EVERY
+        layer's K/V pool from host data. The row index and the bytes are
+        arguments — data, not shape — so the executable mints ONCE and
+        adoption never recompiles; pools are donated and pinned back to
+        their input sharding exactly like the decode step's."""
+        L = self.spec.num_layers
+
+        def fn(idx, pools, kd, vd):
+            return [(pk.at[idx].set(kd[l].astype(pk.dtype)),
+                     pv.at[idx].set(vd[l].astype(pv.dtype)))
+                    for l, (pk, pv) in enumerate(pools)]
+
+        zero = self._dev(jnp.zeros(
+            (L, self.block_size, self.spec.n_kv_heads, self.spec.head_dim),
+            self._cache_dtype))
+        args = (self._dev(jnp.int32(TRASH_BLOCK)), self._pools, zero, zero)
+        out_sh = None if self._mesh is None else \
+            [(self._pool_sh, self._pool_sh) for _ in range(L)]
+        t0 = time.time()
+        exe = self._compile_in_eval(fn, args, out_shardings=out_sh)
+        self._adopt_exe = exe
+        self._minted("adopt", None, time.time() - t0, exe=exe)
+        return exe
+
     def _build_prefill(self, sb: int):
         spec = self.spec
 
@@ -996,6 +1058,15 @@ class DecodeEngine:
                     self._advance_prefill(slot, finished)
         if self._live.any():
             self._decode(finished)
+        if self._kv_pool is not None:
+            # serialize freshly parked registered blocks OUT to the pool at
+            # the end of the iteration — never inside the admission/decode
+            # hot path — bounded per step so exports cannot stall decode
+            self._drain_pool_exports()
+            mon3 = _monitor._active
+            if mon3 is not None:
+                mon3.serve_pool(self.pool_stats(),
+                                engine_id=self.engine_id)
         if self._draining and self.drained and not self._drain_reported:
             self._drain_reported = True
             self.drains += 1
@@ -1402,6 +1473,131 @@ class DecodeEngine:
             src[i], dst[i] = s, d
         return self._dev(src), self._dev(dst)
 
+    def _pool_fetch_adopt(self, req: Request, slot: int,
+                          cov: int) -> Optional[dict]:
+        """The registry-miss fallthrough of admission: fetch consecutive
+        full-block prefixes of ``req`` from the cross-process pool and
+        splice them into ``slot``'s table past ``cov`` (a block boundary).
+        Returns {"cov", "blocks", "tokens", "fetch_s"} on any adoption,
+        None otherwise. Every failure mode — pool miss, stale generation,
+        geometry mismatch, torn payload, injected fetch/adopt fault,
+        allocation pressure — just STOPS the walk: whatever was spliced
+        stands and the caller prefills the remainder (the partial-fetch
+        fallback). Never raises."""
+        bs = self.block_size
+        toks = tuple(int(t) for t in req.prompt)
+        n = len(toks)
+        k = cov // bs + 1
+        if k * bs >= n or k > self._mbs:
+            return None
+        t0 = time.perf_counter()
+        geom = self._pool_geom()
+        fetched = []
+        while k * bs < n and k <= self._mbs:
+            key = toks[:k * bs]
+            if key in self._pager._registry:
+                break          # a local copy exists: share_prefix's tier
+            self.pool_fetches += 1
+            if self._faults is not None:
+                try:
+                    self._faults.fire("fetch")
+                except InjectedFault:
+                    self.pool_fetch_misses += 1
+                    break
+            ent = self._kv_pool.get(prefix_digest(key))
+            if ent is None:
+                self.pool_fetch_misses += 1
+                break
+            payload, meta = ent
+            try:
+                if int(meta.get("gen", -1)) != self._pool_gen \
+                        or [int(g) for g in (meta.get("geom") or [])] != geom \
+                        or int(meta.get("tokens", -1)) != k * bs:
+                    raise ValueError("generation/geometry mismatch")
+                arr = _snapshot.decode_block(payload, meta)
+                arr = arr.reshape([geom[0], 2] + geom[1:])
+            except (ValueError, KeyError, TypeError):
+                self.pool_fetch_misses += 1
+                break
+            self.pool_fetch_hits += 1
+            fetched.append((key, arr))
+            k += 1
+        if not fetched:
+            return None
+        # splice (fires the "adopt" fault site; best-effort prefix)
+        blocks = self._pager.adopt_blocks(slot, cov,
+                                          [key for key, _ in fetched])
+        if not blocks:
+            return None
+        exe = self._adopt_exe
+        if exe is None:
+            exe = self._build_adopt()
+        for blk, (_, arr) in zip(blocks, fetched):
+            self._pools = exe(self._dev(jnp.int32(blk)), self._pools,
+                              self._dev(np.ascontiguousarray(arr[:, 0])),
+                              self._dev(np.ascontiguousarray(arr[:, 1])))
+        for key, _ in fetched[:len(blocks)]:
+            # the pool already holds these bytes: never re-export them
+            self._exported.add(prefix_digest(key))
+        dt = time.perf_counter() - t0
+        nb = len(blocks)
+        self.pool_adopted_blocks += nb
+        self.pool_adopted_tokens += nb * bs
+        self.pool_fetch_s += dt
+        return {"cov": cov + nb * bs, "blocks": nb, "tokens": nb * bs,
+                "fetch_s": dt}
+
+    def _drain_pool_exports(self, budget: int = 4):
+        """End-of-step export drain: serialize up to ``budget`` freshly
+        parked registered blocks into the pool (device rows -> host ->
+        ``snapshot.encode_block`` -> put). Partial-tail keys never export
+        (an adopter COWs the tail anyway — only whole blocks are worth
+        moving); already-exported digests skip. An injected "export"
+        fault (or a pool/master error) skips that block, counted — the
+        pool is a cache tier, losing an export costs a future re-prefill,
+        nothing else."""
+        pager = self._pager
+        pool = self._kv_pool
+        bs = self.block_size
+        while pager.pending_exports and budget > 0:
+            blk, key = pager.pending_exports.popitem(last=False)
+            if len(key) % bs != 0:
+                continue
+            dig = prefix_digest(key)
+            if dig in self._exported:
+                continue
+            budget -= 1
+            if self._faults is not None:
+                try:
+                    self._faults.fire("export")
+                except InjectedFault:
+                    self.pool_export_errors += 1
+                    continue
+            rows = np.stack([
+                np.stack([np.asarray(jax.device_get(pk[blk])),
+                          np.asarray(jax.device_get(pv[blk]))])
+                for pk, pv in self._pools])       # [L, 2, bs, n_kv, hd]
+            payload, meta = _snapshot.encode_block(rows)
+            meta.update(gen=self._pool_gen, tokens=len(key),
+                        geom=self._pool_geom())
+            if pool.put(dig, payload, meta):
+                self._exported.add(dig)
+                self.pool_exports += 1
+            else:
+                self.pool_export_errors += 1
+
+    def drop_prefix_cache(self) -> int:
+        """Operator hook for a weight swap / tokenizer change: flush the
+        pager's parked prefix blocks AND bump the pool generation, so
+        neither the local LRU nor the cross-process tier can serve K/V
+        computed under the old weights. Returns the number of local
+        blocks released."""
+        n = self._pager.drop_prefix_cache() if self.paged else 0
+        if self._kv_pool is not None:
+            self._pool_gen = int(self._kv_pool.bump_generation())
+            self._exported.clear()
+        return n
+
     def _try_admit_paged(self, req: Request) -> bool:
         """Assign a slot, adopt any shared prompt prefix, and reserve the
         first chunk's blocks. False = the pool cannot host the first chunk
@@ -1418,6 +1614,15 @@ class DecodeEngine:
         # hits/admissions figure read these as per-ADMISSION counts)
         ctrs = self._pager.sharing_counters()
         cov = self._pager.share_prefix(slot, req.prompt)
+        pool_meta = None
+        if self._kv_pool is not None and cov % self.block_size == 0:
+            # registry miss past cov: fall through to the cross-process
+            # pool. Adoption raises cov, so the needed/free accounting
+            # below already counts pool-adopted blocks as coverage — the
+            # PR 12 parked-block rule extended one tier down.
+            pool_meta = self._pool_fetch_adopt(req, slot, cov)
+            if pool_meta is not None:
+                cov = pool_meta["cov"]
         end = min(cov + self._chunk_len(n), n)
         copies = self._pager.ensure_writable(slot, cov, end)
         if copies is None:
@@ -1434,10 +1639,13 @@ class DecodeEngine:
                 mon.serve_page_reject(
                     free, needed,
                     trace_id=req._trace.trace_id
-                    if req._trace is not None else None)
+                    if req._trace is not None else None,
+                    pool_blocks=pool_meta["blocks"] if pool_meta else 0)
             if req._trace is not None:
                 req._trace.event("page_reject", free=int(free),
-                                 needed=int(needed))
+                                 needed=int(needed),
+                                 pool_blocks=pool_meta["blocks"]
+                                 if pool_meta else 0)
                 if free >= needed:
                     # refusal WITHOUT real pressure is the allocator-bug
                     # signature — this trace must survive head sampling
@@ -1460,6 +1668,16 @@ class DecodeEngine:
                 # admission's prefill compute shrank by lru_hit_tokens
                 ph.set(lru_hit_blocks=self._pager.last_adopt_parked,
                        lru_hit_tokens=self._pager.last_adopt_parked_tokens)
+            if pool_meta is not None:
+                # TTFT attribution: the pool fetch is ITS OWN slice of the
+                # prefill phase, so a TTFT regression decomposes into
+                # fetch-bytes time vs prefill-compute time downstream
+                ph.set(pool_hit_blocks=int(pool_meta["blocks"]),
+                       pool_hit_tokens=int(pool_meta["tokens"]),
+                       pool_fetch_s=round(pool_meta["fetch_s"], 6))
+                ph.event("pool_fetch", blocks=int(pool_meta["blocks"]),
+                         tokens=int(pool_meta["tokens"]),
+                         dur_s=round(pool_meta["fetch_s"], 6))
             if copies:
                 ph.event("cow", n=len(copies))
         return True
@@ -1944,7 +2162,34 @@ class DecodeEngine:
                                      + self._pager.lru_blocks)
             out["prefix_hits"] = int(self._pager.prefix_hits)
             out["prefix_keys"] = self._pager.prefix_digests(top_prefixes)
+        # pool tier: generation + hit count travel in the door blob, so
+        # the router can prefer warm-pool hosts and spot a generation skew
+        out["pool_gen"] = int(self._pool_gen) \
+            if self._kv_pool is not None else None
+        out["pool_hits"] = int(self._pager.pool_hits) \
+            if self.paged and self._kv_pool is not None else 0
         return out
+
+    def pool_stats(self) -> dict:
+        """Cumulative cross-process pool figures (engine side): transfer
+        counters plus the pager's splice counters — the ``pool/*`` gauges
+        and the bench ``--pool`` lane read this."""
+        return {
+            "gen": int(self._pool_gen),
+            "exports": self.pool_exports,
+            "export_errors": self.pool_export_errors,
+            "fetches": self.pool_fetches,
+            "fetch_hits": self.pool_fetch_hits,
+            "fetch_misses": self.pool_fetch_misses,
+            "fetch_s": round(self.pool_fetch_s, 6),
+            "adopted_blocks": self.pool_adopted_blocks,
+            "adopted_tokens": self.pool_adopted_tokens,
+            "pool_hits": int(self._pager.pool_hits) if self.paged else 0,
+            "pool_hit_tokens": int(self._pager.pool_hit_tokens)
+            if self.paged else 0,
+            "pending_exports": len(self._pager.pending_exports)
+            if self.paged else 0,
+        }
 
     def stats(self) -> dict:
         out = {
@@ -1971,6 +2216,8 @@ class DecodeEngine:
                                 block_size=self.block_size,
                                 preemptions=self.preemptions,
                                 prefilling=len(self._prefilling))
+        if self._kv_pool is not None:
+            out["pool"] = self.pool_stats()
         if self.drafter is not None:
             out["spec"] = {
                 "drafter": self.drafter.name,

@@ -7,7 +7,7 @@ that make that possible:
 
 * **`FaultSchedule`** — the ``PADDLE_SERVE_FAULT`` chaos seam, the serving
   mirror of ``PADDLE_CKPT_FAULT`` (distributed/checkpoint.py): a scripted
-  schedule of faults fired at exact call counts of the engine's six
+  schedule of faults fired at exact call counts of the engine's
   interesting sites, so a test (or ``bench.py decode --chaos``) can drive
   expiry, cancellation, preemption, hang detection and drain through the
   very same code paths production traffic would, with zero randomness.
@@ -25,6 +25,9 @@ that make that possible:
   | alloc        | Nth BlockPager block alloc      | deterministic exhaustion   |
   | verify       | Nth speculative verify dispatch | InjectedFault out of step()|
   | spec_reserve | Nth speculative reservation     | reservation yields nothing |
+  | export       | Nth KV-pool block export        | that block is not exported |
+  | fetch        | Nth KV-pool block fetch         | fetch misses; plain prefill|
+  | adopt        | Nth pool-block table splice     | splice skipped; prefill    |
 
   ``slow`` sleeps ``<arg>`` seconds (default 0.05) at the site — inside
   the watchdog's armed window for decode/chunk/verify, which is how the
@@ -35,8 +38,12 @@ that make that possible:
   injection. Likewise at ``spec_reserve`` an injected ``raise`` makes the
   reservation come back empty: the engine degrades to a plain one-token
   verify for that step — speculation is an optimization, so its chaos
-  failure mode is graceful, never an error. Counts are per-schedule
-  (per-engine), 1-based.
+  failure mode is graceful, never an error. The KV-pool sites follow the
+  same rule: an injected ``raise`` at ``export`` skips that block's
+  upload, at ``fetch`` reads as a pool miss, and at ``adopt`` abandons
+  the splice — all three degrade to plain prefill (the pool is a cache
+  tier, so its chaos failure mode is always the cold path). Counts are
+  per-schedule (per-engine), 1-based.
 
 * **`DispatchWatchdog`** — a monitor-side thread that detects a decode or
   chunk dispatch exceeding ``PADDLE_SERVE_HANG_S`` (default off — CPU XLA
@@ -65,7 +72,7 @@ FAULT_ENV = "PADDLE_SERVE_FAULT"
 HANG_ENV = "PADDLE_SERVE_HANG_S"
 
 FAULT_SITES = ("decode", "chunk", "admit", "alloc", "verify",
-               "spec_reserve")
+               "spec_reserve", "export", "fetch", "adopt")
 _ACTIONS = ("raise", "slow")
 _DEFAULT_SLOW_S = 0.05
 

@@ -34,6 +34,15 @@ Mechanics:
   parks. Cumulative ``prefix_hits``/``prefix_hit_tokens`` count cross-
   request adoptions (distinct from ``shared_hits``, which also counts
   co-resident sharing of live blocks).
+* **cross-process pool (adopt/export)** — the prefix cache's host-RAM
+  tier (``serving/kvpool.py``). When a registered block parks and
+  ``export_enabled`` is set, it queues in ``pending_exports`` for the
+  engine to serialize out; a block that leaves the parked state (revival,
+  LRU reclaim, cache drop) un-queues — only bytes that stay parked are
+  safe to read at the engine's export drain. On the adopt side,
+  ``adopt_blocks`` splices pool-fetched blocks into a slot's table as
+  freshly allocated, REGISTERED blocks: the prefix-registry key travels
+  with the bytes, so the next same-prefix admission hits locally.
 * **copy-on-write** — writes only ever land at a slot's cursor, so shared
   FULL blocks are naturally read-only; the one writable shared case is the
   partial tail block (or a fully-shared final block under the n-1 cap).
@@ -78,7 +87,8 @@ class PagerStats:
     __slots__ = ("blocks_total", "blocks_free", "blocks_used",
                  "blocks_shared", "block_refs", "cow_copies", "shared_hits",
                  "shared_tokens", "lru_blocks", "prefix_hits",
-                 "prefix_hit_tokens", "prefix_repeats")
+                 "prefix_hit_tokens", "prefix_repeats", "pool_hits",
+                 "pool_hit_tokens")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -128,6 +138,15 @@ class BlockPager:
         # per-admission scratch the engine reads right after share_prefix
         self.last_adopt_parked = 0
         self.last_adopt_parked_tokens = 0
+        self.last_adopt_pool = 0
+        self.last_adopt_pool_tokens = 0
+        # cross-process pool export queue: parked block -> registry key,
+        # FIFO. Populated by _decref's park branch when the engine enables
+        # exports; any transition OUT of the parked state un-queues the
+        # block (its device rows are about to be rewritten or are now
+        # tenant-owned — only stably parked bytes are safe to serialize).
+        self.export_enabled = False
+        self.pending_exports: "OrderedDict[int, tuple]" = OrderedDict()
         # PADDLE_SERVE_FAULT chaos seam (serving/guardrails.py): the engine
         # installs its FaultSchedule here; an injected "raise" at the alloc
         # site manifests as deterministic pool exhaustion (the failure the
@@ -141,6 +160,8 @@ class BlockPager:
         self.prefix_hit_tokens = 0    # prompt tokens revived from the LRU
         self.prefix_repeats = 0       # admissions whose first-block key repeated
         self.lru_reclaims = 0         # parked blocks cannibalized on exhaustion
+        self.pool_hits = 0            # admissions that spliced >= 1 pool block
+        self.pool_hit_tokens = 0      # prompt tokens served from pool blocks
 
     # ------------------------------------------------------------ accounting
 
@@ -189,7 +210,9 @@ class BlockPager:
             shared_tokens=self.shared_tokens, lru_blocks=self.lru_blocks,
             prefix_hits=self.prefix_hits,
             prefix_hit_tokens=self.prefix_hit_tokens,
-            prefix_repeats=self.prefix_repeats)
+            prefix_repeats=self.prefix_repeats,
+            pool_hits=self.pool_hits,
+            pool_hit_tokens=self.pool_hit_tokens)
 
     def sharing_counters(self) -> tuple:
         """Snapshot of the per-admission sharing/prefix counters — the
@@ -199,11 +222,13 @@ class BlockPager:
         recency touch of a refused adoption is NOT rolled back: a prefix
         a waiting request keeps reaching for is hot by definition.)"""
         return (self.shared_hits, self.shared_tokens, self.prefix_hits,
-                self.prefix_hit_tokens, self.prefix_repeats)
+                self.prefix_hit_tokens, self.prefix_repeats,
+                self.pool_hits, self.pool_hit_tokens)
 
     def restore_sharing_counters(self, snap: tuple):
         (self.shared_hits, self.shared_tokens, self.prefix_hits,
-         self.prefix_hit_tokens, self.prefix_repeats) = snap
+         self.prefix_hit_tokens, self.prefix_repeats,
+         self.pool_hits, self.pool_hit_tokens) = snap
 
     def check_invariants(self):
         """Assert the three-state partition and refcount/registry health
@@ -233,6 +258,10 @@ class BlockPager:
         for key, b in self._registry.items():
             assert self._block_key.get(b) == key
         assert TRASH_BLOCK not in self._block_key
+        # export queue holds only stably parked blocks, under their keys
+        for b, key in self.pending_exports.items():
+            assert b in parked and self._lru.get(b) == key, \
+                f"pending export {b} not parked (or key torn)"
 
     # ------------------------------------------------------------ allocation
 
@@ -250,6 +279,7 @@ class BlockPager:
             # block — reclamation always beats preempting a live tenant
             blk, key = self._lru.popitem(last=False)
             self._unregister(blk)
+            self.pending_exports.pop(blk, None)
             self.lru_reclaims += 1
         else:
             return None
@@ -273,6 +303,9 @@ class BlockPager:
                 # reclamation longest)
                 self._lru[blk] = key
                 self._lru.move_to_end(blk)
+                if self.export_enabled:
+                    self.pending_exports[blk] = key
+                    self.pending_exports.move_to_end(blk)
             else:
                 self._unregister(blk)
                 self._free.append(blk)
@@ -318,6 +351,7 @@ class BlockPager:
                     if old is not None:
                         if self._ref[old] == 0:     # parked mid-call: revive
                             self._lru.pop(old, None)
+                            self.pending_exports.pop(old, None)
                         self._ref[old] += 1
                         self.tables[slot, l2] = old
                     else:
@@ -407,6 +441,7 @@ class BlockPager:
             if old is not None:
                 if self._ref[old] == 0:      # parked mid-flight: revive
                     self._lru.pop(old, None)
+                    self.pending_exports.pop(old, None)
                 self._ref[old] += 1
                 self.tables[slot, lidx] = old
             else:
@@ -450,10 +485,13 @@ class BlockPager:
         cov = min(cov, n - 1)
         self.last_adopt_parked = 0
         self.last_adopt_parked_tokens = 0
+        self.last_adopt_pool = 0
+        self.last_adopt_pool_tokens = 0
         prev_cov = 0
         for lidx, (blk, cov_after) in enumerate(chain):
             if self._ref[blk] == 0:       # parked: revive from the LRU
                 self._lru.pop(blk, None)
+                self.pending_exports.pop(blk, None)
                 self.last_adopt_parked += 1
                 self.last_adopt_parked_tokens += \
                     min(cov_after, cov) - prev_cov
@@ -467,6 +505,64 @@ class BlockPager:
             self.prefix_hits += 1
             self.prefix_hit_tokens += self.last_adopt_parked_tokens
         return cov
+
+    def adopt_blocks(self, slot: int, start_pos: int,
+                     keys: Sequence[tuple]) -> List[int]:
+        """Splice pool-fetched blocks into ``slot``'s table: one freshly
+        allocated block per key, entered into the prefix registry under
+        that key — the registry entry transfers with the bytes, so the
+        NEXT same-prefix admission adopts locally via ``share_prefix``.
+
+        ``keys`` must be consecutive FULL-block prefix keys extending the
+        slot's coverage from ``start_pos`` (a block boundary):
+        ``len(keys[j]) == start_pos + (j+1) * block_size``. Returns the
+        physical block ids in key order — the caller MUST fill their
+        device rows (data-not-shape ``device_put``) before any dispatch
+        reads them. Best-effort prefix semantics: the walk stops at the
+        first key the pool cannot place (allocation failure, key already
+        registered locally, or an injected ``adopt`` fault, which splices
+        nothing) and whatever was spliced stands — the caller prefills
+        the remainder (the partial-fetch fallback). Refcounts, the LRU
+        and ``check_invariants`` hold at every exit."""
+        if self.fault_schedule is not None:
+            from .guardrails import InjectedFault
+            try:
+                self.fault_schedule.fire("adopt")
+            except InjectedFault:
+                return []
+        bs = self.block_size
+        assert start_pos % bs == 0, "adopt must start on a block boundary"
+        blocks: List[int] = []
+        for j, key in enumerate(keys):
+            key = tuple(int(t) for t in key)
+            assert len(key) == start_pos + (j + 1) * bs, \
+                "adopt keys must be consecutive full-block prefixes"
+            if key in self._registry:
+                break        # a local copy exists: share_prefix's job
+            blk = self._alloc_block()
+            if blk is None:
+                break        # pool pressure: prefill the rest instead
+            lidx = start_pos // bs + j
+            assert int(self.tables[slot, lidx]) == TRASH_BLOCK, \
+                "adopt target already mapped"
+            self.tables[slot, lidx] = blk
+            self._registry[key] = blk
+            self._block_key[blk] = key
+            blocks.append(blk)
+        if blocks:
+            ntok = len(blocks) * bs
+            self.last_adopt_pool = len(blocks)
+            self.last_adopt_pool_tokens = ntok
+            self.pool_hits += 1
+            self.pool_hit_tokens += ntok
+            # a pool splice IS a cross-request prefix adoption — it counts
+            # in the same ledgers the LRU revival path feeds, so hit-rate
+            # telemetry does not depend on WHICH tier served the bytes
+            self.shared_hits += 1
+            self.shared_tokens += ntok
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += ntok
+        return blocks
 
     def register_prompt(self, slot: int, tokens: Sequence[int]):
         """Publish ``slot``'s freshly prefilled prompt blocks for future
@@ -509,8 +605,12 @@ class BlockPager:
     def drop_prefix_cache(self) -> int:
         """Flush every parked block back to the free list (operator hook:
         weight swap / tokenizer change invalidates cached K/V). Returns how
-        many blocks were released."""
+        many blocks were released. Pending pool exports die with the cache
+        (their bytes are invalid for the new weights); the ENGINE wrapper
+        additionally bumps the pool generation so already-exported entries
+        can never splice back in."""
         n = len(self._lru)
+        self.pending_exports.clear()
         while self._lru:
             blk, _ = self._lru.popitem(last=False)
             self._unregister(blk)

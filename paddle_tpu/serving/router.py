@@ -95,9 +95,19 @@ class LocalEngineClient:
             raise EngineDown("engine is dead (chaos kill)")
 
     @staticmethod
-    def _view(req) -> dict:
-        return {"id": str(req.id), "status": req.status, "error": req.error,
-                "tokens": [int(t) for t in req.tokens]}
+    def _view(req, since: Optional[int] = None) -> dict:
+        out = {"id": str(req.id), "status": req.status, "error": req.error}
+        tokens = [int(t) for t in req.tokens]
+        if since is None:
+            out["tokens"] = tokens
+        else:
+            # incremental form (endpoint.DoorServer._req_view contract):
+            # only tokens past the clamped cursor ship
+            eff = min(max(0, int(since)), len(tokens))
+            out["tokens"] = tokens[eff:]
+            out["since"] = eff
+            out["n_tokens"] = len(tokens)
+        return out
 
     def submit(self, prompt, max_new_tokens: int, eos_token_id,
                request_id: str) -> dict:
@@ -108,10 +118,11 @@ class LocalEngineClient:
         self._requests[str(req.id)] = req
         return self._view(req)
 
-    def status(self, request_id: str) -> Optional[dict]:
+    def status(self, request_id: str,
+               since: Optional[int] = None) -> Optional[dict]:
         self._check()
         req = self._requests.get(str(request_id))
-        return None if req is None else self._view(req)
+        return None if req is None else self._view(req, since=since)
 
     def door(self) -> dict:
         self._check()
@@ -159,10 +170,13 @@ class HTTPEngineClient:
             "max_new_tokens": int(max_new_tokens),
             "eos_token_id": eos_token_id, "request_id": request_id})
 
-    def status(self, request_id: str) -> Optional[dict]:
+    def status(self, request_id: str,
+               since: Optional[int] = None) -> Optional[dict]:
+        path = "/status?id=" + urllib.parse.quote(str(request_id))
+        if since is not None:
+            path += f"&since={int(since)}"
         try:
-            return self._call(
-                "/status?id=" + urllib.parse.quote(str(request_id)))
+            return self._call(path)
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
@@ -189,7 +203,8 @@ class RouteTicket:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_token_id", "engine",
                  "status", "error", "tokens", "attempts", "requeues",
-                 "t_submit", "t_done", "_trace", "_avoid", "_requeue_why")
+                 "t_submit", "t_done", "_trace", "_avoid", "_requeue_why",
+                 "_q_deadline")
 
     def __init__(self, request_id: str, prompt, max_new_tokens: int,
                  eos_token_id):
@@ -208,6 +223,7 @@ class RouteTicket:
         self._trace = None
         self._avoid: Set[str] = set()
         self._requeue_why: Optional[str] = None
+        self._q_deadline: Optional[float] = None
 
     @property
     def finished(self) -> bool:
@@ -237,6 +253,16 @@ class Router:
     * ``requeue_limit`` — how many times one ticket may move before the
       router gives up and fails it (a poisoned request must not orbit
       the fleet forever).
+    * ``max_queue`` — bounded router-side admission queue. When every
+      LIVE door is at capacity (overload bounces / all avoided) the
+      request parks here instead of rejecting; ``poll()`` re-dispatches
+      queued tickets as capacity frees. 0 (default) keeps the legacy
+      immediate-reject behavior; queue overflow still rejects, and a
+      genuinely empty/stale fleet rejects immediately (waiting cannot
+      help a fleet that is gone).
+    * ``queue_deadline_s`` — per-ticket budget in the router queue; a
+      ticket still unplaced past it terminalizes as ``expired``, the
+      same status an engine-side deadline produces.
     """
 
     def __init__(self, directory, retry: Optional[RetryPolicy] = None,
@@ -244,7 +270,8 @@ class Router:
                  stale_after: Optional[float] = None, eject_after: int = 2,
                  requeue_limit: int = 3, clock=time.time,
                  fault_schedule: Optional[RouteFaultSchedule] = None,
-                 name: str = "router"):
+                 name: str = "router", max_queue: int = 0,
+                 queue_deadline_s: Optional[float] = 5.0):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(f"policy must be affinity|round_robin, "
                              f"got {policy!r}")
@@ -256,6 +283,9 @@ class Router:
         self.stale_after = stale_after
         self.eject_after = int(eject_after)
         self.requeue_limit = int(requeue_limit)
+        self.max_queue = int(max_queue)
+        self.queue_deadline_s = queue_deadline_s
+        self._queue: List[str] = []
         self._clock = clock
         self._faults = fault_schedule if fault_schedule is not None \
             else RouteFaultSchedule.from_env()
@@ -274,7 +304,8 @@ class Router:
         self._mint = itertools.count(1)
         self._mint_salt = secrets.token_hex(3)
         self.counters = {"routed": 0, "affinity_hits": 0, "spills": 0,
-                         "requeues": 0, "ejections": 0, "rejected": 0}
+                         "requeues": 0, "ejections": 0, "rejected": 0,
+                         "queued": 0, "queue_expired": 0}
 
     # ------------------------------------------------------------ discovery
 
@@ -401,9 +432,14 @@ class Router:
 
         def load(c):
             door = c[2]
+            # warm-pool tiebreak: among equally loaded doors, prefer the
+            # one whose cross-process pool tier has already served hits —
+            # its host cache is warm, so a spilled prompt still has a
+            # chance of adopting blocks instead of cold-prefilling
             return (int(door.get("queue_depth", 0))
                     + int(door.get("active", 0)),
-                    -int(door.get("free_slots", 0)), c[0])
+                    -int(door.get("free_slots", 0)),
+                    -int(door.get("pool_hits") or 0), c[0])
 
         name, client, _ = min(pool, key=load)
         return name, client, bool(aff)
@@ -436,6 +472,8 @@ class Router:
         try:
             self._retry(self._dispatch_once, ticket)
         except NoEngineAvailable as e:
+            if self._try_queue(ticket):
+                return
             ticket.status, ticket.error = "rejected", str(e)
             self.counters["rejected"] += 1
             mon = _monitor._active
@@ -443,9 +481,73 @@ class Router:
                 mon.route_reject(str(e))
             self._finish_ticket(ticket)
         except Exception as e:
+            if isinstance(e, EngineDown) and ticket._requeue_why in (
+                    "overload_bounce", "drain_bounce") \
+                    and self._try_queue(ticket):
+                return             # saturation, not sickness: wait it out
             ticket.status = "failed"
             ticket.error = f"dispatch failed after retries: {e}"
             self._finish_ticket(ticket)
+
+    # ------------------------------------------------------ admission queue
+
+    def _has_live_doors(self) -> bool:
+        """A fresh, non-ejected, accepting door exists SOMEWHERE — the
+        distinction between capacity exhaustion (queueing can help: a
+        slot frees, a bounce clears) and a fleet that is gone (queueing
+        is a hang with extra steps)."""
+        for name, rec in self._seen.items():
+            if name in self._ejected or not self._fresh(rec):
+                continue
+            if (rec["blob"].get("door") or {}).get("state") == "accepting":
+                return True
+        return False
+
+    def _try_queue(self, ticket: RouteTicket) -> bool:
+        """Park an unplaceable ticket in the bounded router queue.
+        Returns False — caller proceeds to reject/fail — when queueing is
+        off, the fleet is gone, or the queue is full (overflow rejects:
+        the bound IS the backpressure)."""
+        if self.max_queue <= 0 or not self._has_live_doors():
+            return False
+        requeue = ticket.status == "queued_router"
+        if not requeue and len(self._queue) >= self.max_queue:
+            return False
+        if not requeue:
+            ticket._q_deadline = (
+                self._clock() + self.queue_deadline_s
+                if self.queue_deadline_s is not None else None)
+            self.counters["queued"] += 1
+            mon = _monitor._active
+            if mon is not None:
+                mon.route_queued(len(self._queue) + 1)
+        ticket.status = "queued_router"
+        ticket.engine = None
+        ticket.error = None
+        ticket._avoid = set()      # fresh episode once capacity frees
+        self._queue.append(ticket.id)
+        return True
+
+    def _service_queue(self):
+        """Re-dispatch router-queued tickets in FIFO order: expired ones
+        terminalize, the rest try placement again (and re-park, keeping
+        their original deadline, if the fleet is still saturated)."""
+        if not self._queue:
+            return
+        waiting, self._queue = self._queue, []
+        for tid in waiting:
+            ticket = self._tickets.get(tid)
+            if ticket is None or ticket.finished:
+                continue
+            if ticket._q_deadline is not None \
+                    and self._clock() > ticket._q_deadline:
+                ticket.status = "expired"
+                ticket.error = (f"router queue deadline "
+                                f"({self.queue_deadline_s}s) exceeded")
+                self.counters["queue_expired"] += 1
+                self._finish_ticket(ticket)
+                continue
+            self._dispatch(ticket)
 
     def _dispatch_once(self, ticket: RouteTicket):
         ticket.attempts += 1
@@ -541,9 +643,11 @@ class Router:
     def poll(self) -> List[RouteTicket]:
         """One health + progress pass over live tickets: refresh the
         fleet view, eject stale/dead engines, requeue their tickets (and
-        drain-flushed / engine-failed ones) elsewhere, and return every
-        ticket that reached a terminal state during this pass."""
+        drain-flushed / engine-failed ones) elsewhere, re-dispatch
+        router-queued tickets, and return every ticket that reached a
+        terminal state during this pass."""
         self.refresh()
+        self._service_queue()
         finished: List[RouteTicket] = []
         for ticket in [t for t in self._tickets.values() if not t.finished]:
             name = ticket.engine
@@ -568,7 +672,14 @@ class Router:
                 if self._faults is not None \
                         and self._faults.fire("status") == "kill":
                     self._chaos_kill(name)
-                st = client.status(ticket.id)
+                try:
+                    # incremental streaming: only tokens past our cursor
+                    # cross the wire (clients without the ``since`` param
+                    # — older doors, test stubs — get the full-view call)
+                    st = client.status(ticket.id,
+                                       since=len(ticket.tokens))
+                except TypeError:
+                    st = client.status(ticket.id)
             except OSError as e:
                 if not isinstance(e, InjectedRouteFault):
                     self._note_failure(name, f"status: {e}")
@@ -588,7 +699,15 @@ class Router:
                 continue
             ticket.status = st.get("status") or ticket.status
             ticket.error = st.get("error")
-            ticket.tokens = list(st.get("tokens") or [])
+            new = [int(t) for t in st.get("tokens") or []]
+            if "since" in st:
+                # the effective cursor is clamped server-side: a
+                # preemption that reset the stream replays from the clamp
+                # point, so truncate-then-append reconciles both cases
+                eff = int(st.get("since") or 0)
+                ticket.tokens = ticket.tokens[:eff] + new
+            else:
+                ticket.tokens = new
             if not ticket.finished:
                 continue
             if ticket.status == "rejected_draining":
@@ -737,6 +856,8 @@ class Router:
                 "free_slots": door.get("free_slots", 0),
                 "free_blocks": door.get("free_blocks", 0),
                 "prefix_hits": door.get("prefix_hits", 0),
+                "pool_gen": door.get("pool_gen"),
+                "pool_hits": door.get("pool_hits", 0),
                 "inc": rec["blob"].get("inc"),
             }
         for name in self._ejected:
@@ -746,6 +867,7 @@ class Router:
             "doors": doors,
             "counters": dict(self.counters),
             "live_tickets": self.live_tickets,
+            "queue_depth": len(self._queue),
             "affinity_hit_rate": round(
                 self.counters["affinity_hits"] / placed, 4) if placed
             else 0.0,

@@ -1,0 +1,143 @@
+"""Subprocess worker for the two-process KV-pool gate
+(tests/test_kvpool.py::test_two_process_pool_gate).
+
+Two phases over one launch KV master, run as SEPARATE processes so the
+only thing the exported blocks can travel through is the master's wire:
+
+* ``warm`` — an engine with the pool attached serves the (deterministic,
+  seed-derived) shared prompt once; its parked blocks export to the
+  master. Exits with a JSON summary carrying the decoded tokens and the
+  export counters.
+* ``cold`` — a FRESH process, same weights, empty pager: its first
+  shared-prompt admission must fetch + adopt those blocks from the
+  master (pool hits counted before any local registration existed),
+  decode bitwise-identically to an in-process no-pool control engine,
+  re-serve the second request from the now-local registry with zero
+  further compiles (steady-state contract), and survive a chaos-killed
+  fetch (``raise@fetch``) by falling back to plain prefill — parity and
+  pager invariants intact throughout.
+
+usage: serve_pool_worker.py <warm|cold> <kv-endpoint>
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _tiny_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    # seed 0 everywhere: exporter and adopter must serve the SAME weights
+    # or block adoption would be numerically meaningless
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _shared_prompt():
+    # 16 tokens = two full 8-token blocks (only whole blocks cross the
+    # pool) + a 3-token private tail
+    rng = np.random.RandomState(7)
+    return rng.randint(1, 64, 16).tolist() + [40, 50, 60]
+
+
+def main():
+    phase = sys.argv[1]
+    kv_endpoint = sys.argv[2]
+
+    from paddle_tpu.distributed.launch.master import KVClient
+    from paddle_tpu.serving import DecodeEngine, FaultSchedule, KVPool
+
+    pool = KVPool(KVClient(kv_endpoint, timeout=5.0), job="pool-gate")
+    prompt = _shared_prompt()
+
+    if phase == "warm":
+        eng = DecodeEngine(_tiny_model(), max_slots=2, max_len=48,
+                           block_size=8, prefill_chunk=8, kv_pool=pool)
+        r = eng.submit(prompt, max_new_tokens=4)
+        eng.run()
+        assert r.status == "done", r.status
+        eng._pager.check_invariants()
+        ps = eng.pool_stats()
+        assert ps["exports"] >= 2, ps       # both full prefix blocks left
+        print(json.dumps({
+            "phase": "warm",
+            "tokens": [int(t) for t in r.output_tokens],
+            "pool": ps,
+            "invariants": "ok",
+        }), flush=True)
+        return 0
+
+    assert phase == "cold", phase
+    # no-pool control arm first: the parity reference for everything below
+    ctrl = DecodeEngine(_tiny_model(), max_slots=2, max_len=48,
+                        block_size=8, prefill_chunk=8)
+    rc = ctrl.submit(prompt, max_new_tokens=4)
+    ctrl.run()
+    assert rc.status == "done", rc.status
+    expect = [int(t) for t in rc.output_tokens]
+
+    eng = DecodeEngine(_tiny_model(), max_slots=2, max_len=48,
+                       block_size=8, prefill_chunk=8, kv_pool=pool)
+    assert not eng._pager._registry, "cold engine must start unregistered"
+    r1 = eng.submit(prompt, max_new_tokens=4)
+    eng.run()
+    assert r1.status == "done", r1.status
+    eng._pager.check_invariants()
+    ps = eng.pool_stats()
+    assert ps["fetch_hits"] >= 2 and ps["adopted_blocks"] >= 2, ps
+    assert eng._pager.pool_hits >= 1, "adoption must count as a pool hit"
+    parity1 = [int(t) for t in r1.output_tokens] == expect
+
+    # steady state: the second identical prompt is served from the (now
+    # local) registry — no further fetches, no further compiles
+    compiles = eng.compile_count
+    fetches = ps["fetches"]
+    r2 = eng.submit(prompt, max_new_tokens=4)
+    eng.run()
+    assert r2.status == "done", r2.status
+    eng._pager.check_invariants()
+    steady_recompiles = eng.compile_count - compiles
+    refetches = eng.pool_stats()["fetches"] - fetches
+    parity2 = [int(t) for t in r2.output_tokens] == expect
+
+    # chaos: a killed fetch degrades to plain prefill — same tokens,
+    # clean invariants, zero adoption on that engine
+    chaos_eng = DecodeEngine(
+        _tiny_model(), max_slots=2, max_len=48, block_size=8,
+        prefill_chunk=8, kv_pool=pool,
+        fault_schedule=FaultSchedule.parse("raise@fetch:1"))
+    r3 = chaos_eng.submit(prompt, max_new_tokens=4)
+    chaos_eng.run()
+    assert r3.status == "done", r3.status
+    chaos_eng._pager.check_invariants()
+    assert chaos_eng.pool_stats()["adopted_blocks"] == 0, \
+        chaos_eng.pool_stats()
+    parity3 = [int(t) for t in r3.output_tokens] == expect
+
+    print(json.dumps({
+        "phase": "cold",
+        "tokens": [int(t) for t in r1.output_tokens],
+        "parity": bool(parity1 and parity2 and parity3),
+        "pool": ps,
+        "pool_hits": int(eng._pager.pool_hits),
+        "steady_state_recompiles": int(steady_recompiles),
+        "refetches": int(refetches),
+        "chaos_fallback": "plain_prefill",
+        "invariants": "ok",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,7 +95,8 @@ def render_router(route_states, now=None, width=100):
             f"affinity {aff / placed if placed else 0:.0%}  requeues "
             f"{int(c.get('requeues', 0))}  ejections "
             f"{int(c.get('ejections', 0))}  rejected "
-            f"{int(c.get('rejected', 0))}  age={age:.1f}s")
+            f"{int(c.get('rejected', 0))}  queued "
+            f"{int(c.get('queued', 0))}  age={age:.1f}s")
     if prev is not None:
         dreq = c.get("requeues", 0) - (prev.get("counters") or {}) \
             .get("requeues", 0)
@@ -104,15 +105,22 @@ def render_router(route_states, now=None, width=100):
     out.append(head)
     out.append("-" * min(width, 100))
     out.append(f"{'engine':<12} {'door':<10} {'queue':>6} {'active':>7} "
-               f"{'free_slots':>11} {'free_blocks':>12} {'prefix_hits':>12}")
+               f"{'free_slots':>11} {'free_blocks':>12} {'prefix_hits':>12} "
+               f"{'pool':>10}")
     for name in sorted(doors):
         d = doors[name]
+        # pool column: cross-process tier hits at this door, "-" for an
+        # engine running without a pool attached
+        pool = ("-" if d.get("pool_gen") is None
+                else f"{int(d.get('pool_hits') or 0)}@g"
+                     f"{int(d.get('pool_gen'))}")
         out.append(f"{name:<12} {d.get('state', '?'):<10} "
                    f"{int(d.get('queue_depth', 0)):>6} "
                    f"{int(d.get('active', 0)):>7} "
                    f"{int(d.get('free_slots', 0)):>11} "
                    f"{int(d.get('free_blocks', 0)):>12} "
-                   f"{int(d.get('prefix_hits', 0)):>12}")
+                   f"{int(d.get('prefix_hits', 0)):>12} "
+                   f"{pool:>10}")
     return "\n".join(out)
 
 

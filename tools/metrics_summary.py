@@ -506,6 +506,37 @@ def summarize(paths, show_events=False, out=sys.stdout):
                       f"rate is 0% — adoption-path bug signature (the "
                       f"share_prefix walk is not matching what "
                       f"register_prompt published)", file=out)
+            # cross-process prefix-cache tier (serving/kvpool.py): the
+            # export/fetch/adopt ledger, plus the cold-start signature —
+            # a pool that others populated, fetched repeatedly, and never
+            # once hit means the digest/generation/geometry handshake is
+            # broken (real cold starts MISS once then adopt)
+            pool_exports = gauges_m.get("pool/exports", 0)
+            pool_fetches = gauges_m.get("pool/fetches", 0)
+            if pool_exports or pool_fetches \
+                    or gauges_m.get("pool/pending_exports", 0):
+                pool_hits_n = gauges_m.get("pool/fetch_hits", 0)
+                pool_miss = gauges_m.get("pool/fetch_misses", 0)
+                print(f"  kv pool: gen {int(gauges_m.get('pool/gen', 0))}  "
+                      f"exports {int(pool_exports)} "
+                      f"(errors {int(gauges_m.get('pool/export_errors', 0))})"
+                      f"  fetches {int(pool_fetches)} "
+                      f"(hits {int(pool_hits_n)}, misses {int(pool_miss)})  "
+                      f"adopted {int(gauges_m.get('pool/adopted_blocks', 0))}"
+                      f" blocks / "
+                      f"{int(gauges_m.get('pool/adopted_tokens', 0))} tokens"
+                      f"  pending "
+                      f"{int(gauges_m.get('pool/pending_exports', 0))}",
+                      file=out)
+                if pool_exports and pool_fetches >= 2 and not pool_hits_n:
+                    print(f"  WARNING: the kv pool holds "
+                          f"{int(pool_exports)} exported block(s) and "
+                          f"{int(pool_fetches)} fetch(es) ran, yet ZERO "
+                          f"adopted — cold-start-never-adopts signature "
+                          f"(digest, generation or geometry mismatch "
+                          f"between exporter and fetcher; a restarted "
+                          f"engine is re-prefilling prompts the pool "
+                          f"already holds)", file=out)
             tp = gauges_m.get("serve/tp", 0)
             if tp and tp > 1:
                 # the engine shards the pool's head axis when it divides,
@@ -581,8 +612,13 @@ def summarize(paths, show_events=False, out=sys.stdout):
                   f"caught)"
                   + (f"  traces {r['traces'][:3]}" if r.get("traces")
                      else ""), file=out)
+        # pool-adoption carve-out: a reject tagged pool_blocks > 0 adopted
+        # that many blocks from the cross-process tier mid-admission, so
+        # its free-vs-needed figures straddle the splice — legitimate, not
+        # the allocator-bug shape this WARN patrols for
         frag = [r for r in by_kind.get("serve_page_reject", [])
-                if r.get("free_blocks", 0) >= r.get("needed_blocks", 1)]
+                if r.get("free_blocks", 0) >= r.get("needed_blocks", 1)
+                and not r.get("pool_blocks")]
         if frag:
             worst = max(frag, key=lambda r: r.get("free_blocks", 0))
             print(f"  WARNING: {len(frag)} paged admission(s) rejected "
@@ -647,20 +683,29 @@ def summarize(paths, show_events=False, out=sys.stdout):
         requeues = route_counters.get("route/requeues", 0)
         ejections = route_counters.get("route/ejections", 0)
         rejected = route_counters.get("route/rejected", 0)
+        queued = route_counters.get("route/queued", 0)
         line = (f"  placed {int(placed)}  affinity {int(aff)}"
                 + (f" ({aff / placed:.0%})" if placed else "")
                 + f"  spills {int(spills)}  requeues {int(requeues)}  "
                 f"ejections {int(ejections)}  rejected {int(rejected)}")
+        if queued:
+            line += (f"  queued {int(queued)} (depth "
+                     f"{int(gauges_m.get('route/queue_depth', 0))})")
         print(line, file=out)
         if route_states:
             doors = route_states[-1].get("doors") or {}
             for name in sorted(doors):
                 door = doors[name]
-                print(f"  engine {name}: {door.get('state', '?'):<10} "
-                      f"queue {int(door.get('queue_depth', 0))}  active "
-                      f"{int(door.get('active', 0))}  free_slots "
-                      f"{int(door.get('free_slots', 0))}  prefix_hits "
-                      f"{int(door.get('prefix_hits', 0))}", file=out)
+                line = (f"  engine {name}: {door.get('state', '?'):<10} "
+                        f"queue {int(door.get('queue_depth', 0))}  active "
+                        f"{int(door.get('active', 0))}  free_slots "
+                        f"{int(door.get('free_slots', 0))}  prefix_hits "
+                        f"{int(door.get('prefix_hits', 0))}")
+                if door.get("pool_gen") is not None:
+                    line += (f"  pool_hits "
+                             f"{int(door.get('pool_hits') or 0)} "
+                             f"(gen {int(door.get('pool_gen'))})")
+                print(line, file=out)
         ejs = by_kind.get("route_eject", [])
         for r in ejs:
             print(f"  +{r.get('ts', t0) - t0:9.3f}s  {tag(r)}ejected "
